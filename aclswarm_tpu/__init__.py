@@ -1,0 +1,28 @@
+"""aclswarm_tpu — a TPU-native swarm formation-flying framework.
+
+A ground-up JAX/XLA re-design of the capabilities of mit-acl/aclswarm
+(mirrored as gitshitou/aclswarm): distributed formation control, decentralized
+task assignment (CBAA auctions / Sinkhorn OT / Hungarian), ADMM formation-gain
+design, mutual localization, velocity-obstacle collision avoidance, and a
+simulation-in-the-loop trial harness.
+
+Where the reference runs one ROS process-stack per vehicle and communicates
+over TCPROS pub/sub, this framework holds the whole swarm as batched arrays
+`(n, ...)` on device, runs every per-vehicle algorithm as a vmapped kernel,
+and scales the agent axis over a `jax.sharding.Mesh` with ICI collectives in
+place of the reference's message passing (reference: SURVEY.md §2.5).
+
+Subpackages
+-----------
+- ``core``       pytree types + geometry kernels (pdistmat, Arun/Umeyama)
+- ``assignment`` task assignment: Hungarian oracle, device auction, CBAA
+                 consensus mode, Sinkhorn OT fast path
+- ``gains``      ADMM formation-gain design (SDP via ADMM, on device)
+- ``control``    formation control law, collision avoidance, safety shaping
+- ``sim``        vehicle dynamics + closed-loop jitted rollouts
+- ``parallel``   agent-axis sharding over device meshes
+- ``harness``    formation library, random formations, supervisor, trials
+- ``interop``    wire-format message types at the host boundary
+"""
+
+__version__ = "0.1.0"

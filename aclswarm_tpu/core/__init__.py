@@ -1,0 +1,10 @@
+from aclswarm_tpu.core import geometry, perm, types
+from aclswarm_tpu.core.types import (ControlGains, Formation, SafetyParams,
+                                     SwarmState, gains_from_flat,
+                                     gains_to_flat, make_formation)
+
+__all__ = [
+    "geometry", "perm", "types",
+    "SwarmState", "Formation", "ControlGains", "SafetyParams",
+    "make_formation", "gains_to_flat", "gains_from_flat",
+]

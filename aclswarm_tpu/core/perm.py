@@ -1,0 +1,71 @@
+"""Assignment-permutation conventions.
+
+The single trickiest bookkeeping in the reference is the vehicle-space vs
+formation-space duality of the assignment permutation (SURVEY.md §7 hard part
+2). The reference stores an `Eigen::PermutationMatrix` pair `P_` / `Pt_`
+(`aclswarm/src/auctioneer.cpp:264-277`):
+
+- ``P_.indices()(v)``  = the formation point assigned to vehicle ``v``
+  (used as "which formation point am I?", `aclswarm/src/distcntrl.cpp:56`);
+- ``Pt_.indices()(i)`` = the vehicle assigned to formation point ``i``
+  (CBAA's `who` table maps task -> vehicle, `auctioneer.cpp:264-267`);
+- ``P_ * q_veh`` permutes vehicle-ordered rows into formation order
+  (`distcntrl.cpp:53`): row v of q lands at row ``P_.indices()(v)``.
+
+Here a permutation is a plain ``(n,)`` index array. We name the two mappings
+explicitly and provide the conversions; *all* framework code goes through
+these helpers so the convention lives in exactly one place.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def identity(n: int) -> jnp.ndarray:
+    """The identity assignment (vehicle v -> formation point v)."""
+    return jnp.arange(n, dtype=jnp.int32)
+
+
+def invert(perm: jnp.ndarray) -> jnp.ndarray:
+    """Invert a permutation index array: out[perm[k]] = k."""
+    return jnp.argsort(perm).astype(perm.dtype)
+
+
+def veh_to_formation_order(x_veh: jnp.ndarray, v2f: jnp.ndarray) -> jnp.ndarray:
+    """Permute vehicle-ordered rows into formation order (reference ``P_ * q``).
+
+    ``out[v2f[v]] = x_veh[v]``, i.e. ``out[i] = x_veh[f2v[i]]``.
+    """
+    return x_veh[invert(v2f)]
+
+
+def formation_to_veh_order(x_form: jnp.ndarray, v2f: jnp.ndarray) -> jnp.ndarray:
+    """Permute formation-ordered rows back to vehicle order (``P^T * x``)."""
+    return x_form[v2f]
+
+
+def is_valid(perm: jnp.ndarray) -> jnp.ndarray:
+    """True iff `perm` is a valid permutation of 0..n-1.
+
+    Device-friendly version of `Auctioneer::isValidAssignment`
+    (`aclswarm/src/auctioneer.cpp:325-343`): every index seen exactly once.
+    Works for arrays containing negative/out-of-range entries.
+    """
+    n = perm.shape[0]
+    counts = jnp.zeros(n, dtype=jnp.int32)
+    inrange = (perm >= 0) & (perm < n)
+    counts = counts.at[jnp.clip(perm, 0, n - 1)].add(inrange.astype(jnp.int32))
+    return jnp.all(counts == 1)
+
+
+def compose(outer: jnp.ndarray, inner: jnp.ndarray) -> jnp.ndarray:
+    """Compose permutations: apply `inner` (vehicle -> formation pt) first,
+    then `outer`, a *formation-space* relabeling (f -> f) produced by a
+    reassignment computed in the already-permuted space.
+
+    ``compose(outer, inner)[v] = outer[inner[v]]`` — matches the
+    permutation-composition semantics the MATLAB reference documents for
+    reassignment (`aclswarm/matlab/CBAA/CBAA_aclswarm.m:8-28`,
+    `aclswarm/matlab/Helpers/Sys.m:46-92`: Q = Qsigma2*Qsigma1).
+    """
+    return outer[inner]

@@ -1,0 +1,138 @@
+"""Core pytree types for the swarm framework.
+
+The reference keeps per-vehicle state scattered across n ROS processes
+(`aclswarm/include/aclswarm/utils.h:25-30` typedefs: AdjMat, PtsMat(n,3),
+GainMat(3n,3n), AssignmentPerm). Here the whole swarm is one batched pytree.
+
+Conventions (see also `aclswarm_tpu/core/perm.py`):
+- positions/velocities are ``(n, 3)`` arrays in *vehicle order* unless noted;
+- the adjacency matrix is an ``(n, n)`` {0,1} mask over *formation points*;
+- gains are stored as ``(n, n, 3, 3)`` blocks (TPU-friendly layout); the
+  reference's flat ``(3n, 3n)`` GainMat is `gains_to_flat`/`gains_from_flat`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class SwarmState:
+    """Batched swarm state, vehicle order.
+
+    Replaces the per-vehicle `q_`/`vel_` members of the reference's
+    coordination node (`aclswarm/src/coordination_ros.cpp:240-259`).
+    """
+
+    q: jnp.ndarray    # (n, 3) positions
+    vel: jnp.ndarray  # (n, 3) velocities
+
+    @property
+    def n(self) -> int:
+        return self.q.shape[0]
+
+
+@struct.dataclass
+class Formation:
+    """A desired formation: points + graph + (optional) gains.
+
+    Mirrors `aclswarm_msgs/msg/Formation.msg:1-18` and the controller-side
+    `DistCntrl::Formation` struct (`aclswarm/include/aclswarm/distcntrl.h:26-34`),
+    including the precomputed desired-distance matrices
+    (`aclswarm/src/distcntrl.cpp:28-35`).
+    """
+
+    points: jnp.ndarray            # (n, 3) desired formation points
+    adjmat: jnp.ndarray            # (n, n) {0,1} adjacency over formation pts
+    gains: jnp.ndarray             # (n, n, 3, 3) gain blocks, formation space
+    dstar_xy: jnp.ndarray          # (n, n) pairwise desired xy distances
+    dstar_z: jnp.ndarray           # (n, n) pairwise desired |z| distances
+
+    @property
+    def n(self) -> int:
+        return self.points.shape[0]
+
+
+@struct.dataclass
+class ControlGains:
+    """Scalar control-law gains.
+
+    Defaults are the SIL values from `aclswarm/launch/coordination.launch:32-39`
+    (struct spec: `aclswarm/include/aclswarm/distcntrl.h:36-45`).
+    """
+
+    K1_xy: float = 0.1
+    K2_xy: float = 0.1
+    K1_z: float = 0.5
+    K2_z: float = 0.3
+    e_xy_thr: float = 0.3
+    e_z_thr: float = 0.1
+    kp: float = 1.5
+    kd: float = 0.5
+
+
+@struct.dataclass
+class SafetyParams:
+    """Safety-node parameters: room bounds, rate/velocity limits, avoidance.
+
+    Defaults from `aclswarm/src/safety.cpp:30-58` overlaid with the launch
+    values in `aclswarm/launch/coordination.launch:13-18`.
+    """
+
+    bounds_min: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.array([0.0, 0.0, 0.0]))
+    bounds_max: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.array([1.0, 1.0, 1.0]))
+    spinup_time: float = 2.0
+    control_dt: float = 0.01
+    takeoff_inc: float = 0.0035
+    takeoff_alt: float = 1.0
+    # static (not a pytree leaf): selects host-side control flow
+    takeoff_rel: bool = struct.field(pytree_node=False, default=True)
+    landing_fast_threshold: float = 0.400
+    landing_fast_dec: float = 0.0035
+    landing_slow_dec: float = 0.001
+    max_accel_xy: float = 0.5
+    max_accel_z: float = 0.8
+    max_vel_xy: float = 0.5
+    max_vel_z: float = 0.3
+    d_avoid_thresh: float = 1.5
+    r_keep_out: float = 1.2
+
+
+def gains_to_flat(gains: jnp.ndarray) -> jnp.ndarray:
+    """(n, n, 3, 3) block gains -> (3n, 3n) flat GainMat (reference layout)."""
+    n = gains.shape[0]
+    return jnp.transpose(gains, (0, 2, 1, 3)).reshape(3 * n, 3 * n)
+
+
+def gains_from_flat(flat: jnp.ndarray) -> jnp.ndarray:
+    """(3n, 3n) flat GainMat -> (n, n, 3, 3) block gains."""
+    n = flat.shape[0] // 3
+    return jnp.transpose(flat.reshape(n, 3, n, 3), (0, 2, 1, 3))
+
+
+def make_formation(points, adjmat, gains=None) -> Formation:
+    """Build a `Formation`, precomputing desired-distance matrices.
+
+    Follows `DistCntrl::setFormation` (`aclswarm/src/distcntrl.cpp:28-35`):
+    dstar_xy = pdist of xy coords, dstar_z = pdist of z coords.
+    """
+    from aclswarm_tpu.core import geometry
+
+    points = jnp.asarray(points)
+    adjmat = jnp.asarray(adjmat)
+    n = points.shape[0]
+    if gains is None:
+        gains = jnp.zeros((n, n, 3, 3), dtype=points.dtype)
+    else:
+        gains = jnp.asarray(gains)
+        if gains.ndim == 2:
+            gains = gains_from_flat(gains)
+    return Formation(
+        points=points,
+        adjmat=adjmat,
+        gains=gains,
+        dstar_xy=geometry.pdistmat(points[:, :2]),
+        dstar_z=geometry.pdistmat(points[:, 2:3]),
+    )

@@ -1,0 +1,20 @@
+"""Test configuration: 8-device virtual CPU mesh + float64.
+
+Multi-chip hardware is not available in CI; per the framework's test strategy
+(SURVEY.md §4 implications), sharding is validated on a virtual 8-device CPU
+mesh. float64 is enabled so golden-value tests can match the reference's
+double-precision C++/MATLAB outputs (`aclswarm/test/test_admm.cpp` uses 1e-8
+tolerances).
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,173 @@
+"""Geometry kernel tests.
+
+Mirrors the reference's validation style: algebraic invariants + cross-checks
+against an independent implementation (`aclswarm/matlab/test_alignment.m`,
+`aclswarm/src/aclswarm/assignment.py:143-156` self-tests).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aclswarm_tpu.core import geometry, perm
+from aclswarm_tpu.core.types import gains_from_flat, gains_to_flat
+
+
+def rot2(th):
+    c, s = np.cos(th), np.sin(th)
+    return np.array([[c, -s], [s, c]])
+
+
+class TestPdistmat:
+    def test_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(10, 3))
+        D = geometry.pdistmat(jnp.asarray(x))
+        Dnp = np.linalg.norm(x[:, None, :] - x[None, :, :], axis=-1)
+        # the |x|^2-2xy trick loses ~sqrt(eps) near zero, like the reference
+        np.testing.assert_allclose(np.asarray(D), Dnp, atol=1e-7)
+
+    def test_zero_diagonal(self):
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(7, 2)))
+        D = geometry.pdistmat(x)
+        np.testing.assert_allclose(np.asarray(jnp.diag(D)), 0.0, atol=1e-12)
+
+
+class TestArun:
+    def test_recovers_planted_2d_transform(self):
+        rng = np.random.default_rng(2)
+        p = rng.normal(size=(8, 3))
+        R2 = rot2(0.7)
+        t2 = np.array([1.5, -2.0])
+        q = p.copy()
+        q[:, :2] = p[:, :2] @ R2.T + t2
+        R, t = geometry.arun(jnp.asarray(p), jnp.asarray(q), d=2)
+        np.testing.assert_allclose(np.asarray(R)[:2, :2], R2, atol=1e-8)
+        np.testing.assert_allclose(np.asarray(t)[:2], t2, atol=1e-8)
+        # z untouched for d=2
+        np.testing.assert_allclose(np.asarray(R)[2, 2], 1.0)
+        assert float(t[2]) == 0.0
+
+    def test_recovers_planted_3d_transform(self):
+        rng = np.random.default_rng(3)
+        p = rng.normal(size=(12, 3))
+        # random proper rotation via QR
+        A = rng.normal(size=(3, 3))
+        Q, _ = np.linalg.qr(A)
+        if np.linalg.det(Q) < 0:
+            Q[:, 0] *= -1
+        t3 = np.array([0.3, 4.0, -1.0])
+        q = p @ Q.T + t3
+        R, t = geometry.arun(jnp.asarray(p), jnp.asarray(q), d=3)
+        np.testing.assert_allclose(np.asarray(R), Q, atol=1e-8)
+        np.testing.assert_allclose(np.asarray(t), t3, atol=1e-8)
+
+    def test_no_reflection(self):
+        # mirrored clouds must still produce a proper rotation (det +1),
+        # per the det-correction in matlab/Helpers/arun.m:14-22
+        rng = np.random.default_rng(4)
+        p = rng.normal(size=(6, 3))
+        q = p.copy()
+        q[:, 0] *= -1.0  # reflect
+        R, _ = geometry.arun(jnp.asarray(p), jnp.asarray(q), d=3)
+        assert float(jnp.linalg.det(R)) == pytest.approx(1.0, abs=1e-8)
+
+    def test_weighted_subset_equals_sliced(self):
+        rng = np.random.default_rng(5)
+        p = rng.normal(size=(9, 3))
+        q = rng.normal(size=(9, 3))
+        mask = np.zeros(9)
+        sel = [0, 2, 3, 7]
+        mask[sel] = 1.0
+        Rw, tw = geometry.arun(jnp.asarray(p), jnp.asarray(q),
+                               w=jnp.asarray(mask), d=2)
+        Rs, ts = geometry.arun(jnp.asarray(p[sel]), jnp.asarray(q[sel]), d=2)
+        np.testing.assert_allclose(np.asarray(Rw), np.asarray(Rs), atol=1e-10)
+        np.testing.assert_allclose(np.asarray(tw), np.asarray(ts), atol=1e-10)
+
+
+class TestAlignLocal:
+    def test_full_graph_matches_global_align(self):
+        # with a complete graph and identity assignment every agent sees the
+        # whole swarm, so local alignment == global alignment for all agents
+        rng = np.random.default_rng(6)
+        n = 6
+        p = rng.normal(size=(n, 3))
+        q = rng.normal(size=(n, 3))
+        adj = np.ones((n, n)) - np.eye(n)
+        v2f = perm.identity(n)
+        out = geometry.align_formation_local(
+            jnp.asarray(q), jnp.asarray(p), jnp.asarray(adj), v2f)
+        ref = geometry.align(jnp.asarray(p), jnp.asarray(q), d=2)
+        for i in range(n):
+            np.testing.assert_allclose(np.asarray(out[i]), np.asarray(ref),
+                                       atol=1e-9)
+
+    def test_respects_assignment_permutation(self):
+        # scramble vehicles; aligning with the correct assignment must match
+        # aligning the unscrambled swarm
+        rng = np.random.default_rng(7)
+        n = 5
+        p = rng.normal(size=(n, 3))
+        q_form = rng.normal(size=(n, 3))
+        v2f = jnp.asarray(np.array([2, 0, 3, 1, 4], dtype=np.int32))
+        q_veh = np.asarray(q_form)[np.asarray(v2f)]  # vehicle v sits at its pt
+        adj = np.ones((n, n)) - np.eye(n)
+        out = geometry.align_formation_local(
+            jnp.asarray(q_veh), jnp.asarray(p), jnp.asarray(adj), v2f)
+        ref = geometry.align(jnp.asarray(p), jnp.asarray(q_form), d=2)
+        for i in range(n):
+            np.testing.assert_allclose(np.asarray(out[i]), np.asarray(ref),
+                                       atol=1e-9)
+
+    def test_jit_compatible(self):
+        rng = np.random.default_rng(8)
+        n = 4
+        f = jax.jit(geometry.align_formation_local)
+        out = f(jnp.asarray(rng.normal(size=(n, 3))),
+                jnp.asarray(rng.normal(size=(n, 3))),
+                jnp.asarray(np.ones((n, n)) - np.eye(n)),
+                perm.identity(n))
+        assert out.shape == (n, n, 3)
+
+
+class TestPerm:
+    def test_invert_roundtrip(self):
+        p = jnp.asarray(np.array([2, 0, 1, 4, 3], dtype=np.int32))
+        pi = perm.invert(p)
+        np.testing.assert_array_equal(np.asarray(p[pi]), np.arange(5))
+        np.testing.assert_array_equal(np.asarray(pi[p]), np.arange(5))
+
+    def test_order_conversions(self):
+        rng = np.random.default_rng(9)
+        x = jnp.asarray(rng.normal(size=(5, 3)))
+        v2f = jnp.asarray(np.array([2, 0, 1, 4, 3], dtype=np.int32))
+        xf = perm.veh_to_formation_order(x, v2f)
+        # row v must land at row v2f[v]
+        for v in range(5):
+            np.testing.assert_allclose(np.asarray(xf[int(v2f[v])]),
+                                       np.asarray(x[v]))
+        back = perm.formation_to_veh_order(xf, v2f)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+
+    def test_is_valid(self):
+        assert bool(perm.is_valid(jnp.asarray([1, 0, 2])))
+        assert not bool(perm.is_valid(jnp.asarray([1, 1, 2])))
+        assert not bool(perm.is_valid(jnp.asarray([-1, 0, 2])))
+        assert not bool(perm.is_valid(jnp.asarray([0, 1, 3])))
+
+
+class TestGainLayout:
+    def test_flat_roundtrip(self):
+        rng = np.random.default_rng(10)
+        n = 4
+        flat = jnp.asarray(rng.normal(size=(3 * n, 3 * n)))
+        blocks = gains_from_flat(flat)
+        # block (i, j) is the reference's A.block<3,3>(3i, 3j)
+        for i in range(n):
+            for j in range(n):
+                np.testing.assert_allclose(
+                    np.asarray(blocks[i, j]),
+                    np.asarray(flat[3 * i:3 * i + 3, 3 * j:3 * j + 3]))
+        np.testing.assert_allclose(np.asarray(gains_to_flat(blocks)),
+                                   np.asarray(flat))
