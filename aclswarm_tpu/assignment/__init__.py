@@ -1,0 +1,28 @@
+"""Task assignment solvers.
+
+Four modes, mirroring + extending the reference's two paths (SURVEY.md §2.5):
+
+- ``cbaa``     — decentralized CBAA max-consensus, reference-faithful parity
+                 mode (`aclswarm/src/auctioneer.cpp`).
+- ``auction``  — exact centralized LAP on device (Bertsekas auction), the
+                 TPU replacement for the base station's Hungarian
+                 (`aclswarm/nodes/operator.py:221-246`).
+- ``sinkhorn`` — entropic-OT fast path with permutation rounding.
+- ``lapjv``    — host O(n^3) Jonker-Volgenant, the test oracle.
+"""
+from aclswarm_tpu.assignment.auction import (AuctionResult, assign_min_dist,
+                                             auction_lap)
+from aclswarm_tpu.assignment.cbaa import (CBAAResult, bid_prices, cbaa_assign,
+                                          cbaa_from_state)
+from aclswarm_tpu.assignment.lapjv import lapjv, solve_assignment_host
+from aclswarm_tpu.assignment.sinkhorn import (SinkhornResult,
+                                              round_to_permutation,
+                                              sinkhorn_assign, sinkhorn_log)
+
+__all__ = [
+    "auction_lap", "assign_min_dist", "AuctionResult",
+    "cbaa_assign", "cbaa_from_state", "bid_prices", "CBAAResult",
+    "lapjv", "solve_assignment_host",
+    "sinkhorn_assign", "sinkhorn_log", "round_to_permutation",
+    "SinkhornResult",
+]

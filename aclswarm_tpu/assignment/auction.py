@@ -1,0 +1,135 @@
+"""Bertsekas auction algorithm for linear assignment, fully on device.
+
+This is the framework's exact *centralized* assignment kernel — the TPU
+equivalent of the reference's base-station Hungarian
+(`aclswarm/nodes/operator.py:221-246`: align + cdist +
+`scipy.optimize.linear_sum_assignment`, "for n = 15, takes 5-10 ms"). The
+auction algorithm is chosen over Hungarian/JV because each bidding round is
+dense (n, n) tensor work — argmax/top-2 reductions and scatters, no
+sequential augmenting paths — which is exactly what the TPU's vector units
+want, and it vmaps/shards cleanly.
+
+Jacobi variant with epsilon-scaling: all unassigned agents bid each round;
+each object accepts its highest bidder. With final eps < gap/n the result is
+optimal; for float costs it is within n*eps of optimal (standard auction
+guarantee). `lapjv` on host is the reference oracle in tests.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class AuctionResult(NamedTuple):
+    row_to_col: jnp.ndarray  # (n,) agent -> object
+    prices: jnp.ndarray      # (n,) final object prices
+    iters: jnp.ndarray       # () total bid rounds executed
+    valid: jnp.ndarray       # () bool: converged to a true permutation
+                             # (False only if max_rounds was exhausted)
+
+
+def auction_lap(benefit: jnp.ndarray,
+                eps_start: float | None = None,
+                eps_min: float = 1e-4,
+                scale_factor: float = 5.0,
+                max_rounds: int = 10000) -> AuctionResult:
+    """Maximize sum_i benefit[i, assign[i]] over permutations.
+
+    Args:
+      benefit: (n, n) benefit (negated cost) matrix.
+      eps_start: initial epsilon; defaults to max|benefit|/2.
+      eps_min: final epsilon (optimality slack is n * eps_min).
+      scale_factor: epsilon division factor per scaling phase.
+      max_rounds: safety cap on total bid rounds across all phases.
+    """
+    n = benefit.shape[0]
+    dtype = benefit.dtype
+    big = jnp.asarray(jnp.finfo(dtype).max / 4, dtype)
+
+    if eps_start is None:
+        eps_start = jnp.maximum(jnp.max(jnp.abs(benefit)), 1.0) / 2.0
+    else:
+        eps_start = jnp.asarray(eps_start, dtype)
+
+    def bid_round(state):
+        owner, prices, eps, rounds = state
+        # agent i is unassigned iff it owns no object. The "unowned" sentinel
+        # is n (positive out-of-bounds, dropped by the scatter) — NOT -1,
+        # which JAX index-wraps onto agent n-1.
+        assigned_agents = jnp.zeros((n,), bool).at[owner].set(
+            True, mode="drop")
+        unassigned = ~assigned_agents
+
+        value = benefit - prices[None, :]            # (n, n)
+        top1 = jnp.max(value, axis=1)
+        j_star = jnp.argmax(value, axis=1)
+        value2 = value.at[jnp.arange(n), j_star].set(-big)
+        top2 = jnp.max(value2, axis=1)
+        bid_amt = prices[j_star] + (top1 - top2) + eps  # (n,)
+
+        # each object takes its best bidder among unassigned agents
+        bids = jnp.where(
+            unassigned[:, None] & (j_star[:, None] == jnp.arange(n)[None, :]),
+            bid_amt[:, None], -big)                  # (n agents, n objects)
+        best_bid = jnp.max(bids, axis=0)
+        best_agent = jnp.argmax(bids, axis=0)
+        got_bid = best_bid > -big
+
+        new_prices = jnp.where(got_bid, best_bid, prices)
+        # evict previous owners implicitly: owner[j] simply changes
+        new_owner = jnp.where(got_bid, best_agent.astype(jnp.int32), owner)
+        return new_owner, new_prices, eps, rounds + 1
+
+    def phase_unfinished(state):
+        owner, _, _, rounds = state
+        assigned_agents = jnp.zeros((n,), bool).at[owner].set(
+            True, mode="drop")
+        return (~jnp.all(assigned_agents)) & (rounds < max_rounds)
+
+    def run_phase(carry):
+        prices, eps, rounds = carry
+        owner0 = jnp.full((n,), n, dtype=jnp.int32)  # n = unowned sentinel
+        owner, prices, _, rounds = lax.while_loop(
+            phase_unfinished, bid_round, (owner0, prices, eps, rounds))
+        return owner, prices, rounds
+
+    def scaling_cond(carry):
+        _, (prices, eps, rounds) = carry
+        return (eps > eps_min) & (rounds < max_rounds)
+
+    def scaling_body(carry):
+        _, (prices, eps, rounds) = carry
+        eps = jnp.maximum(eps / scale_factor, eps_min)
+        owner, prices, rounds = run_phase((prices, eps, rounds))
+        return owner, (prices, eps, rounds)
+
+    # first phase at eps_start, then scale down to eps_min
+    owner, prices, rounds = run_phase(
+        (jnp.zeros((n,), dtype), eps_start, jnp.asarray(0, jnp.int32)))
+    owner, (prices, _, rounds) = lax.while_loop(
+        scaling_cond, scaling_body,
+        (owner, (prices, eps_start, rounds)))
+
+    # owner[j] = agent; invert to agent -> object. If max_rounds was
+    # exhausted mid-phase some agents own nothing — flag via `valid` rather
+    # than silently returning a non-permutation.
+    row_to_col = jnp.zeros((n,), jnp.int32).at[owner].set(
+        jnp.arange(n, dtype=jnp.int32), mode="drop")
+    all_owned = jnp.all(owner < n)
+    return AuctionResult(row_to_col=row_to_col, prices=prices,
+                         iters=rounds, valid=all_owned)
+
+
+def assign_min_dist(q: jnp.ndarray, p_aligned: jnp.ndarray,
+                    **kw) -> jnp.ndarray:
+    """Centralized assignment minimizing total vehicle->point distance.
+
+    Device analogue of `find_optimal_assignment`
+    (`aclswarm/src/aclswarm/assignment.py:94-137`) with the Hungarian solve
+    replaced by the auction kernel. Returns v2f (n,).
+    """
+    from aclswarm_tpu.core import geometry
+    return auction_lap(-geometry.cdist(q, p_aligned), **kw).row_to_col

@@ -1,0 +1,177 @@
+"""CBAA (Consensus-Based Auction Algorithm) as a bulk-synchronous TPU kernel.
+
+The reference runs one asynchronous Auctioneer per vehicle, exchanging bid
+messages over per-neighbor ROS topics and pumping a locked queue at 1 kHz
+(`aclswarm/src/auctioneer.cpp`; spec lines cited per function below). Because
+CBAA is logically a synchronous iteration — an agent cannot advance until all
+graph neighbors' bids for the current iteration arrived
+(`auctioneer.cpp:419-437` bidIterComplete) — the TPU-native design drops the
+queues/mutexes entirely and runs the *synchronous matrix form* (the same one
+the MATLAB ground truth uses, `aclswarm/matlab/CBAA/CBAA_aclswarm.m`):
+all n price/who tables live in one ``(n, n)`` array, a bid round is a masked
+max-consensus over the neighbor axis, and the whole auction is a
+``lax.scan`` over ``n * diameter`` rounds (diameter hardcoded 2, matching
+`auctioneer.cpp:50-51`).
+
+Semantics preserved from the reference:
+- initial greedy bid on the nearest aligned formation point with price
+  1/(dist + 1e-8) (`selectTaskAssignment` `auctioneer.cpp:517-542`,
+  `getPrice` `auctioneer.cpp:546-549`);
+- per-task winner = highest price among graph neighbors + self, ties broken
+  by LOWEST vehicle id (std::map iteration order + strict `>` comparison,
+  `updateTaskAssignment` `auctioneer.cpp:469-513`);
+- an outbid agent rebids in the same round on the updated table
+  (`processBid` `auctioneer.cpp:221-224`);
+- rebid requires strictly beating the table price at the candidate task, and
+  selects the FIRST index achieving the max among candidates
+  (`auctioneer.cpp:524-535` sequential max with strict `>`);
+- the communication graph follows adjacency composed with the *current*
+  assignment (`bidIterComplete` maps formation-space adjacency to vehicle
+  space through P/Pt, `auctioneer.cpp:419-437`);
+- the final `who` table maps task -> vehicle id, i.e. P^T
+  (`auctioneer.cpp:264-267`); validity = it is a permutation
+  (`isValidAssignment` `auctioneer.cpp:325-343`).
+
+Memory note: the consensus round materializes an (n, n, n) masked-broadcast;
+this CBAA-faithful mode is the parity/validation path for moderate n. The
+scalable device solvers are `auction.py` (exact) and `sinkhorn.py` (fast).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from aclswarm_tpu.core import geometry
+from aclswarm_tpu.core import perm as permutil
+
+PRICE_EPS = 1e-8  # getPrice regularizer, auctioneer.cpp:548
+DIAMETER = 2      # hardcoded graph-diameter budget, auctioneer.cpp:50
+
+
+class CBAAResult(NamedTuple):
+    v2f: jnp.ndarray    # (n,) vehicle -> formation point (P indices)
+    f2v: jnp.ndarray    # (n,) formation point -> vehicle (P^T / `who` table)
+    valid: jnp.ndarray  # () bool: consensus reached a true permutation
+    price: jnp.ndarray  # (n, n) final per-agent price tables
+    who: jnp.ndarray    # (n, n) final per-agent winner tables
+
+
+def bid_prices(q_veh: jnp.ndarray, paligned: jnp.ndarray) -> jnp.ndarray:
+    """Candidate prices: price[v, j] = 1 / (||q_v - paligned_v[j]|| + eps).
+
+    `Auctioneer::getPrice` (`auctioneer.cpp:546-549`) batched over all agents
+    and all tasks; `paligned` is each agent's own locally-aligned formation
+    ((n, n, 3), agent axis first).
+    """
+    d = jnp.linalg.norm(q_veh[:, None, :] - paligned, axis=-1)
+    return 1.0 / (d + PRICE_EPS)  # per-agent aligned pts: not cdist-shaped
+
+
+def _select_task(myprice, price, who, vehids):
+    """Vectorized `selectTaskAssignment` (`auctioneer.cpp:517-542`).
+
+    Each agent picks the first index achieving the max over candidate tasks
+    where its own price strictly beats the current table (and zero), then
+    writes its bid into its table row. Agents with no candidate leave their
+    row unchanged (`was_assigned` guard, `auctioneer.cpp:538-541`).
+    """
+    n = myprice.shape[0]
+    cand = (myprice > price) & (myprice > 0.0)
+    masked = jnp.where(cand, myprice, -jnp.inf)
+    task = jnp.argmax(masked, axis=1)              # first max (lowest j)
+    was_assigned = jnp.any(cand, axis=1)
+    rows = jnp.arange(n)
+    newp = price.at[rows, task].set(
+        jnp.where(was_assigned, myprice[rows, task], price[rows, task]))
+    neww = who.at[rows, task].set(
+        jnp.where(was_assigned, vehids, who[rows, task]))
+    return newp, neww
+
+
+def _consensus_round(price, who, comm_mask, vehids):
+    """One synchronous bid round: masked max-consensus over neighbors + self.
+
+    Vectorized `updateTaskAssignment` (`auctioneer.cpp:469-513`). Winner per
+    (agent, task) maximizes price with ties to the lowest vehicle id.
+    Returns updated tables and the per-agent outbid flags.
+    """
+    n = price.shape[0]
+    # eff[v, w, j]: neighbor w's price for task j as seen by agent v
+    eff = jnp.where(comm_mask[:, :, None], price[None, :, :], -jnp.inf)
+    # argmax over w returns the first (lowest-id) maximizer — the reference's
+    # std::map-order strict-> tie-break.
+    winner = jnp.argmax(eff, axis=1)               # (n, n) agent x task -> w
+    new_who = jnp.take_along_axis(
+        who[None, :, :], winner[:, None, :], axis=1)[:, 0, :]
+    new_price = jnp.take_along_axis(
+        price[None, :, :], winner[:, None, :], axis=1)[:, 0, :]
+
+    was_outbid = jnp.any(
+        (who == vehids[:, None]) & (new_who != vehids[:, None]), axis=1)
+    return new_price, new_who, was_outbid
+
+
+def cbaa_assign(q_veh: jnp.ndarray,
+                paligned: jnp.ndarray,
+                adjmat: jnp.ndarray,
+                v2f_prev: jnp.ndarray,
+                n_iters: Optional[int] = None) -> CBAAResult:
+    """Run a full synchronous CBAA auction on device.
+
+    Args:
+      q_veh: (n, 3) swarm positions, vehicle order (the `q_` snapshot taken
+        at auction start, `auctioneer.cpp:78-97`).
+      paligned: (n, n, 3) per-agent locally-aligned formation points, from
+        `geometry.align_formation_local`.
+      adjmat: (n, n) formation-space adjacency.
+      v2f_prev: (n,) current assignment (defines the comm graph).
+      n_iters: bid rounds; defaults to n * DIAMETER (`auctioneer.cpp:50-51`).
+
+    Returns a `CBAAResult`; `valid` mirrors the reference's detect-and-skip
+    recovery for non-permutation outcomes (`auctioneer.cpp:283-292`).
+    """
+    n = q_veh.shape[0]
+    if n_iters is None:
+        n_iters = n * DIAMETER
+    vehids = jnp.arange(n, dtype=jnp.int32)
+
+    # comm graph in vehicle space: v hears w iff adj[v2f[v], v2f[w]] or v==w
+    comm_mask = adjmat[jnp.ix_(v2f_prev, v2f_prev)] > 0
+    comm_mask = comm_mask | jnp.eye(n, dtype=bool)
+
+    myprice = bid_prices(q_veh, paligned)
+
+    # START bids (auctioneer.cpp:100-105): empty tables + initial greedy bid
+    price0 = jnp.zeros((n, n), dtype=myprice.dtype)
+    who0 = jnp.full((n, n), -1, dtype=jnp.int32)
+    price0, who0 = _select_task(myprice, price0, who0, vehids)
+
+    def round_fn(carry, _):
+        price, who = carry
+        price, who, outbid = _consensus_round(price, who, comm_mask, vehids)
+        # outbid agents rebid on the updated table (auctioneer.cpp:224)
+        newp, neww = _select_task(myprice, price, who, vehids)
+        price = jnp.where(outbid[:, None], newp, price)
+        who = jnp.where(outbid[:, None], neww, who)
+        return (price, who), None
+
+    (price, who), _ = lax.scan(round_fn, (price0, who0), None, length=n_iters)
+
+    # consensus result: every agent's `who` row is its belief of P^T
+    f2v = who[0].astype(jnp.int32)
+    agree = jnp.all(who == who[None, 0, :])
+    valid = agree & permutil.is_valid(f2v)
+    safe_f2v = jnp.where(valid, f2v, jnp.arange(n, dtype=jnp.int32))
+    v2f = permutil.invert(safe_f2v)
+    return CBAAResult(v2f=v2f, f2v=f2v, valid=valid, price=price, who=who)
+
+
+def cbaa_from_state(q_veh, formation_points, adjmat, v2f_prev, n_iters=None):
+    """Convenience wrapper: local alignment + auction, the full `start()` ->
+    consensus pipeline of `auctioneer.cpp:78-120` for the whole swarm."""
+    paligned = geometry.align_formation_local(
+        q_veh, formation_points, adjmat, v2f_prev)
+    return cbaa_assign(q_veh, paligned, adjmat, v2f_prev, n_iters=n_iters)

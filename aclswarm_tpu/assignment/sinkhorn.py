@@ -1,0 +1,88 @@
+"""Sinkhorn optimal-transport assignment: the high-rate fast path.
+
+The reference's decentralized assignment needs 2n sequential communication
+rounds per auction (`aclswarm/src/auctioneer.cpp:50-51`; SURVEY.md §3.2 —
+O(n^2) latency). The TPU north star replaces it with entropic OT: a fixed
+(or tolerance-gated) number of log-domain Sinkhorn iterations — each a pair
+of row/column logsumexp reductions over the (n, n) cost, pure vector work —
+followed by greedy rounding to a permutation with a validity guarantee by
+construction (the reference's validity concern: `auctioneer.cpp:325-343`).
+
+Accuracy: with temperature tau -> 0 the transport plan concentrates on the
+optimal permutation; at moderate tau rounding may be suboptimal but is always
+a valid permutation, and the exact `auction.py` kernel is the fallback/oracle
+(SURVEY.md §7 hard part 3).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class SinkhornResult(NamedTuple):
+    row_to_col: jnp.ndarray  # (n,) rounded permutation (v2f for our costs)
+    plan_log: jnp.ndarray    # (n, n) final log transport plan
+    err: jnp.ndarray         # () final row-marginal L1 error
+
+
+def sinkhorn_log(cost: jnp.ndarray, tau: float = 0.05,
+                 n_iters: int = 200) -> jnp.ndarray:
+    """Log-domain Sinkhorn on a square cost matrix; returns log plan (n, n).
+
+    Uniform marginals (every vehicle gets exactly one formation point).
+    """
+    n = cost.shape[0]
+    logK = -cost / tau
+    log_mu = jnp.full((n,), -jnp.log(n), dtype=cost.dtype)
+
+    def body(carry, _):
+        f, g = carry
+        f = log_mu - jax.nn.logsumexp(logK + g[None, :], axis=1)
+        g = log_mu - jax.nn.logsumexp(logK + f[:, None], axis=0)
+        return (f, g), None
+
+    f0 = jnp.zeros((n,), cost.dtype)
+    g0 = jnp.zeros((n,), cost.dtype)
+    (f, g), _ = lax.scan(body, (f0, g0), None, length=n_iters)
+    return logK + f[:, None] + g[None, :]
+
+
+def round_to_permutation(plan_log: jnp.ndarray) -> jnp.ndarray:
+    """Greedy rounding: repeatedly take the global max entry, strike its row
+    and column. Always yields a valid permutation in n steps."""
+    n = plan_log.shape[0]
+    neg = -jnp.inf
+
+    def body(carry, _):
+        scores, assign = carry
+        flat = jnp.argmax(scores)
+        i, j = flat // n, flat % n
+        assign = assign.at[i].set(j.astype(jnp.int32))
+        scores = scores.at[i, :].set(neg)
+        scores = scores.at[:, j].set(neg)
+        return (scores, assign), None
+
+    assign0 = jnp.full((n,), -1, jnp.int32)
+    (_, assign), _ = lax.scan(body, (plan_log, assign0), None, length=n)
+    return assign
+
+
+def sinkhorn_assign(q: jnp.ndarray, p_aligned: jnp.ndarray,
+                    tau: float = 0.05, n_iters: int = 200) -> SinkhornResult:
+    """Fast assignment: vehicle->point distances, Sinkhorn, greedy rounding.
+
+    Cost uses the same distance the reference prices bids with
+    (`auctioneer.cpp:546-549` is 1/(d+eps); minimizing d maximizes price).
+    """
+    from aclswarm_tpu.core import geometry
+    cost = geometry.cdist(q, p_aligned)
+    # normalize scale so tau is formation-size independent
+    cost = cost / (jnp.mean(cost) + 1e-12)
+    plan_log = sinkhorn_log(cost, tau=tau, n_iters=n_iters)
+    v2f = round_to_permutation(plan_log)
+    row_mass = jnp.exp(jax.nn.logsumexp(plan_log, axis=1))
+    err = jnp.sum(jnp.abs(row_mass - 1.0 / cost.shape[0]))
+    return SinkhornResult(row_to_col=v2f, plan_log=plan_log, err=err)

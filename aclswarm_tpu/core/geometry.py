@@ -37,7 +37,21 @@ def pdistmat(x: jnp.ndarray) -> jnp.ndarray:
     sq = jnp.sum(x * x, axis=-1)
     xxT = jnp.einsum("id,jd->ij", x, x, precision="highest")
     d2 = sq[:, None] + sq[None, :] - 2.0 * xxT
-    return jnp.sqrt(jnp.maximum(d2, 0.0))
+    d = jnp.sqrt(jnp.maximum(d2, 0.0))
+    # exact-zero diagonal: cancellation leaves ~sqrt(eps)*|x| self-distances
+    n = x.shape[0]
+    return jnp.where(jnp.eye(n, dtype=bool), 0.0, d)
+
+
+def cdist(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Cross distances ||a_i - b_j|| between rows of (n, d) and (m, d).
+
+    The single home of the assignment-cost distance (the reference prices
+    bids with 1/(d+eps), `auctioneer.cpp:546-549`, and the centralized path
+    uses scipy cdist, `assignment.py:94-137`). Direct subtraction — no
+    |x|^2-2xy cancellation — so it is safe near zero.
+    """
+    return jnp.linalg.norm(a[:, None, :] - b[None, :, :], axis=-1)
 
 
 def arun(p: jnp.ndarray, q: jnp.ndarray, w: jnp.ndarray | None = None,

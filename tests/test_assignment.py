@@ -1,0 +1,206 @@
+"""Assignment solver tests.
+
+Follows the reference's test strategy (SURVEY.md §4): golden/oracle
+cross-checks (lapjv vs scipy vs brute force; auction/sinkhorn vs lapjv) and
+algorithm-level scenario tests modeled on
+`aclswarm/matlab/CBAA/test_CBAA_aclswarm.m` (recover an obvious matching,
+adversarial swapped configurations, random permutations).
+"""
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aclswarm_tpu.assignment import (assign_min_dist, auction_lap,
+                                     cbaa_assign, cbaa_from_state, lapjv,
+                                     sinkhorn_assign)
+from aclswarm_tpu.core import geometry, perm
+
+
+def brute_force_min(cost):
+    n = cost.shape[0]
+    best, best_p = np.inf, None
+    for p in itertools.permutations(range(n)):
+        c = cost[np.arange(n), list(p)].sum()
+        if c < best:
+            best, best_p = c, np.array(p)
+    return best, best_p
+
+
+class TestLapjv:
+    def test_vs_brute_force(self):
+        rng = np.random.default_rng(0)
+        for _ in range(20):
+            n = int(rng.integers(2, 7))
+            C = rng.normal(size=(n, n))
+            r = lapjv(C)
+            best, _ = brute_force_min(C)
+            assert C[np.arange(n), r].sum() == pytest.approx(best, abs=1e-9)
+
+    def test_vs_scipy(self):
+        scipy_opt = pytest.importorskip("scipy.optimize")
+        rng = np.random.default_rng(1)
+        for _ in range(50):
+            n = int(rng.integers(2, 40))
+            C = rng.normal(size=(n, n)) * 10
+            r = lapjv(C)
+            ri, ci = scipy_opt.linear_sum_assignment(C)
+            assert C[np.arange(n), r].sum() == pytest.approx(
+                C[ri, ci].sum(), abs=1e-8)
+
+
+class TestAuction:
+    def test_optimal_cost_vs_lapjv(self):
+        rng = np.random.default_rng(2)
+        for trial in range(10):
+            n = int(rng.integers(3, 30))
+            C = rng.normal(size=(n, n)) * 5
+            res = auction_lap(jnp.asarray(-C), eps_min=1e-6)
+            r = np.asarray(res.row_to_col)
+            assert perm.is_valid(jnp.asarray(r))
+            opt = C[np.arange(n), lapjv(C)].sum()
+            got = C[np.arange(n), r].sum()
+            # auction guarantee: within n * eps_min of optimal
+            assert got <= opt + n * 1e-5
+
+    def test_assign_min_dist_recovers_obvious(self):
+        # vehicles sitting exactly on distinct formation points
+        rng = np.random.default_rng(3)
+        n = 12
+        p = rng.normal(size=(n, 3)) * 5
+        true = rng.permutation(n)
+        q = p[true]
+        v2f = assign_min_dist(jnp.asarray(q), jnp.asarray(p))
+        np.testing.assert_array_equal(np.asarray(v2f), true)
+
+    def test_jit(self):
+        rng = np.random.default_rng(4)
+        C = jnp.asarray(rng.normal(size=(8, 8)))
+        f = jax.jit(lambda b: auction_lap(b).row_to_col)
+        r = f(C)
+        assert perm.is_valid(r)
+
+
+class TestSinkhorn:
+    def test_valid_permutation_always(self):
+        rng = np.random.default_rng(5)
+        for _ in range(5):
+            n = int(rng.integers(3, 25))
+            q = rng.normal(size=(n, 3))
+            p = rng.normal(size=(n, 3))
+            res = sinkhorn_assign(jnp.asarray(q), jnp.asarray(p))
+            assert bool(perm.is_valid(res.row_to_col))
+
+    def test_near_optimal_on_separated_instances(self):
+        # well-separated instances: sinkhorn must match the exact solver
+        rng = np.random.default_rng(6)
+        n = 15
+        p = rng.normal(size=(n, 3)) * 10
+        true = rng.permutation(n)
+        q = p[true] + rng.normal(size=(n, 3)) * 0.05
+        res = sinkhorn_assign(jnp.asarray(q), jnp.asarray(p))
+        np.testing.assert_array_equal(np.asarray(res.row_to_col), true)
+
+    def test_cost_gap_vs_exact(self):
+        rng = np.random.default_rng(7)
+        n = 20
+        q = rng.normal(size=(n, 3)) * 3
+        p = rng.normal(size=(n, 3)) * 3
+        cost = np.linalg.norm(q[:, None] - p[None, :], axis=-1)
+        opt = cost[np.arange(n), lapjv(cost)].sum()
+        res = sinkhorn_assign(jnp.asarray(q), jnp.asarray(p))
+        got = cost[np.arange(n), np.asarray(res.row_to_col)].sum()
+        assert got <= opt * 1.10 + 1e-6  # fast path: within 10% of exact
+
+
+class TestCBAA:
+    def test_recovers_obvious_matching_complete_graph(self):
+        # swarm standing exactly on formation points, scrambled: CBAA must
+        # find the ground-truth matching (test_CBAA_aclswarm.m scenario 1)
+        rng = np.random.default_rng(8)
+        n = 8
+        p = rng.normal(size=(n, 3)) * 5
+        true = rng.permutation(n).astype(np.int32)
+        q = p[true]  # vehicle v at point true[v]
+        adj = jnp.asarray(np.ones((n, n)) - np.eye(n))
+        paligned = jnp.broadcast_to(jnp.asarray(p), (n, n, 3))
+        res = cbaa_assign(jnp.asarray(q), paligned, adj, perm.identity(n))
+        assert bool(res.valid)
+        np.testing.assert_array_equal(np.asarray(res.v2f), true)
+
+    def test_agreement_and_validity_random(self):
+        rng = np.random.default_rng(9)
+        for trial in range(5):
+            n = int(rng.integers(4, 12))
+            p = rng.normal(size=(n, 3)) * 4
+            q = rng.normal(size=(n, 3)) * 4
+            adj = jnp.asarray(np.ones((n, n)) - np.eye(n))
+            paligned = jnp.broadcast_to(jnp.asarray(p), (n, n, 3))
+            res = cbaa_assign(jnp.asarray(q), paligned, adj, perm.identity(n))
+            assert bool(res.valid), f"trial {trial}: CBAA did not converge"
+            # consensus: every agent's who-table identical
+            assert bool(jnp.all(res.who == res.who[0][None, :]))
+
+    def test_price_semantics_match_reference(self):
+        # price = 1/(dist + 1e-8): the winning bid for each task must be the
+        # price of the vehicle assigned to it (auctioneer.cpp:546-549)
+        rng = np.random.default_rng(10)
+        n = 6
+        p = rng.normal(size=(n, 3))
+        q = rng.normal(size=(n, 3))
+        adj = jnp.asarray(np.ones((n, n)) - np.eye(n))
+        paligned = jnp.broadcast_to(jnp.asarray(p), (n, n, 3))
+        res = cbaa_assign(jnp.asarray(q), paligned, adj, perm.identity(n))
+        assert bool(res.valid)
+        f2v = np.asarray(res.f2v)
+        d = np.linalg.norm(np.asarray(q)[f2v] - np.asarray(p), axis=-1)
+        np.testing.assert_allclose(np.asarray(res.price[0]),
+                                   1.0 / (d + 1e-8), rtol=1e-6)
+
+    def test_full_pipeline_with_local_alignment(self):
+        # end-to-end start(): local alignment then auction, on a rotated+
+        # translated swarm in formation shape -> recovers correspondence
+        rng = np.random.default_rng(11)
+        n = 6
+        th = np.linspace(0, 2 * np.pi, n, endpoint=False)
+        p = np.stack([np.cos(th), np.sin(th), np.ones(n)], 1)
+        c, s = np.cos(1.1), np.sin(1.1)
+        R = np.array([[c, -s], [s, c]])
+        qf = p.copy()
+        qf[:, :2] = p[:, :2] @ R.T + [4.0, 2.0]
+        true = rng.permutation(n).astype(np.int32)
+        q = qf[true]
+        adj = jnp.asarray(np.ones((n, n)) - np.eye(n))
+        res = cbaa_from_state(jnp.asarray(q), jnp.asarray(p), adj,
+                              perm.identity(n))
+        assert bool(res.valid)
+        # the hexagon is rotationally symmetric and the alignment runs off
+        # the stale (identity) assignment, exactly like the reference — so
+        # the result is the ground truth composed with a formation symmetry.
+        # Require congruence: swarm in formation order matches the formation
+        # shape exactly.
+        q_fs = np.asarray(perm.veh_to_formation_order(jnp.asarray(q),
+                                                      res.v2f))
+        np.testing.assert_allclose(
+            np.asarray(geometry.pdistmat(jnp.asarray(q_fs))),
+            np.asarray(geometry.pdistmat(jnp.asarray(p))), atol=1e-6)
+
+    def test_noncomplete_graph_converges(self):
+        # ring + chords graph (diameter 2-ish): still reaches consensus
+        rng = np.random.default_rng(12)
+        n = 8
+        adj = np.zeros((n, n))
+        for i in range(n):
+            for dj in (1, 2, 3):
+                j = (i + dj) % n
+                adj[i, j] = adj[j, i] = 1
+        p = rng.normal(size=(n, 3)) * 5
+        true = rng.permutation(n).astype(np.int32)
+        q = p[true]
+        paligned = jnp.broadcast_to(jnp.asarray(p), (n, n, 3))
+        res = cbaa_assign(jnp.asarray(q), paligned, jnp.asarray(adj),
+                          perm.identity(n))
+        assert bool(res.valid)
+        np.testing.assert_array_equal(np.asarray(res.v2f), true)
