@@ -1,0 +1,19 @@
+"""Formation control + safety shim (SURVEY.md §7 layer 4).
+
+- ``distcntrl`` — the distributed formation control law, one batched einsum
+  (`aclswarm/src/distcntrl.cpp` spec).
+- ``colavoid``  — velocity-obstacle collision avoidance, circular-angle masked
+  formulation (`aclswarm/src/safety.cpp:412-541` spec).
+- ``safety``    — saturation, accel rate limits, room bounds, trajectory goal
+  integration (`aclswarm/src/safety.cpp:330-408` spec).
+"""
+from aclswarm_tpu.control.colavoid import collision_avoidance, wrap_to_pi
+from aclswarm_tpu.control.distcntrl import compute, scale_control
+from aclswarm_tpu.control.safety import (TrajGoal, make_safe_traj, rate_limit,
+                                         saturate_velocity)
+
+__all__ = [
+    "compute", "scale_control",
+    "collision_avoidance", "wrap_to_pi",
+    "TrajGoal", "make_safe_traj", "rate_limit", "saturate_velocity",
+]

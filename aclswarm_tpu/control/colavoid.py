@@ -1,0 +1,132 @@
+"""Velocity-obstacle collision avoidance, vectorized over all agents.
+
+Spec: `aclswarm/src/safety.cpp:412-541` (`Safety::collisionAvoidance`) and the
+MATLAB ground truth `aclswarm/matlab/Helpers/ColAvoid.m`. Per agent, every
+neighbor within ``d_avoid_thresh`` (planar distance) casts a polar "no-fly"
+sector centered on its bearing with half-angle ``asin(r_keep_out / d)``
+(`safety.cpp:433-445`); if the desired velocity heading falls inside the union
+of sectors, the command is rotated to the nearest *free* sector edge when that
+edge is within ±90° (half-plane convergence argument, `safety.cpp:529-536`),
+else zeroed (`safety.cpp:538-540`).
+
+TPU-native design: the reference unions sectors by sorting edge events and
+counting parentheses on a linearized angle axis, with explicit ±pi splitting
+(`safety.cpp:450-480`). On device we never linearize: all angle tests are
+circular (`wrap(a - b)`), so sectors that straddle ±pi need no special case,
+and the union is implicit — a heading is unsafe iff it is strictly inside ANY
+sector, and a candidate edge is free iff it is strictly inside NO sector.
+Everything is fixed-shape masked math over the (n, n) pair grid, vmapped over
+the agent axis — no sorting, no data-dependent shapes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from aclswarm_tpu.core.types import SafetyParams
+
+
+def wrap_to_pi(a: jnp.ndarray) -> jnp.ndarray:
+    """Wrap angle(s) to [-pi, pi).
+
+    Circular analogue of the reference's `utils::wrapToPi` (`utils.h:275-280`);
+    the only divergence is at exactly ±pi (the reference maps pi -> pi, this
+    maps pi -> -pi), a measure-zero boundary that no decision below sits on.
+    """
+    return jnp.mod(a + jnp.pi, 2.0 * jnp.pi) - jnp.pi
+
+
+def _one_agent(qij_xy: jnp.ndarray, active: jnp.ndarray, vel: jnp.ndarray,
+               params: SafetyParams) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Avoidance for one agent against up-to-(n-1) active neighbors.
+
+    Args:
+      qij_xy: (n, 2) planar relative positions of the other vehicles.
+      active: (n,) bool, neighbor-within-threshold mask (self excluded).
+      vel: (3,) desired velocity goal.
+
+    Returns:
+      (safe velocity (3,), modified flag) — `modified` mirrors
+      `VelocityGoal::modified` feeding `SafetyStatus.collision_avoidance_active`
+      (`safety.cpp:277-279,503`), the gridlock signal.
+    """
+    d = jnp.linalg.norm(qij_xy, axis=-1)
+    theta = jnp.arctan2(qij_xy[:, 1], qij_xy[:, 0])
+    # half-angle; d <= r_keep_out => full half-plane sector (asin(1) = pi/2)
+    ratio = jnp.minimum(1.0, params.r_keep_out / jnp.maximum(d, 1e-12))
+    alpha = jnp.abs(jnp.arcsin(ratio))
+
+    psi = jnp.arctan2(vel[1], vel[0])
+
+    # Is the desired heading strictly inside any active sector?
+    inside = active & (jnp.abs(wrap_to_pi(psi - theta)) < alpha)
+    unsafe = jnp.any(inside)
+
+    # Candidate escape directions: both edges of every active sector.
+    n = theta.shape[0]
+    edges = jnp.concatenate([theta - alpha, theta + alpha])  # (2n,)
+    edge_active = jnp.concatenate([active, active])
+    # An edge is free iff it lies strictly inside no OTHER active sector
+    # (matching the union-zone boundary structure of `safety.cpp:460-513`).
+    # The owning sector is excluded explicitly: its edge sits exactly on its
+    # boundary in exact arithmetic, but `wrap(θ±α − θ) < α` is a coin flip
+    # under rounding.
+    own = jnp.tile(jnp.eye(n, dtype=bool), (2, 1))            # (2n, n)
+    covered = jnp.any(
+        ~own & active[None, :]
+        & (jnp.abs(wrap_to_pi(edges[:, None] - theta[None, :]))
+           < alpha[None, :]),
+        axis=1)
+    free = edge_active & ~covered
+
+    # Nearest free edge to the desired heading. NOTE: nearest is measured on
+    # the *linearized* [-pi, pi] axis, not circularly — the reference searches
+    # its sorted edge list with `utils::closest` (`safety.cpp:526`), so an
+    # edge across the ±pi cut is "far". The subsequent escape check is then
+    # circular (`safety.cpp:531`). Reproduced exactly: this asymmetry shapes
+    # when agents stop vs deflect, which feeds the gridlock predicate.
+    wedges = wrap_to_pi(edges)
+    dist_lin = jnp.where(free, jnp.abs(wedges - psi), jnp.inf)
+    min_dist = jnp.min(dist_lin)
+    # Exact-tie rule: `utils::closest` (`utils.h:309-325`) compares
+    # `|prev - v| < |it - v|` strictly, so an equidistant pair resolves to the
+    # *larger* edge — symmetric head-on encounters deflect counterclockwise.
+    tied = dist_lin == min_dist
+    best_edge = jnp.max(jnp.where(tied, wedges, -jnp.inf))
+    best_dist = jnp.where(jnp.isfinite(min_dist),
+                          jnp.abs(wrap_to_pi(best_edge - psi)), jnp.inf)
+
+    umag = jnp.linalg.norm(vel[:2])
+    v_edge = jnp.array([umag * jnp.cos(best_edge),
+                        umag * jnp.sin(best_edge), vel[2]])
+    v_stop = jnp.zeros_like(vel)
+
+    # Within the commanded half-plane => rotate to the edge; surrounded or
+    # edge behind us => full stop (`safety.cpp:516-540`).
+    escape_ok = jnp.isfinite(best_dist) & (best_dist <= jnp.pi / 2.0)
+    v_avoid = jnp.where(escape_ok, v_edge, v_stop)
+
+    v_out = jnp.where(unsafe, v_avoid, vel)
+    return v_out, unsafe
+
+
+def collision_avoidance(q: jnp.ndarray, vel_des: jnp.ndarray,
+                        params: SafetyParams) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched velocity-obstacle shim for the whole swarm.
+
+    Args:
+      q: (n, 3) vehicle positions (vehicle order — avoidance is done in
+         vehicle space, `safety.cpp:419-424`).
+      vel_des: (n, 3) desired velocity goals.
+      params: safety parameters (``d_avoid_thresh``, ``r_keep_out``).
+
+    Returns:
+      ((n, 3) safe velocities, (n,) bool modified/avoidance-active flags).
+    """
+    n = q.shape[0]
+    qij = q[None, :, :] - q[:, None, :]           # (i, j, 3): j relative to i
+    dxy = jnp.linalg.norm(qij[..., :2], axis=-1)
+    active = (dxy <= params.d_avoid_thresh) & ~jnp.eye(n, dtype=bool)
+
+    return jax.vmap(_one_agent, in_axes=(0, 0, 0, None))(
+        qij[..., :2], active, vel_des, params)

@@ -1,0 +1,79 @@
+"""Distributed formation control law, batched over the whole swarm.
+
+Spec: `aclswarm/src/distcntrl.cpp:46-102` (per-vehicle `DistCntrl::compute`)
+and its MATLAB ground truth `aclswarm/matlab/Helpers/Sys.m:104-137`. The
+reference runs this independently on each of n vehicles at 100 Hz; here it is
+one jitted einsum over the gain blocks plus a masked nonlinear scale term,
+producing all n velocity commands at once (SURVEY.md §7 layer 4).
+
+Behavioral notes preserved from the reference:
+- The damping term ``kd * (-vel)`` is accumulated *inside* the neighbor loop
+  (`distcntrl.cpp:93-96`), so effective damping scales with the degree of the
+  vehicle's formation point. We reproduce that (``deg * kd * -vel``) rather
+  than "fixing" it — gains were tuned against it.
+- The scale (nonlinear) control has per-axis deadbands: the xy term applies to
+  both x and y only when ``|e_xy| > e_xy_thr``; the z term only when
+  ``|e_z| > e_z_thr`` (`distcntrl.cpp:74-83`).
+- Everything is computed in *formation space*: positions are permuted by the
+  current assignment before use (`distcntrl.cpp:53`), and the gain/adjacency
+  matrices are indexed by formation point.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from aclswarm_tpu.core import perm as permutil
+from aclswarm_tpu.core.types import ControlGains, Formation, SwarmState
+
+
+def scale_control(qij: jnp.ndarray, dstar_xy: jnp.ndarray,
+                  dstar_z: jnp.ndarray, gains: ControlGains) -> jnp.ndarray:
+    """Nonlinear scale-control diagonal F for every formation-point pair.
+
+    Args:
+      qij: (n, n, 3) relative positions, formation space (qij[i, j] = q_j - q_i).
+      dstar_xy / dstar_z: (n, n) desired pairwise xy / |z| distances.
+      gains: scalar control gains.
+
+    Returns:
+      (n, n, 3) the diagonal of F_ij (`distcntrl.cpp:74-83`): x and y carry the
+      xy-range term past its deadband, z carries the z-range term past its own.
+    """
+    e_xy = jnp.linalg.norm(qij[..., :2], axis=-1) - dstar_xy
+    F_xy = gains.K1_xy * jnp.arctan(gains.K2_xy * e_xy)
+    F_xy = jnp.where(jnp.abs(e_xy) > gains.e_xy_thr, F_xy, 0.0)
+
+    e_z = jnp.abs(qij[..., 2]) - dstar_z
+    F_z = gains.K1_z * jnp.arctan(gains.K2_z * e_z)
+    F_z = jnp.where(jnp.abs(e_z) > gains.e_z_thr, F_z, 0.0)
+
+    return jnp.stack([F_xy, F_xy, F_z], axis=-1)
+
+
+def compute(state: SwarmState, formation: Formation, v2f: jnp.ndarray,
+            gains: ControlGains) -> jnp.ndarray:
+    """All n vehicles' velocity commands (vehicle order), one batched step.
+
+    Replaces n independent calls to `DistCntrl::compute`
+    (`distcntrl.cpp:46-102`). Returns (n, 3) commanded velocities.
+    """
+    q_form = permutil.veh_to_formation_order(state.q, v2f)
+    adj = (formation.adjmat > 0).astype(q_form.dtype)
+
+    # qij[i, j] = q_j - q_i in formation space (`distcntrl.cpp:67`)
+    qij = q_form[None, :, :] - q_form[:, None, :]
+
+    # linear term A_ij @ qij + nonlinear scale term F_ij * qij, masked by graph
+    F = scale_control(qij, formation.dstar_xy, formation.dstar_z, gains)
+    lin = jnp.einsum("ijab,ijb->ija", formation.gains, qij,
+                     precision="highest")
+    up = jnp.sum(adj[..., None] * (lin + F * qij), axis=1)  # (n, 3) form space
+
+    # degree of each formation point: the reference adds kd*(-vel) once per
+    # neighbor (`distcntrl.cpp:93-96`)
+    deg = jnp.sum(adj, axis=1)
+
+    # back to vehicle order; each vehicle damps its own velocity
+    up_veh = permutil.formation_to_veh_order(up, v2f)
+    deg_veh = permutil.formation_to_veh_order(deg, v2f)
+    return gains.kp * up_veh - gains.kd * deg_veh[:, None] * state.vel

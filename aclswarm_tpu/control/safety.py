@@ -1,0 +1,96 @@
+"""Safety shaping: velocity saturation, accel rate limits, room bounds.
+
+Spec: `aclswarm/src/safety.cpp` — the per-vehicle safety node's signal
+conditioning, batched over the swarm:
+
+- `saturate_velocity`  <- `Safety::cmdinCb` (`safety.cpp:172-197`): planar and
+  vertical saturation preserving direction.
+- `rate_limit`         <- `utils::rateLimit` (`utils.h` template): clamp the
+  step change to ``[lo*dt, hi*dt]`` around the previous value.
+- `make_safe_traj`     <- `Safety::makeSafeTraj` (`safety.cpp:330-408`):
+  accel-rate-limit the velocity goal, integrate it into a position goal,
+  clamp to room bounds (only allowing motion back into the room), zero + re-
+  rate-limit the clamped axes, integrate yaw.
+
+The flight-mode FSM (`safety.cpp:201-318`) lives in `aclswarm_tpu.sim.vehicle`
+where it is stepped as batched integer state.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from aclswarm_tpu.core.types import SafetyParams
+from aclswarm_tpu.control.colavoid import wrap_to_pi
+
+
+@struct.dataclass
+class TrajGoal:
+    """Batched position+velocity+yaw trajectory goal (the QuadGoal analogue).
+
+    One row per vehicle; mirrors the integrated goal state the reference keeps
+    in its static `goalmsg` between 100 Hz ticks (`safety.cpp:203-208`).
+    """
+
+    pos: jnp.ndarray   # (n, 3)
+    vel: jnp.ndarray   # (n, 3)
+    yaw: jnp.ndarray   # (n,)
+    dyaw: jnp.ndarray  # (n,)
+
+    @classmethod
+    def hover_at(cls, q: jnp.ndarray, yaw: jnp.ndarray | None = None
+                 ) -> "TrajGoal":
+        n = q.shape[0]
+        if yaw is None:
+            yaw = jnp.zeros((n,), q.dtype)
+        return cls(pos=q, vel=jnp.zeros_like(q), yaw=yaw,
+                   dyaw=jnp.zeros((n,), q.dtype))
+
+
+def saturate_velocity(v: jnp.ndarray, params: SafetyParams) -> jnp.ndarray:
+    """Saturate planar speed to ``max_vel_xy`` and |vz| to ``max_vel_z``,
+    keeping direction (`safety.cpp:185-196`). v: (..., 3)."""
+    vxy = jnp.linalg.norm(v[..., :2], axis=-1, keepdims=True)
+    scale = jnp.where(vxy > params.max_vel_xy,
+                      params.max_vel_xy / jnp.maximum(vxy, 1e-12), 1.0)
+    xy = v[..., :2] * scale
+    z = jnp.clip(v[..., 2:3], -params.max_vel_z, params.max_vel_z)
+    return jnp.concatenate([xy, z], axis=-1)
+
+
+def rate_limit(dt: float, lo, hi, v0: jnp.ndarray,
+               v1: jnp.ndarray) -> jnp.ndarray:
+    """Limit the change from ``v0`` to ``v1`` to rates in ``[lo, hi]``."""
+    return jnp.clip(v1, v0 + lo * dt, v0 + hi * dt)
+
+
+def make_safe_traj(dt: float, vel_goal: jnp.ndarray, yawrate: jnp.ndarray,
+                   goal: TrajGoal, params: SafetyParams) -> TrajGoal:
+    """Turn velocity goals into a smooth, in-bounds trajectory goal.
+
+    Batched `Safety::makeSafeTraj` (`safety.cpp:330-408`). ``vel_goal`` is
+    (n, 3) desired velocities (already through collision avoidance),
+    ``yawrate`` is (n,), ``goal`` is the previous tick's integrated goal.
+    """
+    amax = jnp.array([params.max_accel_xy, params.max_accel_xy,
+                      params.max_accel_z], vel_goal.dtype)
+
+    # accel rate limit against the previous goal velocity
+    v = rate_limit(dt, -amax, amax, goal.vel, vel_goal)
+
+    # predicted next goal position; clamp only movement that leaves the room —
+    # min/max with the current goal lets an already-out-of-bounds goal move
+    # back in (`safety.cpp:371-379`)
+    nxt = goal.pos + v * dt
+    lo = jnp.minimum(params.bounds_min, goal.pos)
+    hi = jnp.maximum(params.bounds_max, goal.pos)
+    pos = jnp.clip(nxt, lo, hi)
+    clamped = (nxt < lo) | (nxt > hi)
+
+    # clamped axes: zero the velocity, but rate-limited so accel stays bounded
+    # (`safety.cpp:382-389`)
+    v = jnp.where(clamped,
+                  rate_limit(dt, -amax, amax, goal.vel, jnp.zeros_like(v)), v)
+
+    yaw = wrap_to_pi(goal.yaw + yawrate * dt)
+    return TrajGoal(pos=pos, vel=v, yaw=yaw, dyaw=yawrate)

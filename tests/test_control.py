@@ -1,0 +1,269 @@
+"""Control-layer tests.
+
+Per SURVEY.md §4's implications, every batched kernel is validated against an
+independent *sequential* reference implementation written the way the C++
+does it (per-vehicle loops, linearized-angle sector union), on random inputs:
+
+- formation control law vs a literal per-vehicle translation of
+  `DistCntrl::compute` (`aclswarm/src/distcntrl.cpp:46-102`);
+- collision avoidance vs an edge-sort/parenthesis-count implementation of
+  `Safety::collisionAvoidance` (`aclswarm/src/safety.cpp:412-541`);
+- safety shaping invariants (`safety.cpp:172-197,330-408`).
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from aclswarm_tpu import control
+from aclswarm_tpu.core import perm
+from aclswarm_tpu.core.types import (ControlGains, SafetyParams, SwarmState,
+                                     make_formation)
+
+
+def wrap(a):
+    while a > math.pi:
+        a -= 2 * math.pi
+    while a < -math.pi:
+        a += 2 * math.pi
+    return a
+
+
+def distcntrl_sequential(q_veh, vel, qdes, adj, gains_flat, v2f, g):
+    """Per-vehicle loop mirror of `DistCntrl::compute` (distcntrl.cpp:46-102)."""
+    n = q_veh.shape[0]
+    dstar_xy = np.linalg.norm(qdes[:, None, :2] - qdes[None, :, :2], axis=-1)
+    dstar_z = np.abs(qdes[:, None, 2] - qdes[None, :, 2])
+    # P_ * q_veh: row v lands at row v2f[v]
+    q = np.zeros_like(q_veh)
+    for v in range(n):
+        q[v2f[v]] = q_veh[v]
+    u_all = np.zeros((n, 3))
+    for v in range(n):
+        i = v2f[v]
+        u = np.zeros(3)
+        for j in range(n):
+            if adj[i, j]:
+                Aij = gains_flat[3 * i:3 * i + 3, 3 * j:3 * j + 3]
+                qij = q[j] - q[i]
+                e_xy = np.linalg.norm(qij[:2]) - dstar_xy[i, j]
+                F_xy = g.K1_xy * math.atan(g.K2_xy * e_xy)
+                e_z = abs(qij[2]) - dstar_z[i, j]
+                F_z = g.K1_z * math.atan(g.K2_z * e_z)
+                F = np.zeros(3)
+                if abs(e_xy) > g.e_xy_thr:
+                    F[0] = F[1] = F_xy
+                if abs(e_z) > g.e_z_thr:
+                    F[2] = F_z
+                up = Aij @ qij + F * qij
+                u += g.kp * up + g.kd * (-vel[v])
+        u_all[v] = u
+    return u_all
+
+
+def colavoid_sequential(q, vel, vehid, d_thresh, r_keep):
+    """Linearized-angle mirror of `Safety::collisionAvoidance`
+    (safety.cpp:412-541): sector edges, sort, parenthesis-count union."""
+    did_wrap = False
+    edges = []
+    for j in range(q.shape[0]):
+        if j == vehid:
+            continue
+        qij = q[j] - q[vehid]
+        d = np.linalg.norm(qij[:2])
+        if d > d_thresh:
+            continue
+        theta = math.atan2(qij[1], qij[0])
+        alpha = abs(math.asin(min(1.0, r_keep / d))) if d > 0 else math.pi / 2
+        beg, end = wrap(theta - alpha), wrap(theta + alpha)
+        edges.append((beg, +1))
+        edges.append((end, -1))
+        if beg > end:
+            did_wrap = True
+            edges.append((-math.pi, +1))
+            edges.append((math.pi, -1))
+    v = vel.copy()
+    if not edges:
+        return v, False
+    edges.sort()
+    count, start, zones = 0, 0.0, []
+    for a, s in edges:
+        if count == 0:
+            start = a
+        count += s
+        if count == 0:
+            zones.append((start, a))
+    psi = math.atan2(v[1], v[0])
+    if not any(z[0] < psi < z[1] for z in zones):
+        return v, False
+    zedges = []
+    for z in zones:
+        if not did_wrap or abs(z[0]) != math.pi:
+            zedges.append(z[0])
+        if not did_wrap or abs(z[1]) != math.pi:
+            zedges.append(z[1])
+    if not zedges:
+        return np.zeros(3), True
+    zedges.sort()
+    # utils::closest tie rule: strict `<` on the prev comparison means exact
+    # ties resolve to the larger edge (utils.h:309-325)
+    best = min(zedges, key=lambda e: (abs(e - psi), -e))
+    if abs(wrap(best - psi)) <= math.pi / 2:
+        umag = np.linalg.norm(v[:2])
+        return np.array([umag * math.cos(best), umag * math.sin(best),
+                         v[2]]), True
+    return np.zeros(3), True
+
+
+class TestDistCntrl:
+    def _random_problem(self, seed, n=6, permute=True):
+        rng = np.random.default_rng(seed)
+        qdes = rng.normal(size=(n, 3)) * 2.0
+        q = rng.normal(size=(n, 3)) * 2.0
+        vel = rng.normal(size=(n, 3)) * 0.3
+        adj = (rng.random((n, n)) < 0.6).astype(float)
+        adj = np.triu(adj, 1)
+        adj = adj + adj.T
+        gains_flat = rng.normal(size=(3 * n, 3 * n)) * 0.2
+        if permute:
+            v2f = rng.permutation(n).astype(np.int32)
+        else:
+            v2f = np.arange(n, dtype=np.int32)
+        return q, vel, qdes, adj, gains_flat, v2f
+
+    def test_matches_sequential_reference(self):
+        g = ControlGains()
+        for seed in range(5):
+            q, vel, qdes, adj, gains_flat, v2f = self._random_problem(seed)
+            ref = distcntrl_sequential(q, vel, qdes, adj, gains_flat, v2f, g)
+            f = make_formation(qdes, adj, gains_flat)
+            out = control.compute(
+                SwarmState(q=jnp.asarray(q), vel=jnp.asarray(vel)), f,
+                jnp.asarray(v2f), g)
+            np.testing.assert_allclose(np.asarray(out), ref, atol=1e-9)
+
+    def test_converged_swarm_zero_command(self):
+        # at the exact formation with zero velocity, u must vanish when gains
+        # have the kernel property A @ (formation offsets) = 0; use a simple
+        # consensus-style gain A_ij = I to check only relative-error terms
+        n = 5
+        rng = np.random.default_rng(11)
+        qdes = rng.normal(size=(n, 3))
+        adj = np.ones((n, n)) - np.eye(n)
+        # zero gains: linear term off; swarm exactly at formation => scale
+        # errors are zero => u = 0
+        f = make_formation(qdes, adj)
+        out = control.compute(
+            SwarmState(q=jnp.asarray(qdes), vel=jnp.zeros((n, 3))), f,
+            perm.identity(n), ControlGains())
+        np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-12)
+
+    def test_jit(self):
+        q, vel, qdes, adj, gains_flat, v2f = self._random_problem(42)
+        f = make_formation(qdes, adj, gains_flat)
+        fn = jax.jit(control.compute)
+        out = fn(SwarmState(q=jnp.asarray(q), vel=jnp.asarray(vel)), f,
+                 jnp.asarray(v2f), ControlGains())
+        assert out.shape == q.shape
+
+
+class TestColAvoid:
+    def _params(self):
+        return SafetyParams(d_avoid_thresh=1.5, r_keep_out=0.6)
+
+    def test_matches_sequential_reference(self):
+        p = self._params()
+        matched_modified = 0
+        for seed in range(30):
+            rng = np.random.default_rng(100 + seed)
+            n = 6
+            q = rng.normal(size=(n, 3)) * 1.2
+            vel = rng.normal(size=(n, 3)) * 0.5
+            out, mod = control.collision_avoidance(
+                jnp.asarray(q), jnp.asarray(vel), p)
+            for i in range(n):
+                vref, mref = colavoid_sequential(
+                    q, vel[i], i, p.d_avoid_thresh, p.r_keep_out)
+                assert bool(mod[i]) == mref, (seed, i)
+                np.testing.assert_allclose(np.asarray(out[i]), vref,
+                                           atol=1e-7, err_msg=f"{seed},{i}")
+                matched_modified += int(mref)
+        # make sure the sweep actually exercised avoidance
+        assert matched_modified > 10
+
+    def test_far_apart_untouched(self):
+        p = self._params()
+        q = np.array([[0.0, 0, 1], [10.0, 0, 1], [0, 10.0, 1]])
+        vel = np.array([[0.3, 0, 0], [0, 0.3, 0], [0.1, 0.1, 0]])
+        out, mod = control.collision_avoidance(jnp.asarray(q),
+                                               jnp.asarray(vel), p)
+        np.testing.assert_allclose(np.asarray(out), vel)
+        assert not np.any(np.asarray(mod))
+
+    def test_head_on_deflects(self):
+        # two vehicles approaching head-on: both goals must be modified and
+        # rotated away from the collision bearing
+        p = self._params()
+        q = np.array([[0.0, 0, 1], [1.0, 0, 1]])
+        vel = np.array([[0.5, 0, 0], [-0.5, 0, 0]])
+        out, mod = control.collision_avoidance(jnp.asarray(q),
+                                               jnp.asarray(vel), p)
+        assert np.all(np.asarray(mod))
+        # speed preserved (rotated, not scaled) since an escape edge exists
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(out)[:, :2], axis=1), 0.5, atol=1e-9)
+        # heading moved off the direct bearing
+        assert abs(math.atan2(float(out[0, 1]), float(out[0, 0]))) > 0.1
+
+    def test_surrounded_stops(self):
+        # agent ringed by close obstacles on all sides => full stop
+        p = SafetyParams(d_avoid_thresh=3.0, r_keep_out=1.2)
+        angles = np.linspace(0, 2 * math.pi, 8, endpoint=False)
+        ring = np.stack([1.4 * np.cos(angles), 1.4 * np.sin(angles),
+                         np.ones(8)], axis=1)
+        q = np.concatenate([[[0.0, 0, 1]], ring])
+        vel = np.zeros((9, 3))
+        vel[0] = [0.5, 0.0, 0.2]
+        out, mod = control.collision_avoidance(jnp.asarray(q),
+                                               jnp.asarray(vel), p)
+        assert bool(mod[0])
+        np.testing.assert_allclose(np.asarray(out[0]), 0.0, atol=1e-12)
+
+
+class TestSafetyShaping:
+    def test_saturate_velocity(self):
+        p = SafetyParams(max_vel_xy=0.5, max_vel_z=0.3)
+        v = jnp.asarray(np.array([[3.0, 4.0, -1.0], [0.1, 0.0, 0.1]]))
+        out = np.asarray(control.saturate_velocity(v, p))
+        np.testing.assert_allclose(np.linalg.norm(out[0, :2]), 0.5, atol=1e-9)
+        # direction preserved
+        np.testing.assert_allclose(out[0, :2] / 0.5,
+                                   np.array([3.0, 4.0]) / 5.0, atol=1e-9)
+        assert out[0, 2] == -0.3
+        np.testing.assert_allclose(out[1], [0.1, 0.0, 0.1])
+
+    def test_make_safe_traj_integrates_and_bounds(self):
+        p = SafetyParams(
+            bounds_min=jnp.asarray([0.0, 0.0, 0.0]),
+            bounds_max=jnp.asarray([5.0, 5.0, 3.0]),
+            max_accel_xy=100.0, max_accel_z=100.0)
+        goal = control.TrajGoal.hover_at(jnp.asarray([[4.99, 2.0, 1.0]]))
+        vel = jnp.asarray([[1.0, 0.0, 0.0]])
+        dt = 0.01
+        g2 = goal
+        for _ in range(10):
+            g2 = control.make_safe_traj(dt, vel, jnp.zeros((1,)), g2, p)
+        # clamped at the x wall, velocity zeroed there
+        assert float(g2.pos[0, 0]) <= 5.0 + 1e-12
+        assert float(g2.vel[0, 0]) == 0.0
+
+    def test_make_safe_traj_rate_limits(self):
+        p = SafetyParams(max_accel_xy=0.5, max_accel_z=0.8,
+                         bounds_min=jnp.asarray([-100.0, -100.0, -100.0]),
+                         bounds_max=jnp.asarray([100.0, 100.0, 100.0]))
+        goal = control.TrajGoal.hover_at(jnp.zeros((1, 3)))
+        vel = jnp.asarray([[10.0, 0.0, 0.0]])
+        g2 = control.make_safe_traj(0.01, vel, jnp.zeros((1,)), goal, p)
+        # one tick from rest: |dv| <= a*dt
+        assert abs(float(g2.vel[0, 0])) <= 0.5 * 0.01 + 1e-12
