@@ -30,8 +30,9 @@ def wrap_to_pi(a: jnp.ndarray) -> jnp.ndarray:
     """Wrap angle(s) to [-pi, pi).
 
     Circular analogue of the reference's `utils::wrapToPi` (`utils.h:275-280`);
-    the only divergence is at exactly ±pi (the reference maps pi -> pi, this
-    maps pi -> -pi), a measure-zero boundary that no decision below sits on.
+    diverges only at exactly ±pi (the reference maps pi -> pi, this maps
+    pi -> -pi). One decision DOES sit on that boundary — see the
+    intentional-divergence note in `_one_agent` on headings of exactly ±pi.
     """
     return jnp.mod(a + jnp.pi, 2.0 * jnp.pi) - jnp.pi
 
@@ -59,6 +60,13 @@ def _one_agent(qij_xy: jnp.ndarray, active: jnp.ndarray, vel: jnp.ndarray,
     psi = jnp.arctan2(vel[1], vel[0])
 
     # Is the desired heading strictly inside any active sector?
+    # INTENTIONAL DIVERGENCE from the reference: its linearized zone test
+    # `psi > beg && psi < end` (`safety.cpp:487-493`) can never flag
+    # psi == ±pi — a vehicle commanded exactly along -x flies unmodified
+    # straight at an obstacle dead ahead (the wrapped sector splits at ±pi
+    # and the strict inequalities exclude the seam). The circular test has
+    # no seam, so exactly-axis-aligned headings are handled like any other;
+    # we keep the safe behavior rather than reproduce the escape hatch.
     inside = active & (jnp.abs(wrap_to_pi(psi - theta)) < alpha)
     unsafe = jnp.any(inside)
 

@@ -1,0 +1,8 @@
+"""Trial harness: formation library, supervisor oracle, trial driver
+(SURVEY.md §7 layer 7)."""
+from aclswarm_tpu.harness.formations import (FormationSpec, load_formation,
+                                             load_group)
+from aclswarm_tpu.harness.supervisor import TrialResult, evaluate
+
+__all__ = ["FormationSpec", "load_formation", "load_group", "TrialResult",
+           "evaluate"]

@@ -1,0 +1,92 @@
+"""Formation-library loader: the reference's `formations.yaml` format.
+
+Spec: `aclswarm/param/formations.yaml:1-8` (format comment) interpreted with
+the operator's exact semantics (`aclswarm/nodes/operator.py:88-109,155-157`):
+
+- a *group* holds ``agents``, an optional group ``adjmat``, and a list of
+  formations, each with ``name``, ``points`` (n x 3), optional ``scale``,
+  optional per-formation ``adjmat``, optional ``gains`` (3n x 3n);
+- if the group supplies any ``adjmat`` key it overrides every formation's own
+  (`operator.py:95-103`) — note ``adjmat: fc`` is a *string*, so a group-level
+  ``fc`` forces every formation fully connected even when per-formation
+  matrices exist (this is how the shipped swarm6_3d demo actually flies);
+- anything that is not a list at that point becomes fully connected
+  (`operator.py:105-109`);
+- ``scale`` multiplies the points only — never the gains (`operator.py:155-157`).
+"""
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+import yaml
+
+from aclswarm_tpu.core.types import Formation, make_formation
+
+# the framework's own formation library (same file format)
+DEFAULT_LIBRARY = Path(__file__).resolve().parent.parent / "param" / "formations.yaml"
+
+
+@dataclasses.dataclass
+class FormationSpec:
+    """One loaded formation, host-side (NumPy)."""
+
+    name: str
+    points: np.ndarray            # (n, 3), scale already applied
+    adjmat: np.ndarray            # (n, n) {0,1}
+    gains: Optional[np.ndarray]   # (3n, 3n) or None
+
+    @property
+    def n(self) -> int:
+        return self.points.shape[0]
+
+    def to_device(self, gains: Optional[np.ndarray] = None) -> Formation:
+        """Build the device `Formation` pytree (precomputes dstar matrices)."""
+        g = gains if gains is not None else self.gains
+        return make_formation(self.points, self.adjmat, g)
+
+
+def _resolve_adjmat(entry, n: int) -> np.ndarray:
+    if isinstance(entry, list):
+        return np.asarray(entry, dtype=np.float64)
+    return np.ones((n, n)) - np.eye(n)  # 'fc', None, or anything non-list
+
+
+def load_group(path: str | Path | None = None, group: str = "swarm6_3d"
+               ) -> list[FormationSpec]:
+    """Load every formation in a group, operator semantics applied."""
+    path = Path(path) if path is not None else DEFAULT_LIBRARY
+    with open(path) as f:
+        lib = yaml.safe_load(f)
+    if group not in lib:
+        raise KeyError(f"formation group {group!r} not in {path} "
+                       f"(available: {[k for k in lib if isinstance(lib[k], dict)]})")
+    spec = lib[group]
+    n = int(spec["agents"])
+    has_global = "adjmat" in spec
+
+    out = []
+    for fm in spec["formations"]:
+        adj_entry = spec["adjmat"] if has_global else fm.get("adjmat")
+        adjmat = _resolve_adjmat(adj_entry, n)
+        scale = float(fm.get("scale", 1.0))
+        points = scale * np.asarray(fm["points"], dtype=np.float64)
+        gains = None
+        if "gains" in fm:
+            gains = np.asarray(fm["gains"], dtype=np.float64)
+            assert gains.shape == (3 * n, 3 * n), fm["name"]
+        assert points.shape == (n, 3), fm["name"]
+        out.append(FormationSpec(name=str(fm["name"]), points=points,
+                                 adjmat=adjmat, gains=gains))
+    return out
+
+
+def load_formation(name: str, path: str | Path | None = None,
+                   group: str = "swarm6_3d") -> FormationSpec:
+    """Load a single formation by name from a group."""
+    for fm in load_group(path, group):
+        if fm.name == name:
+            return fm
+    raise KeyError(f"formation {name!r} not in group {group!r}")

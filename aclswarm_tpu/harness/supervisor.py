@@ -1,0 +1,122 @@
+"""Trial supervisor: the reference's experiment oracle, evaluated post-hoc.
+
+Spec: `aclswarm_sim/nodes/supervisor.py` — a 50 Hz FSM sampling live topics
+into 1 s ring buffers and applying windowed predicates (SURVEY.md §2.2 P7,
+§4.4). Because the TPU sim records every control tick of the whole rollout
+(`aclswarm_tpu.sim.engine.rollout` metrics), the same predicates are computed
+here *after the fact* over the full time series — same thresholds, same
+window, no FSM races:
+
+- convergence: every vehicle's windowed-mean |distcmd| < 1.0 m/s
+  (`supervisor.py:61,297-316`, ORIG_ZERO_VEL_THR over BUFFER_SECONDS=1);
+- gridlock: any vehicle's windowed-mean collision-avoidance-active ratio
+  > 0.95 (`supervisor.py:62,318-337`);
+- metrics row: per-vehicle smoothed planar distance traveled (EWMA
+  alpha=0.98, `supervisor.py:83,452-478`), convergence time, time in
+  avoidance, assignment count (`supervisor.py:404-415` CSV schema).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+BUFFER_SECONDS = 1.0          # supervisor.py:47
+ORIG_ZERO_VEL_THR = 1.00      # m/s, supervisor.py:61
+AVG_ACTIVE_CA_THR = 0.95      # supervisor.py:62
+EWMA_ALPHA = 0.98             # supervisor.py:83
+ASSIGNMENT_TIMEOUT = 20.0     # s, supervisor.py:53
+GRIDLOCK_TIMEOUT = 90.0       # s, supervisor.py:56
+TRIAL_TIMEOUT = 600.0         # s, supervisor.py:57
+
+
+def rolling_mean(x: np.ndarray, window: int) -> np.ndarray:
+    """Rolling mean over the leading (time) axis; row t averages the window
+    *ending* at t. Rows before a full window mirror the reference's "not
+    enough data" answer by returning +inf-safe NaN."""
+    x = np.asarray(x, dtype=np.float64)
+    T = x.shape[0]
+    out = np.full_like(x, np.nan, dtype=np.float64)
+    if T < window:
+        return out
+    c = np.cumsum(x, axis=0)
+    out[window - 1] = c[window - 1] / window
+    out[window:] = (c[window:] - c[:-window]) / window
+    return out
+
+
+@dataclasses.dataclass
+class TrialResult:
+    """One formation's outcome — the CSV row of `supervisor.py:404-415`."""
+
+    converged: bool
+    convergence_time_s: Optional[float]   # first tick the predicate held
+    gridlocked: bool                      # gridlock predicate ever held
+    time_in_gridlock_s: float
+    time_in_avoidance_s: np.ndarray       # (n,) per vehicle
+    dist_traveled_m: np.ndarray           # (n,) EWMA-smoothed planar distance
+    n_reassignments: int
+    invalid_auctions: int
+
+    def csv_row(self, trial: int) -> list:
+        return ([trial] + self.dist_traveled_m.tolist()
+                + [self.convergence_time_s if self.converged else np.nan]
+                + [float(np.sum(self.time_in_avoidance_s))]
+                + [self.n_reassignments])
+
+
+def distance_traveled(q: np.ndarray, alpha: float = EWMA_ALPHA) -> np.ndarray:
+    """Per-vehicle planar distance through an EWMA position filter
+    (`supervisor.py:452-478`): smooth x/y, accumulate |delta| of the filtered
+    signal — suppresses jitter so hover doesn't count as travel."""
+    q = np.asarray(q)
+    fx = q[0, :, 0].copy()
+    fy = q[0, :, 1].copy()
+    dist = np.zeros(q.shape[1])
+    for t in range(1, q.shape[0]):
+        nx = alpha * fx + (1 - alpha) * q[t, :, 0]
+        ny = alpha * fy + (1 - alpha) * q[t, :, 1]
+        dist += np.hypot(nx - fx, ny - fy)
+        fx, fy = nx, ny
+    return dist
+
+
+def evaluate(distcmd_norm: np.ndarray, ca_active: np.ndarray,
+             q: np.ndarray, reassigned: np.ndarray,
+             assign_valid: np.ndarray, dt: float) -> TrialResult:
+    """Apply the supervisor predicates to a recorded rollout.
+
+    Args (time-major, from `rollout` metrics, moved to host):
+      distcmd_norm: (T, n) per-tick |distcmd|.
+      ca_active: (T, n) per-tick collision-avoidance-active flags.
+      q: (T, n, 3) positions.
+      reassigned / assign_valid: (T,) assignment events.
+      dt: control tick period (s).
+    """
+    distcmd_norm = np.asarray(distcmd_norm)
+    ca_active = np.asarray(ca_active, dtype=np.float64)
+    window = max(1, int(round(BUFFER_SECONDS / dt)))
+
+    # convergence: windowed per-vehicle mean speed all below threshold
+    avg_mag = rolling_mean(distcmd_norm, window)          # (T, n)
+    conv_t = np.all(avg_mag < ORIG_ZERO_VEL_THR, axis=1)  # NaN -> False
+    converged = bool(conv_t.any())
+    conv_time = float(np.argmax(conv_t) * dt) if converged else None
+
+    # gridlock: windowed per-vehicle CA-active ratio, any above threshold
+    avg_ca = rolling_mean(ca_active, window)
+    grid_t = np.nan_to_num(avg_ca, nan=0.0) > AVG_ACTIVE_CA_THR
+    grid_any = grid_t.any(axis=1)
+    gridlocked = bool(grid_any.any())
+
+    return TrialResult(
+        converged=converged,
+        convergence_time_s=conv_time,
+        gridlocked=gridlocked,
+        time_in_gridlock_s=float(np.sum(grid_any) * dt),
+        time_in_avoidance_s=np.sum(ca_active, axis=0) * dt,
+        dist_traveled_m=distance_traveled(q),
+        n_reassignments=int(np.sum(np.asarray(reassigned))),
+        invalid_auctions=int(np.sum(~np.asarray(assign_valid))),
+    )

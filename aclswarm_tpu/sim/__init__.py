@@ -1,0 +1,6 @@
+"""On-device closed-loop swarm simulation (SURVEY.md §7 layer 5)."""
+from aclswarm_tpu.sim.engine import (SimConfig, SimState, StepMetrics,
+                                     init_state, rollout, step)
+
+__all__ = ["SimConfig", "SimState", "StepMetrics", "init_state", "rollout",
+           "step"]

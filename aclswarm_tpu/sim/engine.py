@@ -1,0 +1,183 @@
+"""Closed-loop swarm simulation as one jitted `lax.scan`.
+
+Replaces the reference's SIL stack — n `snap_sim` dynamics processes + n
+3-node vehicle stacks wired over TCPROS, driven in real time for up to 600 s
+per trial (`aclswarm_sim/scripts/start.sh:126-160`, SURVEY.md §3.5) — with a
+single on-device rollout. One scan step = one 100 Hz control tick of *every*
+vehicle (`aclswarm/launch/coordination.launch:24` control_dt=0.01), with the
+auto-auction re-assignment decimated onto its own period
+(`coordination.launch:23` autoauction_dt=1.2) exactly as the reference
+multiplexes timers (SURVEY.md §2.5: decimation counters replace timers).
+
+Per tick, the reference's cross-process pipeline (§3.3) becomes a straight
+function composition: distcntrl -> saturate (`safety.cpp:185-196`) ->
+collision avoidance (`safety.cpp:412-541`) -> safe trajectory integration
+(`safety.cpp:330-408`) -> vehicle dynamics. The localization flood (§3.4) is
+exact in sim: all agents see the true batched state, which is what the
+reference's sim also converges to (common-frame estimates flooded at 50 Hz).
+
+Dynamics models:
+- ``tracking``: the autopilot tracks the integrated trajectory goal exactly
+  (the snap outer loop is a tight tracker; goals are already accel- and
+  velocity-limited by `make_safe_traj`, so motion stays physical);
+- ``firstorder``: velocity relaxes toward the goal velocity with time
+  constant ``tau`` — a lag model of the autopilot+vehicle, the analogue of
+  the double-integrator MATLAB sim (`aclswarm/matlab/FormCtrlDynam.m`).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from aclswarm_tpu import control
+from aclswarm_tpu.assignment import auction, cbaa
+from aclswarm_tpu.core import geometry
+from aclswarm_tpu.core import perm as permutil
+from aclswarm_tpu.core.types import (ControlGains, Formation, SafetyParams,
+                                     SwarmState)
+
+
+@struct.dataclass
+class SimConfig:
+    """Static rollout configuration (all fields are compile-time)."""
+
+    control_dt: float = struct.field(pytree_node=False, default=0.01)
+    # auto-auction period in control ticks: 1.2 s / 0.01 s
+    # (`coordination.launch:23`)
+    assign_every: int = struct.field(pytree_node=False, default=120)
+    # 'auction' (centralized exact, operator.py:221-246 semantics), 'cbaa'
+    # (decentralized consensus parity mode), or 'none' (hold assignment)
+    assignment: str = struct.field(pytree_node=False, default="auction")
+    dynamics: str = struct.field(pytree_node=False, default="tracking")
+    tau: float = struct.field(pytree_node=False, default=0.15)
+    use_colavoid: bool = struct.field(pytree_node=False, default=True)
+
+
+@struct.dataclass
+class SimState:
+    """Scan carry: everything that persists across control ticks."""
+
+    swarm: SwarmState
+    goal: control.TrajGoal
+    v2f: jnp.ndarray          # (n,) current assignment
+    tick: jnp.ndarray         # () int32
+
+
+@struct.dataclass
+class StepMetrics:
+    """Per-tick observables feeding the supervisor predicates (§2.2 P7)."""
+
+    distcmd_norm: jnp.ndarray   # (n,) |distcmd| per vehicle (pre-safety)
+    ca_active: jnp.ndarray      # (n,) collision avoidance modified the cmd
+    assign_valid: jnp.ndarray   # () bool: this tick's auction produced a perm
+    reassigned: jnp.ndarray     # () bool: assignment changed this tick
+    q: jnp.ndarray              # (n, 3) positions after the tick
+
+
+def init_state(q0, v2f0=None) -> SimState:
+    q0 = jnp.asarray(q0)
+    n = q0.shape[0]
+    if v2f0 is None:
+        v2f0 = permutil.identity(n)
+    return SimState(
+        swarm=SwarmState(q=q0, vel=jnp.zeros_like(q0)),
+        goal=control.TrajGoal.hover_at(q0),
+        v2f=jnp.asarray(v2f0, jnp.int32),
+        tick=jnp.asarray(0, jnp.int32))
+
+
+def _assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
+            cfg: SimConfig):
+    """One re-assignment: returns (new v2f, valid flag).
+
+    'auction' follows the centralized path (`assignment.py:94-137`): order the
+    swarm by the *last* assignment, globally align the formation (d=2), then
+    solve the absolute vehicle->point LAP. 'cbaa' follows the decentralized
+    path (`auctioneer.cpp:78-120`): per-agent local alignment + synchronous
+    max-consensus auction, invalid outcomes rejected (detect-and-skip,
+    `auctioneer.cpp:283-292`).
+    """
+    if cfg.assignment == "auction":
+        q_form = permutil.veh_to_formation_order(swarm.q, v2f)
+        paligned = geometry.align(formation.points, q_form, d=2)
+        res = auction.auction_lap(-geometry.cdist(swarm.q, paligned))
+        new_v2f = jnp.where(res.valid, res.row_to_col, v2f)
+        return new_v2f, res.valid
+    elif cfg.assignment == "cbaa":
+        res = cbaa.cbaa_from_state(swarm.q, formation.points,
+                                   formation.adjmat, v2f)
+        new_v2f = jnp.where(res.valid, res.v2f, v2f)
+        return new_v2f, res.valid
+    elif cfg.assignment == "none":
+        return v2f, jnp.asarray(True)
+    raise ValueError(f"unknown assignment mode {cfg.assignment!r}")
+
+
+def step(state: SimState, formation: Formation, gains: ControlGains,
+         sparams: SafetyParams, cfg: SimConfig
+         ) -> tuple[SimState, StepMetrics]:
+    """One 100 Hz control tick for the whole swarm (§3.3 pipeline)."""
+    swarm, goal, v2f = state.swarm, state.goal, state.v2f
+
+    # --- auto-auction (decimated onto its own period, §2.5) ---
+    do_assign = (state.tick % cfg.assign_every) == 0
+    if cfg.assignment == "none":
+        new_v2f, valid = v2f, jnp.asarray(True)
+    else:
+        new_v2f, valid = lax.cond(
+            do_assign,
+            lambda s, f, p: _assign(s, f, p, cfg),
+            lambda s, f, p: (p, jnp.asarray(True)),
+            swarm, formation, v2f)
+    reassigned = do_assign & jnp.any(new_v2f != v2f)
+    v2f = new_v2f
+
+    # --- distributed control law -> distcmd (§3.3) ---
+    u = control.compute(swarm, formation, v2f, gains)
+    distcmd_norm = jnp.linalg.norm(u, axis=-1)
+
+    # --- safety shim: saturate -> avoid -> safe trajectory ---
+    u = control.saturate_velocity(u, sparams)
+    if cfg.use_colavoid:
+        u, ca = control.collision_avoidance(swarm.q, u, sparams)
+    else:
+        ca = jnp.zeros((u.shape[0],), bool)
+    n = u.shape[0]
+    goal = control.make_safe_traj(cfg.control_dt, u,
+                                  jnp.zeros((n,), u.dtype), goal, sparams)
+
+    # --- vehicle dynamics ---
+    if cfg.dynamics == "tracking":
+        swarm = SwarmState(q=goal.pos, vel=goal.vel)
+    elif cfg.dynamics == "firstorder":
+        a = jnp.clip(cfg.control_dt / cfg.tau, 0.0, 1.0)
+        vel = swarm.vel + a * (goal.vel - swarm.vel)
+        swarm = SwarmState(q=swarm.q + vel * cfg.control_dt, vel=vel)
+    else:
+        raise ValueError(f"unknown dynamics model {cfg.dynamics!r}")
+
+    new_state = SimState(swarm=swarm, goal=goal, v2f=v2f,
+                         tick=state.tick + 1)
+    return new_state, StepMetrics(distcmd_norm=distcmd_norm, ca_active=ca,
+                                  assign_valid=valid, reassigned=reassigned,
+                                  q=swarm.q)
+
+
+@partial(jax.jit, static_argnames=("n_ticks", "cfg"))
+def rollout(state: SimState, formation: Formation, gains: ControlGains,
+            sparams: SafetyParams, cfg: SimConfig, n_ticks: int
+            ) -> tuple[SimState, StepMetrics]:
+    """Roll the swarm forward ``n_ticks`` control ticks; one jitted scan.
+
+    Returns the final state and time-stacked `StepMetrics` (leading axis
+    ``n_ticks``), from which the supervisor predicates are evaluated
+    (`aclswarm_tpu.harness.supervisor`).
+    """
+    def body(s, _):
+        return step(s, formation, gains, sparams, cfg)
+
+    return lax.scan(body, state, None, length=n_ticks)

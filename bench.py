@@ -1,0 +1,62 @@
+"""Benchmark driver contract: prints ONE JSON line.
+
+Headline metric: the centralized assignment pipeline (align + cdist + LAP) —
+the only hard number the reference publishes: "for n = 15, takes 5-10 ms"
+on the base-station CPU (`aclswarm/nodes/operator.py:241`, BASELINE.md).
+We time the identical pipeline (2D Umeyama alignment, pairwise distances,
+exact LAP via the device auction kernel) fully jitted on one TPU chip and
+report throughput in assignments/second; ``vs_baseline`` is the speedup over
+the reference's midpoint (7.5 ms => 133.3 Hz).
+"""
+import json
+import time
+
+import numpy as np
+
+BASELINE_HZ = 1000.0 / 7.5  # operator.py:241 midpoint
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from aclswarm_tpu.assignment import auction
+    from aclswarm_tpu.core import geometry
+    from aclswarm_tpu.core import perm as permutil
+
+    n = 15
+    rng = np.random.default_rng(0)
+    points = rng.normal(size=(n, 3)) * 3.0
+    q = rng.normal(size=(n, 3)) * 3.0
+    v2f = jnp.asarray(rng.permutation(n).astype(np.int32))
+
+    @jax.jit
+    def assign(q, points, v2f):
+        q_form = permutil.veh_to_formation_order(q, v2f)
+        paligned = geometry.align(points, q_form, d=2)
+        res = auction.auction_lap(-geometry.cdist(q, paligned))
+        return res.row_to_col
+
+    qd = jnp.asarray(q, jnp.float32)
+    pd = jnp.asarray(points, jnp.float32)
+    out = assign(qd, pd, v2f)
+    jax.block_until_ready(out)  # compile + warm
+
+    iters = 200
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = assign(qd, pd, v2f)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    hz = 1.0 / dt
+
+    print(json.dumps({
+        "metric": "central_assignment_n15_hz",
+        "value": round(hz, 1),
+        "unit": "Hz",
+        "vs_baseline": round(hz / BASELINE_HZ, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -216,6 +216,22 @@ class TestColAvoid:
         # heading moved off the direct bearing
         assert abs(math.atan2(float(out[0, 1]), float(out[0, 0]))) > 0.1
 
+    def test_heading_exactly_pi_still_avoided(self):
+        # INTENTIONAL divergence from the reference: its linearized strict
+        # zone test can never flag psi == ±pi (safety.cpp:487-493), letting a
+        # vehicle commanded exactly along -x fly unmodified at an obstacle
+        # dead ahead. The circular formulation must flag and deflect it.
+        p = self._params()
+        q = np.array([[0.0, 0, 1], [-1.0, 0, 1]])   # obstacle at bearing pi
+        vel = np.array([[-0.5, 0.0, 0.0], [0.0, 0.0, 0.0]])  # psi == pi
+        out, mod = control.collision_avoidance(jnp.asarray(q),
+                                               jnp.asarray(vel), p)
+        assert bool(mod[0])
+        # deflected but speed-preserving (an escape edge exists within 90°)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(out)[0, :2]), 0.5, atol=1e-9)
+        assert abs(float(out[0, 1])) > 0.1  # rotated off the -x axis
+
     def test_surrounded_stops(self):
         # agent ringed by close obstacles on all sides => full stop
         p = SafetyParams(d_avoid_thresh=3.0, r_keep_out=1.2)
