@@ -1,0 +1,12 @@
+"""Formation gain design (SURVEY.md §7 layer 3).
+
+- ``admm``      — the TPU-native projection-form ADMM solver (jit/device).
+- ``reference`` — sequential NumPy mirror of the C++ solver, the test oracle
+                  (matches `test_admm.cpp` goldens to machine precision).
+"""
+from aclswarm_tpu.gains.admm import (solve_gains, solve_gains_blocks,
+                                     validate_gains)
+from aclswarm_tpu.gains.reference import AdmmParams
+
+__all__ = ["solve_gains", "solve_gains_blocks", "validate_gains",
+           "AdmmParams"]

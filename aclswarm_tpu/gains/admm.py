@@ -1,0 +1,253 @@
+"""TPU-native ADMM formation gain design (SURVEY.md §7 layer 3).
+
+Same algorithm as the reference's hand-written solver
+(`aclswarm/lib/admm/src/solver.cpp`; MATLAB ground truth
+`ADMMGainDesign3D.m`), re-derived into a *projection form* that is exactly
+equivalent but maps to dense TPU ops instead of sparse-matrix machinery:
+
+The reference assembles a giant sparse constraint matrix **A** over vec(X)
+(rows for X11 = t*I, X12 = I, 2x2 complex-structure, zero-gain, trace,
+symmetry — `solver.cpp:351-694`) and each ADMM iteration solves the normal
+system (A A^T) y = ... with a cached sparse Cholesky (`solver.cpp:264-347`).
+Because y only ever enters through A^T y with a consistent system,
+
+    mat(A^T y) = P_R vec(D) + mu * x_min,
+
+where P_R projects onto the row space and x_min is the min-norm affine
+point. Hence the whole linear-algebra core collapses to the orthogonal
+projection P_N onto the constraint null space — which is *structural*:
+
+    P_N(M) = [[ (tr M11 / dm) I , 0 ],
+              [ 0 , P_V(sym(M22)) ]]
+
+with P_V = projection onto complex-structured symmetric matrices (closed
+form, d=2) minus a rank-K correction for the zero-gain + trace constraints
+(K = d * #non-edges + 1, solved through a tiny K x K Gram system). No sparse
+Cholesky, no constraint matrix — just eigh/matmul on (2dm, 2dm) dense
+matrices, which is exactly what the MXU wants. Equivalence to the
+constraint-matrix form is machine-precision (validated against
+`aclswarm_tpu.gains.reference` and the `test_admm.cpp` golden matrices).
+
+The iteration, stopping criteria, parameters, and the final S=0 projection
+follow `solver.cpp:264-347` exactly, including the keep-all-modes quirk when
+no eigenvalue exceeds epsEig (`solver.cpp:301-308`).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from aclswarm_tpu.gains.reference import AdmmParams
+
+
+def _proj_struct(B: jnp.ndarray, d: int) -> jnp.ndarray:
+    """Project onto symmetric (d=1) or complex-structured symmetric (d=2)
+    matrices: 2x2 blocks [[a, b], [-b, a]] (`solver.cpp:519-561` constraint
+    set, as an orthogonal projection)."""
+    B = (B + B.T) / 2.0
+    if d == 1:
+        return B
+    dm = B.shape[0]
+    m = dm // 2
+    Bb = B.reshape(m, 2, m, 2)
+    a = (Bb[:, 0, :, 0] + Bb[:, 1, :, 1]) / 2.0
+    b = (Bb[:, 0, :, 1] - Bb[:, 1, :, 0]) / 2.0
+    out = jnp.stack([
+        jnp.stack([a, b], axis=-1),
+        jnp.stack([-b, a], axis=-1)], axis=-2)  # (m, m, 2, 2)
+    return jnp.transpose(out, (0, 2, 1, 3)).reshape(dm, dm)
+
+
+def _zero_gain_tensors(Q: jnp.ndarray, nonedges: tuple, d: int,
+                       dm: int) -> jnp.ndarray:
+    """Constraint tensors H (K, dm, dm): one per zero-gain row
+    (`solver.cpp:563-607`: <outer(Q[d*j], Q[d*i+s]), Abar> = 0), projected
+    onto the structured subspace, plus the trace constraint (= I) last."""
+    Hs = []
+    for (i, j) in nonedges:
+        for s in range(d if d == 2 else 1):
+            QQ = jnp.outer(Q[d * j, :], Q[d * i + s, :])
+            Hs.append(_proj_struct(QQ, d))
+    Hs.append(_proj_struct(jnp.eye(dm, dtype=Q.dtype), d))
+    return jnp.stack(Hs)
+
+
+def _subproblem(Q: jnp.ndarray, nonedges: tuple, d: int,
+                params: AdmmParams) -> jnp.ndarray:
+    """Solve one (2D or 1D) gain subproblem; returns the full-space gains
+    -Q Abar Q^T (`solver.cpp:143,207`)."""
+    dtype = Q.dtype
+    dm = Q.shape[1]
+    mu = params.mu
+
+    H = _zero_gain_tensors(Q, nonedges, d, dm)       # (K, dm, dm)
+    c = jnp.zeros((H.shape[0],), dtype).at[-1].set(dm)
+    G = jnp.einsum("kij,lij->kl", H, H, precision="highest")
+    Ginv = jnp.linalg.pinv(G, rtol=1e-12)
+
+    def P_V(B):
+        """Project onto {structured symmetric} ∩ {<H_k, .> = 0}."""
+        B = _proj_struct(B, d)
+        coef = Ginv @ jnp.einsum("kij,ij->k", H, B, precision="highest")
+        return B - jnp.einsum("k,kij->ij", coef, H, precision="highest")
+
+    def P_N(M):
+        """Projection onto the homogeneous constraint null space."""
+        out = jnp.zeros_like(M)
+        t = jnp.trace(M[:dm, :dm]) / dm
+        out = out.at[:dm, :dm].set(t * jnp.eye(dm, dtype=dtype))
+        return out.at[dm:, dm:].set(P_V(M[dm:, dm:]))
+
+    # min-norm affine point: X12 = X21 = I, X22 solving the K constraints
+    B0 = jnp.einsum("k,kij->ij", Ginv @ c, H, precision="highest")
+    Xmin = jnp.zeros((2 * dm, 2 * dm), dtype)
+    Xmin = Xmin.at[:dm, dm:].set(jnp.eye(dm, dtype=dtype))
+    Xmin = Xmin.at[dm:, :dm].set(jnp.eye(dm, dtype=dtype))
+    Xmin = Xmin.at[dm:, dm:].set(B0)
+
+    C = jnp.zeros((2 * dm, 2 * dm), dtype)
+    C = C.at[:dm, :dm].set(jnp.eye(dm, dtype=dtype))
+
+    def W_of(D):
+        """W = C - mat(A^T y) - mu X, in projection form
+        (`solver.cpp:283-297` y-update + W assembly)."""
+        W = P_N(D) - mu * Xmin
+        return (W + W.T) / 2.0
+
+    def psd_part(W):
+        """Keep modes with eigenvalue > epsEig; if none, keep all
+        (`solver.cpp:299-313` incl. the k=0 quirk)."""
+        lam, V = jnp.linalg.eigh(W)
+        keep = lam > params.eps_eig
+        keep = jnp.where(jnp.any(keep), keep, jnp.ones_like(keep))
+        lam_kept = jnp.where(keep, lam, 0.0)
+        return (V * lam_kept[None, :]) @ V.T
+
+    X0 = jnp.tile(jnp.eye(dm, dtype=dtype), (2, 2))
+    S0 = jnp.zeros_like(X0)
+
+    def cond(carry):
+        X, S, it, stop = carry
+        return (~stop) & (it < params.max_itr)
+
+    def body(carry):
+        X, S, it, _ = carry
+        W = W_of(C - S - mu * X) + S
+        Snew = psd_part(W)
+        Xnew = (Snew - W) / mu
+        diffX = jnp.sum(jnp.abs(Xnew - X))
+        tr = jnp.trace(Xnew[dm:, dm:])
+        stop = (diffX < params.thresh) | \
+               ((tr - dm) / dm < params.thresh_tr)   # signed, solver.cpp:328
+        return Xnew, Snew, it + 1, stop
+
+    X, S, _, _ = lax.while_loop(cond, body,
+                                (X0, S0, jnp.asarray(0), jnp.asarray(False)))
+
+    # final projection with S = 0 (`solver.cpp:333-346`)
+    W = W_of(C - mu * X)
+    X22 = (-W / mu)[dm:, dm:]
+    return -(Q @ X22 @ Q.T)
+
+
+def _kernel_2d(pts_xy: jnp.ndarray) -> jnp.ndarray:
+    """Q = orthogonal complement of [q, rot90(q), 1x, 1y]
+    (`solver.cpp:160-188`)."""
+    n = pts_xy.shape[0]
+    q = pts_xy.reshape(-1)
+    qbar = jnp.stack([-pts_xy[:, 1], pts_xy[:, 0]], 1).reshape(-1)
+    ex = jnp.tile(jnp.asarray([1.0, 0.0], q.dtype), n)
+    ey = jnp.tile(jnp.asarray([0.0, 1.0], q.dtype), n)
+    N = jnp.column_stack([q, qbar, ex, ey])
+    U = jnp.linalg.svd(N, full_matrices=True)[0]
+    return U[:, 4:]
+
+
+def _kernel_1d(pts_z: jnp.ndarray, planar: bool) -> jnp.ndarray:
+    """Q = orthogonal complement of [qz, 1] ([qz] if flat)
+    (`solver.cpp:94-124`)."""
+    n = pts_z.shape[0]
+    qz = pts_z.reshape(-1)
+    if planar:
+        N = qz[:, None]
+    else:
+        N = jnp.column_stack([qz, jnp.ones((n,), qz.dtype)])
+    U = jnp.linalg.svd(N, full_matrices=True)[0]
+    return U[:, N.shape[1]:]
+
+
+@partial(jax.jit, static_argnames=("nonedges", "planar", "params"))
+def _solve_jit(points: jnp.ndarray, nonedges: tuple, planar: bool,
+               params: AdmmParams) -> jnp.ndarray:
+    A2d = _subproblem(_kernel_2d(points[:, :2]), nonedges, 2, params)
+    A1d = _subproblem(_kernel_1d(points[:, 2], planar), nonedges, 1, params)
+    n = points.shape[0]
+    out = jnp.zeros((n, 3, n, 3), points.dtype)
+    out = out.at[:, :2, :, :2].set(A2d.reshape(n, 2, n, 2))
+    out = out.at[:, 2, :, 2].set(A1d)
+    # non-edge blocks are *structurally* zero (a vehicle has no gain toward a
+    # non-neighbor); mask them exactly so f32 projection residue (~1e-3 on
+    # TPU) can't leak communication outside the graph. In f64 this changes
+    # nothing beyond the ~1e-12 the final projection already leaves.
+    mask = np.ones((n, n), dtype=bool)
+    for (i, j) in nonedges:
+        mask[i, j] = mask[j, i] = False
+    out = jnp.where(jnp.asarray(mask)[:, None, :, None], out, 0.0)
+    flat = out.reshape(3 * n, 3 * n)
+    # kill numerically-zero entries (`solver.cpp:144,208`)
+    return jnp.where(jnp.abs(flat) > params.thr_sparse_zero, flat, 0.0)
+
+
+def solve_gains(points, adj, params: AdmmParams | None = None) -> jnp.ndarray:
+    """Design (3n, 3n) formation gains on device.
+
+    The adjacency *pattern* and planarity are compile-time (one trace per
+    graph, like the reference's one parse per formation); the points are
+    traced, so re-solving for moved points reuses the compiled program.
+    """
+    params = params or AdmmParams()
+    adj_np = np.asarray(adj)  # the graph is always concrete (host config)
+    n = adj_np.shape[0]
+    nonedges = tuple((i, j) for i in range(n) for j in range(i + 1, n)
+                     if adj_np[i, j] == 0)
+    if isinstance(points, jax.core.Tracer):
+        # under an outer trace the planarity test can't branch on data;
+        # assume non-flat (kernel [qz, 1]), callers with flat formations
+        # should call from host with concrete points
+        planar = False
+    else:
+        planar = bool(np.std(np.asarray(points)[:, 2], ddof=1)
+                      < params.thr_planar)
+    return _solve_jit(jnp.asarray(points), nonedges, planar, params)
+
+
+def solve_gains_blocks(points, adj, params: AdmmParams | None = None
+                       ) -> jnp.ndarray:
+    """Same, in the framework's (n, n, 3, 3) block layout."""
+    from aclswarm_tpu.core.types import gains_from_flat
+    return gains_from_flat(solve_gains(points, adj, params))
+
+
+def validate_gains(A: np.ndarray, points: np.ndarray,
+                   thr_planar: float = 1e-2) -> dict:
+    """Eigenstructure self-check (`aclswarm/src/aclswarm/control.py:221-261`):
+    no positive eigenvalues, nullity 6 (or 5 for flat formations), remaining
+    eigenvalues strictly negative. Returns a dict of booleans + eigenvalues.
+    """
+    A = np.asarray(A)
+    points = np.asarray(points)
+    flat = np.std(points[:, 2]) <= thr_planar
+    nullity = 5 if flat else 6
+    w = np.sort(np.real(np.linalg.eigvals(A)))
+    return {
+        "no_positive": bool(np.all(w < 1e-6)),
+        "kernel_ok": bool(np.linalg.norm(w[len(w) - nullity:]) <= 1e-6),
+        "strictly_negative_rest": bool(
+            np.all(np.real(w[:len(w) - nullity]) < -1e-10)),
+        "nullity": nullity,
+        "eigenvalues": w,
+    }

@@ -1,0 +1,64 @@
+"""Precompute formation gains into a formations.yaml library.
+
+The reference ships precomputed gains inside `formations.yaml` so vehicles
+don't redo the ADMM solve on formation dispatch (`operator.py:186-197`,
+MATLAB `precalc_gains.m`). This tool does the same for the framework's own
+library: every formation in every group gets a `gains` entry designed by the
+on-device ADMM solver, validated against the eigenstructure self-check
+(`aclswarm/src/aclswarm/control.py:221-261`).
+
+Usage:
+    python -m aclswarm_tpu.harness.precalc [--library PATH] [--group NAME]
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import yaml
+
+from aclswarm_tpu import gains as gainslib
+from aclswarm_tpu.harness import formations as formlib
+
+
+def precalc(library_path=None, group: str | None = None,
+            verbose: bool = True) -> None:
+    path = library_path or formlib.DEFAULT_LIBRARY
+    with open(path) as f:
+        lib = yaml.safe_load(f)
+
+    groups = [group] if group else [k for k, v in lib.items()
+                                    if isinstance(v, dict)]
+    for g in groups:
+        specs = formlib.load_group(path, g)
+        for spec, raw in zip(specs, lib[g]["formations"]):
+            A = np.asarray(gainslib.solve_gains(spec.points, spec.adjmat))
+            v = gainslib.validate_gains(A, spec.points)
+            ok = v["no_positive"] and v["kernel_ok"] \
+                and v["strictly_negative_rest"]
+            if verbose:
+                print(f"{g}/{spec.name}: gains {A.shape} "
+                      f"{'OK' if ok else 'EIGENSTRUCTURE FAILED'}")
+            if not ok:
+                raise RuntimeError(
+                    f"gain design failed validation for {g}/{spec.name}: "
+                    f"{v['eigenvalues']}")
+            raw["gains"] = [[round(float(x), 12) for x in row] for row in A]
+
+    with open(path, "w") as f:
+        yaml.safe_dump(lib, f, sort_keys=False, default_flow_style=None,
+                       width=10000)
+    if verbose:
+        print(f"wrote {path}")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--library", default=None, help="formations.yaml path")
+    ap.add_argument("--group", default=None, help="only this group")
+    args = ap.parse_args()
+    precalc(args.library, args.group)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,23 +1,34 @@
-"""Trial supervisor: the reference's experiment oracle, evaluated post-hoc.
+"""Trial supervisor: the reference's experiment oracle, replayed post-hoc.
 
 Spec: `aclswarm_sim/nodes/supervisor.py` — a 50 Hz FSM sampling live topics
-into 1 s ring buffers and applying windowed predicates (SURVEY.md §2.2 P7,
-§4.4). Because the TPU sim records every control tick of the whole rollout
-(`aclswarm_tpu.sim.engine.rollout` metrics), the same predicates are computed
-here *after the fact* over the full time series — same thresholds, same
-window, no FSM races:
+into 1 s ring buffers (SURVEY.md §2.2 P7, §4.4). The TPU sim records every
+control tick of the whole rollout (`aclswarm_tpu.sim.engine.rollout`), so the
+same FSM is *emulated tick-by-tick over the recorded series* — same states,
+same buffer-reset semantics, same thresholds and timeouts:
 
-- convergence: every vehicle's windowed-mean |distcmd| < 1.0 m/s
-  (`supervisor.py:61,297-316`, ORIG_ZERO_VEL_THR over BUFFER_SECONDS=1);
-- gridlock: any vehicle's windowed-mean collision-avoidance-active ratio
-  > 0.95 (`supervisor.py:62,318-337`);
-- metrics row: per-vehicle smoothed planar distance traveled (EWMA
-  alpha=0.98, `supervisor.py:83,452-478`), convergence time, time in
-  avoidance, assignment count (`supervisor.py:404-415` CSV schema).
+- convergence predicate: every vehicle's buffered-mean |distcmd| < 1.0 m/s
+  (`supervisor.py:61,297-316`); buffers empty on state transitions
+  (`supervisor.py:247-249`) except entering IN_FORMATION (reset=False,
+  `supervisor.py:199`);
+- gridlock predicate: any vehicle's buffered-mean CA-active ratio > 0.95
+  (`supervisor.py:62,318-337`); a trial only *terminates* as gridlocked if
+  the GRIDLOCK state persists GRIDLOCK_TIMEOUT=90 s (`supervisor.py:211-215`);
+- the logged `time_avoidance` is the duration of the last GRIDLOCK episode
+  (`supervisor.py:256-265`), NOT per-vehicle avoidance time (kept separately
+  here as `time_in_avoidance_s`);
+- convergence time runs from FLYING entry to leaving IN_FORMATION after
+  CONVERGED_WAIT (`supervisor.py:203-206,397-403` start/stop_logging), so it
+  includes the 1 s confirmation dwell, as the reference's CSV does.
+
+This emulation covers the FLYING / IN_FORMATION / GRIDLOCK / COMPLETE /
+TERMINATE portion of the FSM — the rollout starts with the swarm already
+airborne and assigned (IDLE/TAKING_OFF/HOVERING/WAITING_ON_ASSIGNMENT are
+trial-driver concerns, `aclswarm_tpu.harness.trials`).
 """
 from __future__ import annotations
 
 import dataclasses
+from collections import deque
 from typing import Optional
 
 import numpy as np
@@ -26,15 +37,16 @@ BUFFER_SECONDS = 1.0          # supervisor.py:47
 ORIG_ZERO_VEL_THR = 1.00      # m/s, supervisor.py:61
 AVG_ACTIVE_CA_THR = 0.95      # supervisor.py:62
 EWMA_ALPHA = 0.98             # supervisor.py:83
-ASSIGNMENT_TIMEOUT = 20.0     # s, supervisor.py:53
+FORMATION_RECEIVED_WAIT = 1.0  # s, supervisor.py:54
+CONVERGED_WAIT = 1.0          # s, supervisor.py:55
 GRIDLOCK_TIMEOUT = 90.0       # s, supervisor.py:56
 TRIAL_TIMEOUT = 600.0         # s, supervisor.py:57
 
 
 def rolling_mean(x: np.ndarray, window: int) -> np.ndarray:
     """Rolling mean over the leading (time) axis; row t averages the window
-    *ending* at t. Rows before a full window mirror the reference's "not
-    enough data" answer by returning +inf-safe NaN."""
+    ending at t. Rows before a full window are NaN (the reference's "not
+    enough data" answer)."""
     x = np.asarray(x, dtype=np.float64)
     T = x.shape[0]
     out = np.full_like(x, np.nan, dtype=np.float64)
@@ -48,13 +60,16 @@ def rolling_mean(x: np.ndarray, window: int) -> np.ndarray:
 
 @dataclasses.dataclass
 class TrialResult:
-    """One formation's outcome — the CSV row of `supervisor.py:404-415`."""
+    """One formation's outcome, matching the reference CSV semantics
+    (`supervisor.py:404-415`: trial, dist*, time, time_avoidance,
+    assignments)."""
 
     converged: bool
-    convergence_time_s: Optional[float]   # first tick the predicate held
-    gridlocked: bool                      # gridlock predicate ever held
-    time_in_gridlock_s: float
-    time_in_avoidance_s: np.ndarray       # (n,) per vehicle
+    convergence_time_s: Optional[float]   # FLYING -> out of IN_FORMATION
+    gridlocked: bool                      # ever entered the GRIDLOCK state
+    gridlock_terminated: bool             # GRIDLOCK persisted >= 90 s
+    last_gridlock_episode_s: float        # the CSV's `time_avoidance` column
+    time_in_avoidance_s: np.ndarray       # (n,) per vehicle (extra metric)
     dist_traveled_m: np.ndarray           # (n,) EWMA-smoothed planar distance
     n_reassignments: int
     invalid_auctions: int
@@ -62,8 +77,8 @@ class TrialResult:
     def csv_row(self, trial: int) -> list:
         return ([trial] + self.dist_traveled_m.tolist()
                 + [self.convergence_time_s if self.converged else np.nan]
-                + [float(np.sum(self.time_in_avoidance_s))]
-                + [self.n_reassignments])
+                + [self.last_gridlock_episode_s]
+                + [1 + self.n_reassignments])  # counter starts at 1 on log
 
 
 def distance_traveled(q: np.ndarray, alpha: float = EWMA_ALPHA) -> np.ndarray:
@@ -82,10 +97,114 @@ def distance_traveled(q: np.ndarray, alpha: float = EWMA_ALPHA) -> np.ndarray:
     return dist
 
 
+class _Buffer:
+    """A predicate ring buffer: appended only when its predicate is invoked,
+    cleared on (most) state transitions — `supervisor.py:297-346`."""
+
+    def __init__(self, window: int):
+        self.window = window
+        self.buf: deque = deque(maxlen=window)
+
+    def push(self, sample):
+        self.buf.append(sample)
+
+    @property
+    def full(self) -> bool:
+        return len(self.buf) == self.window
+
+    def mean(self) -> np.ndarray:
+        return np.mean(np.asarray(self.buf), axis=0)
+
+
+# FSM states (subset relevant post-takeoff, supervisor.py:19-28)
+FLYING, IN_FORMATION, GRIDLOCK, COMPLETE, TERMINATE = range(5)
+
+
+def run_fsm(distcmd_norm: np.ndarray, ca_active: np.ndarray, dt: float):
+    """Emulate the supervisor FSM over a recorded rollout (single formation).
+
+    Returns (converged, convergence_time_s, entered_gridlock,
+    gridlock_terminated, last_gridlock_episode_s).
+    """
+    distcmd_norm = np.asarray(distcmd_norm)
+    ca_active = np.asarray(ca_active, dtype=np.float64)
+    T = distcmd_norm.shape[0]
+    window = max(1, int(round(BUFFER_SECONDS / dt)))
+
+    state = FLYING
+    ticks_in_state = -1          # next_state resets to -1, ++ at tick top
+    conv = _Buffer(window)
+    grid = _Buffer(window)
+    log_start_t = 0
+    conv_time = None
+    entered_gridlock = False
+    terminated = False
+    grid_enter_t = None
+    last_episode = 0.0
+
+    def elapsed(secs):
+        return ticks_in_state * dt >= secs
+
+    def has_converged(t):
+        conv.push(distcmd_norm[t])
+        return conv.full and bool(np.all(conv.mean() < ORIG_ZERO_VEL_THR))
+
+    def has_gridlocked(t):
+        grid.push(ca_active[t])
+        return grid.full and bool(np.any(grid.mean() > AVG_ACTIVE_CA_THR))
+
+    def next_state(new, t, reset=True):
+        nonlocal state, ticks_in_state, conv, grid, grid_enter_t, \
+            last_episode, entered_gridlock
+        if new == GRIDLOCK:
+            grid_enter_t = t
+            entered_gridlock = True
+        if state == GRIDLOCK and grid_enter_t is not None:
+            last_episode = (t - grid_enter_t) * dt
+            grid_enter_t = None
+        state = new
+        ticks_in_state = -1
+        if reset:
+            conv = _Buffer(window)
+            grid = _Buffer(window)
+
+    for t in range(T):
+        ticks_in_state += 1
+        if state == FLYING:
+            if elapsed(FORMATION_RECEIVED_WAIT):
+                if has_converged(t):
+                    next_state(IN_FORMATION, t, reset=False)
+                elif has_gridlocked(t):
+                    next_state(GRIDLOCK, t)
+        elif state == IN_FORMATION:
+            if elapsed(CONVERGED_WAIT):
+                conv_time = (t - log_start_t) * dt   # stop_logging
+                next_state(COMPLETE, t)
+                break
+            elif not has_converged(t):
+                next_state(FLYING, t)
+        elif state == GRIDLOCK:
+            # has_left_gridlock: full buffer and predicate false
+            left = (not has_gridlocked(t)) and grid.full
+            if left:
+                next_state(FLYING, t)
+            elif elapsed(GRIDLOCK_TIMEOUT):
+                terminated = True
+                next_state(TERMINATE, t)
+                break
+        if t * dt > TRIAL_TIMEOUT:                   # watchdog
+            terminated = True
+            next_state(TERMINATE, t)
+            break
+
+    return (state == COMPLETE, conv_time, entered_gridlock, terminated,
+            last_episode)
+
+
 def evaluate(distcmd_norm: np.ndarray, ca_active: np.ndarray,
              q: np.ndarray, reassigned: np.ndarray,
              assign_valid: np.ndarray, dt: float) -> TrialResult:
-    """Apply the supervisor predicates to a recorded rollout.
+    """Apply the supervisor oracle to a recorded rollout.
 
     Args (time-major, from `rollout` metrics, moved to host):
       distcmd_norm: (T, n) per-tick |distcmd|.
@@ -94,28 +213,16 @@ def evaluate(distcmd_norm: np.ndarray, ca_active: np.ndarray,
       reassigned / assign_valid: (T,) assignment events.
       dt: control tick period (s).
     """
-    distcmd_norm = np.asarray(distcmd_norm)
-    ca_active = np.asarray(ca_active, dtype=np.float64)
-    window = max(1, int(round(BUFFER_SECONDS / dt)))
-
-    # convergence: windowed per-vehicle mean speed all below threshold
-    avg_mag = rolling_mean(distcmd_norm, window)          # (T, n)
-    conv_t = np.all(avg_mag < ORIG_ZERO_VEL_THR, axis=1)  # NaN -> False
-    converged = bool(conv_t.any())
-    conv_time = float(np.argmax(conv_t) * dt) if converged else None
-
-    # gridlock: windowed per-vehicle CA-active ratio, any above threshold
-    avg_ca = rolling_mean(ca_active, window)
-    grid_t = np.nan_to_num(avg_ca, nan=0.0) > AVG_ACTIVE_CA_THR
-    grid_any = grid_t.any(axis=1)
-    gridlocked = bool(grid_any.any())
-
+    converged, conv_time, entered, terminated, last_ep = run_fsm(
+        distcmd_norm, ca_active, dt)
+    ca = np.asarray(ca_active, dtype=np.float64)
     return TrialResult(
         converged=converged,
         convergence_time_s=conv_time,
-        gridlocked=gridlocked,
-        time_in_gridlock_s=float(np.sum(grid_any) * dt),
-        time_in_avoidance_s=np.sum(ca_active, axis=0) * dt,
+        gridlocked=entered,
+        gridlock_terminated=terminated,
+        last_gridlock_episode_s=last_ep,
+        time_in_avoidance_s=np.sum(ca, axis=0) * dt,
         dist_traveled_m=distance_traveled(q),
         n_reassignments=int(np.sum(np.asarray(reassigned))),
         invalid_auctions=int(np.sum(~np.asarray(assign_valid))),

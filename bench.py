@@ -42,11 +42,12 @@ def main():
     out = assign(qd, pd, v2f)
     jax.block_until_ready(out)  # compile + warm
 
+    # block every call: the baseline is a *latency* figure, so measure
+    # latency, not pipelined dispatch throughput
     iters = 200
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = assign(qd, pd, v2f)
-    jax.block_until_ready(out)
+        jax.block_until_ready(assign(qd, pd, v2f))
     dt = (time.perf_counter() - t0) / iters
     hz = 1.0 / dt
 

@@ -1,0 +1,208 @@
+"""Gain-design tests: golden parity + algebraic invariants + closed loop.
+
+Mirrors the reference's own ADMM test suite (`aclswarm/test/test_admm.cpp`):
+exact golden matrices for n=4 (tol 1e-8), zero-block and structure checks for
+n=9, trace invariants for n=20 — plus the eigenstructure validation the
+Python gain designer applies (`aclswarm/src/aclswarm/control.py:221-261`) and
+an end-to-end check that self-designed gains fly the closed-loop sim.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aclswarm_tpu import gains as gainslib
+from aclswarm_tpu.gains import admm, reference
+
+GOLD_SQUARE_PTS = np.array([[0.0, 0.0, 2.5], [2.0, 0.0, 3.5],
+                            [2.0, 2.0, 4.5], [0.0, 2.0, 1.5]])
+
+# `test_admm.cpp:26-37`: MATLAB golden gains, 4-agent square, complete graph
+GOLD_FC = np.array([
+    [-0.50, 0, 0, 0.25, 0.25, 0, 0, 0, 0, 0.25, -0.25, 0],
+    [0, -0.50, 0, -0.25, 0.25, 0, 0, 0, 0, 0.25, 0.25, 0],
+    [0, 0, -0.70, 0, 0, 0.20, 0, 0, 0.10, 0, 0, 0.40],
+    [0.25, -0.25, 0, -0.50, 0, 0, 0.25, 0.25, 0, 0, 0, 0],
+    [0.25, 0.25, 0, 0, -0.50, 0, -0.25, 0.25, 0, 0, 0, 0],
+    [0, 0, 0.20, 0, 0, -0.70, 0, 0, 0.40, 0, 0, 0.10],
+    [0, 0, 0, 0.25, -0.25, 0, -0.50, 0, 0, 0.25, 0.25, 0],
+    [0, 0, 0, 0.25, 0.25, 0, 0, -0.50, 0, -0.25, 0.25, 0],
+    [0, 0, 0.10, 0, 0, 0.40, 0, 0, -0.30, 0, 0, -0.20],
+    [0.25, 0.25, 0, 0, 0, 0, 0.25, -0.25, 0, -0.50, 0, 0],
+    [-0.25, 0.25, 0, 0, 0, 0, 0.25, 0.25, 0, 0, -0.50, 0],
+    [0, 0, 0.40, 0, 0, 0.10, 0, 0, -0.20, 0, 0, -0.30]])
+
+# `test_admm.cpp:64-75`: golden gains, same square, edges (0,2),(1,3) removed
+GOLD_NC = np.array([
+    [-0.500, 0, 0, 0.250, 0.250, 0, 0, 0, 0, 0.250, -0.250, 0],
+    [0, -0.500, 0, -0.250, 0.250, 0, 0, 0, 0, 0.250, 0.250, 0],
+    [0, 0, -0.750, 0, 0, 0.375, 0, 0, 0, 0, 0, 0.375],
+    [0.250, -0.250, 0, -0.500, 0, 0, 0.250, 0.250, 0, 0, 0, 0],
+    [0.250, 0.250, 0, 0, -0.500, 0, -0.250, 0.250, 0, 0, 0, 0],
+    [0, 0, 0.375, 0, 0, -0.750, 0, 0, 0.375, 0, 0, 0],
+    [0, 0, 0, 0.250, -0.250, 0, -0.500, 0, 0, 0.250, 0.250, 0],
+    [0, 0, 0, 0.250, 0.250, 0, 0, -0.500, 0, -0.250, 0.250, 0],
+    [0, 0, 0, 0, 0, 0.375, 0, 0, -0.250, 0, 0, -0.125],
+    [0.250, 0.250, 0, 0, 0, 0, 0.250, -0.250, 0, -0.500, 0, 0],
+    [-0.250, 0.250, 0, 0, 0, 0, 0.250, 0.250, 0, 0, -0.500, 0],
+    [0, 0, 0.375, 0, 0, 0, 0, 0, -0.125, 0, 0, -0.250]])
+
+
+def fc_adj(n):
+    return np.ones((n, n)) - np.eye(n)
+
+
+def nine_agent_case():
+    """`test_admm.cpp:84-152`: 9 agents, 5 removed edges, fixed points."""
+    adj = fc_adj(9)
+    for i, j in [(0, 6), (2, 4), (5, 7), (5, 8), (6, 7)]:
+        adj[i, j] = adj[j, i] = 0
+    p = np.array([
+        [-1.7484733199059646, 1.7306756147165174, 0.2977622220453062],
+        [6.8174866001631180, -6.2778267151168700, 1.7416024649609380],
+        [-3.8137004331127518, -2.3232057308608365, 0.4655014204423282],
+        [2.7536551200474015, -5.5700708736518450, 1.7252000594155040],
+        [-3.5935365621834463, 4.8028457222331170, 1.2981050175550286],
+        [-2.5820075847777666, 7.4136205487374910, 1.5131454738258028],
+        [0.8900655441583734, 3.2902893860285527, 1.5581930129432586],
+        [0.4370445360276376, -5.7714142992744755, 0.2531727259898202],
+        [-6.1065377928157310, -5.7852241311701940, 1.7663507973073431]])
+    return p, adj
+
+
+class TestOracleGoldenParity:
+    """NumPy mirror vs the committed MATLAB goldens (tol 1e-8)."""
+
+    def test_four_agent_fc(self):
+        A = reference.solve_gains(GOLD_SQUARE_PTS, fc_adj(4))
+        assert np.linalg.norm(A - GOLD_FC) < 1e-8
+
+    def test_four_agent_noncomplete(self):
+        adj = fc_adj(4)
+        adj[0, 2] = adj[2, 0] = 0
+        adj[1, 3] = adj[3, 1] = 0
+        A = reference.solve_gains(GOLD_SQUARE_PTS, adj)
+        assert np.linalg.norm(A - GOLD_NC) < 1e-8
+
+
+class TestDeviceSolverGoldenParity:
+    """Projection-form device solver vs the same goldens and the oracle."""
+
+    def test_four_agent_fc(self):
+        A = np.asarray(gainslib.solve_gains(GOLD_SQUARE_PTS, fc_adj(4)))
+        assert np.linalg.norm(A - GOLD_FC) < 1e-8
+
+    def test_four_agent_noncomplete(self):
+        adj = fc_adj(4)
+        adj[0, 2] = adj[2, 0] = 0
+        adj[1, 3] = adj[3, 1] = 0
+        A = np.asarray(gainslib.solve_gains(GOLD_SQUARE_PTS, adj))
+        assert np.linalg.norm(A - GOLD_NC) < 1e-8
+
+    def test_matches_oracle_nine_agents(self):
+        p, adj = nine_agent_case()
+        A_dev = np.asarray(gainslib.solve_gains(p, adj))
+        A_ref = reference.solve_gains(p, adj)
+        np.testing.assert_allclose(A_dev, A_ref, atol=1e-9)
+
+    def test_matches_oracle_random_sparse(self):
+        rng = np.random.default_rng(7)
+        n = 12
+        adj = fc_adj(n)
+        # knock out a handful of edges, keep graph dense enough for rigidity
+        for _ in range(6):
+            i, j = rng.integers(0, n, 2)
+            if i != j:
+                adj[i, j] = adj[j, i] = 0
+        p = rng.normal(size=(n, 3)) * 4
+        A_dev = np.asarray(gainslib.solve_gains(p, adj))
+        A_ref = reference.solve_gains(p, adj)
+        np.testing.assert_allclose(A_dev, A_ref, atol=1e-9)
+
+
+class TestInvariants:
+    """`test_admm.cpp:84-227` structural/trace checks on the device solver."""
+
+    def test_zero_blocks(self):
+        p, adj = nine_agent_case()
+        A = np.asarray(gainslib.solve_gains(p, adj))
+        for i in range(9):
+            for j in range(9):
+                if i != j and adj[i, j] == 0:
+                    blk = A[3 * i:3 * i + 3, 3 * j:3 * j + 3]
+                    np.testing.assert_allclose(blk, 0.0, atol=1e-8)
+
+    def test_block_structure(self):
+        p, adj = nine_agent_case()
+        A = np.asarray(gainslib.solve_gains(p, adj))
+        for i in range(9):
+            for j in range(9):
+                blk = A[3 * i:3 * i + 3, 3 * j:3 * j + 3]
+                # [a b 0; -b a 0; 0 0 c]
+                assert abs(blk[0, 0] - blk[1, 1]) < 1e-8
+                assert abs(blk[1, 0] + blk[0, 1]) < 1e-8
+                for r, c in [(0, 2), (2, 0), (1, 2), (2, 1)]:
+                    assert abs(blk[r, c]) < 1e-8
+
+    @pytest.mark.parametrize("sparse", [False, True])
+    def test_fixed_trace_n20(self, sparse):
+        rng = np.random.default_rng(20 + sparse)
+        n = 20
+        adj = fc_adj(n)
+        if sparse:
+            adj[0, 5] = adj[5, 0] = 0
+            adj[3, 15] = adj[15, 3] = 0
+        p = rng.uniform(-5, 5, size=(n, 3))
+        A = np.asarray(gainslib.solve_gains(p, adj))
+        assert abs(np.trace(A) - (-3 * (n - 2))) < 1e-8
+
+    def test_eigenstructure(self):
+        # non-flat formation: nullity 6, no positive eigs, rest negative
+        p, adj = nine_agent_case()
+        A = np.asarray(gainslib.solve_gains(p, adj))
+        v = gainslib.validate_gains(A, p)
+        assert v["no_positive"], v["eigenvalues"]
+        assert v["kernel_ok"], v["eigenvalues"]
+        assert v["strictly_negative_rest"], v["eigenvalues"]
+
+    def test_planar_formation_nullity5(self):
+        rng = np.random.default_rng(3)
+        n = 6
+        p = np.column_stack([rng.normal(size=(n, 2)) * 3, np.full(n, 1.5)])
+        A = np.asarray(gainslib.solve_gains(p, fc_adj(n)))
+        v = gainslib.validate_gains(A, p)
+        assert v["nullity"] == 5
+        assert v["no_positive"] and v["kernel_ok"]
+
+    def test_desired_formation_in_kernel(self):
+        # A @ vec-stacked formation coordinates must vanish: the formation
+        # (and its rigid motions) are equilibria of the linear term
+        p, adj = nine_agent_case()
+        A = np.asarray(gainslib.solve_gains(p, adj))
+        qvec = p.reshape(-1)  # [x0 y0 z0 x1 ...] matches 3x3 block layout
+        np.testing.assert_allclose(A @ qvec, 0.0, atol=1e-7)
+
+
+class TestClosedLoopWithDesignedGains:
+    def test_swarm6_pyramid_flies(self):
+        """End of the gain-design story: our own gains fly the demo."""
+        import jax
+        from aclswarm_tpu import harness, sim
+        from aclswarm_tpu.core.types import ControlGains
+        from aclswarm_tpu.harness import supervisor
+        from tests.test_sim import room_params, spread_start, shape_error
+
+        spec = harness.load_formation("Pentagonal Pyramid",
+                                      group="swarm6_3d")
+        f = spec.to_device(gains=np.asarray(
+            gainslib.solve_gains(spec.points, spec.adjmat)))
+        st = sim.init_state(spread_start(6, 11))
+        cfg = sim.SimConfig(assignment="auction")
+        final, m = sim.rollout(st, f, ControlGains(), room_params(), cfg,
+                               4500)
+        res = supervisor.evaluate(
+            np.asarray(m.distcmd_norm), np.asarray(m.ca_active),
+            np.asarray(m.q), np.asarray(m.reassigned),
+            np.asarray(m.assign_valid), cfg.control_dt)
+        assert res.converged, res
+        err = shape_error(final.swarm.q, spec.points, final.v2f)
+        assert err < 0.35, err

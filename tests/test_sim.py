@@ -174,30 +174,54 @@ class TestSupervisor:
         np.testing.assert_allclose(rm[2, 0], 1.0)
         np.testing.assert_allclose(rm[9, 0], 8.0)
 
-    def test_convergence_needs_full_window(self):
-        # command drops below threshold instantly, but predicate must wait
-        # out the 1 s buffer (supervisor.py "not enough data" semantics)
-        T, n, dt = 150, 3, 0.01
+    def test_convergence_fsm_timing(self):
+        # quiet command from the start: the FSM spends 1 s in FLYING before
+        # predicates run (FORMATION_RECEIVED_WAIT), 1 s filling the buffer,
+        # then 1 s confirming IN_FORMATION (CONVERGED_WAIT) — so the logged
+        # convergence time is ~3 s, dwell included, as in the reference CSV
+        T, n, dt = 400, 3, 0.01
         cmd = np.zeros((T, n))
-        cmd[:40] = 5.0
         res = supervisor.evaluate(
             cmd, np.zeros((T, n)), np.zeros((T, n, 3)),
             np.zeros(T, bool), np.ones(T, bool), dt)
         assert res.converged
-        # windowed mean < 1 first holds once 4/5 of the window is quiet:
-        # window=100, need mean<1 => >= 80 quiet ticks after the 40 loud ones
-        assert res.convergence_time_s == pytest.approx(1.19, abs=0.03)
+        assert res.convergence_time_s == pytest.approx(3.0, abs=0.05)
 
-    def test_gridlock_detection(self):
-        T, n, dt = 300, 2, 0.01
+    def test_unconverged_when_loud(self):
+        T, n, dt = 400, 3, 0.01
+        res = supervisor.evaluate(
+            np.full((T, n), 5.0), np.zeros((T, n)), np.zeros((T, n, 3)),
+            np.zeros(T, bool), np.ones(T, bool), dt)
+        assert not res.converged
+        assert res.convergence_time_s is None
+
+    def test_gridlock_episode_and_recovery(self):
+        T, n, dt = 600, 2, 0.01
         ca = np.zeros((T, n))
         ca[100:250, 1] = 1.0  # vehicle 1 stuck in avoidance 1.5 s
         res = supervisor.evaluate(
             np.ones((T, n)) * 5.0, ca, np.zeros((T, n, 3)),
             np.zeros(T, bool), np.ones(T, bool), dt)
+        # entered GRIDLOCK but recovered (no 90 s persistence)
         assert res.gridlocked
-        assert res.time_in_gridlock_s > 0.3
+        assert not res.gridlock_terminated
+        assert not res.converged  # command stays loud
+        # episode: enters when the 1 s buffer fills with CA-active (t≈2.0 s),
+        # leaves once the fresh in-state buffer reads clear (t≈3.0 s)
+        assert res.last_gridlock_episode_s == pytest.approx(1.0, abs=0.1)
         np.testing.assert_allclose(res.time_in_avoidance_s, [0.0, 1.5])
+
+    def test_gridlock_termination_after_90s(self):
+        dt = 0.01
+        T = int(100.0 / dt)
+        n = 2
+        ca = np.zeros((T, n))
+        ca[100:, 0] = 1.0  # vehicle 0 in avoidance forever
+        res = supervisor.evaluate(
+            np.ones((T, n)) * 5.0, ca, np.zeros((T, n, 3)),
+            np.zeros(T, bool), np.ones(T, bool), dt)
+        assert res.gridlocked and res.gridlock_terminated
+        assert not res.converged
 
     def test_distance_traveled_suppresses_jitter(self):
         rng = np.random.default_rng(0)
