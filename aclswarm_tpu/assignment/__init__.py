@@ -15,14 +15,17 @@ from aclswarm_tpu.assignment.auction import (AuctionResult, assign_min_dist,
 from aclswarm_tpu.assignment.cbaa import (CBAAResult, bid_prices, cbaa_assign,
                                           cbaa_from_state)
 from aclswarm_tpu.assignment.lapjv import lapjv, solve_assignment_host
-from aclswarm_tpu.assignment.sinkhorn import (SinkhornResult,
+from aclswarm_tpu.assignment.sinkhorn import (SinkhornResult, round_dominant,
+                                              round_parallel,
                                               round_to_permutation,
-                                              sinkhorn_assign, sinkhorn_log)
+                                              sinkhorn_assign, sinkhorn_log,
+                                              two_opt_refine)
 
 __all__ = [
     "auction_lap", "assign_min_dist", "AuctionResult",
     "cbaa_assign", "cbaa_from_state", "bid_prices", "CBAAResult",
     "lapjv", "solve_assignment_host",
     "sinkhorn_assign", "sinkhorn_log", "round_to_permutation",
+    "round_parallel", "round_dominant", "two_opt_refine",
     "SinkhornResult",
 ]

@@ -119,7 +119,9 @@ def _one_agent(qij_xy: jnp.ndarray, active: jnp.ndarray, vel: jnp.ndarray,
 
 
 def collision_avoidance(q: jnp.ndarray, vel_des: jnp.ndarray,
-                        params: SafetyParams) -> tuple[jnp.ndarray, jnp.ndarray]:
+                        params: SafetyParams,
+                        max_neighbors: int | None = None
+                        ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Batched velocity-obstacle shim for the whole swarm.
 
     Args:
@@ -127,6 +129,17 @@ def collision_avoidance(q: jnp.ndarray, vel_des: jnp.ndarray,
          vehicle space, `safety.cpp:419-424`).
       vel_des: (n, 3) desired velocity goals.
       params: safety parameters (``d_avoid_thresh``, ``r_keep_out``).
+      max_neighbors: consider only the k nearest vehicles per agent. The
+        per-agent edge-coverage test is O(k^2), so the swarm-wide cost is
+        O(n * k^2) instead of O(n^3) — at n=1000 the dense form materializes
+        a 2e9-element tensor. EXACT whenever an agent has <= k vehicles
+        within ``d_avoid_thresh`` (out-of-range vehicles contribute no
+        sector). With MORE than k in range, farther in-range vehicles are
+        silently ignored — including one directly in the flight path — so k
+        must be sized so that > k vehicles inside ``d_avoid_thresh`` implies
+        an already-collapsed packing (e.g. k >= the max number of
+        ``r_keep_out`` discs that fit in the threshold circle). `None` =
+        dense (all n-1), the small-swarm default.
 
     Returns:
       ((n, 3) safe velocities, (n,) bool modified/avoidance-active flags).
@@ -135,6 +148,16 @@ def collision_avoidance(q: jnp.ndarray, vel_des: jnp.ndarray,
     qij = q[None, :, :] - q[:, None, :]           # (i, j, 3): j relative to i
     dxy = jnp.linalg.norm(qij[..., :2], axis=-1)
     active = (dxy <= params.d_avoid_thresh) & ~jnp.eye(n, dtype=bool)
+
+    if max_neighbors is not None and max_neighbors < n - 1:
+        k = max_neighbors
+        # k nearest others (self excluded via +inf)
+        d_masked = jnp.where(jnp.eye(n, dtype=bool), jnp.inf, dxy)
+        _, idx = jax.lax.top_k(-d_masked, k)                  # (n, k)
+        qij_k = jnp.take_along_axis(qij[..., :2], idx[:, :, None], axis=1)
+        active_k = jnp.take_along_axis(active, idx, axis=1)   # (n, k)
+        return jax.vmap(_one_agent, in_axes=(0, 0, 0, None))(
+            qij_k, active_k, vel_des, params)
 
     return jax.vmap(_one_agent, in_axes=(0, 0, 0, None))(
         qij[..., :2], active, vel_des, params)

@@ -68,6 +68,7 @@ class TrialResult:
     convergence_time_s: Optional[float]   # FLYING -> out of IN_FORMATION
     gridlocked: bool                      # ever entered the GRIDLOCK state
     gridlock_terminated: bool             # GRIDLOCK persisted >= 90 s
+    timed_out: bool                       # trial watchdog (600 s) fired
     last_gridlock_episode_s: float        # the CSV's `time_avoidance` column
     time_in_avoidance_s: np.ndarray       # (n,) per vehicle (extra metric)
     dist_traveled_m: np.ndarray           # (n,) EWMA-smoothed planar distance
@@ -138,7 +139,8 @@ def run_fsm(distcmd_norm: np.ndarray, ca_active: np.ndarray, dt: float):
     log_start_t = 0
     conv_time = None
     entered_gridlock = False
-    terminated = False
+    grid_terminated = False
+    timed_out = False
     grid_enter_t = None
     last_episode = 0.0
 
@@ -189,16 +191,21 @@ def run_fsm(distcmd_norm: np.ndarray, ca_active: np.ndarray, dt: float):
             if left:
                 next_state(FLYING, t)
             elif elapsed(GRIDLOCK_TIMEOUT):
-                terminated = True
+                grid_terminated = True
                 next_state(TERMINATE, t)
                 break
         if t * dt > TRIAL_TIMEOUT:                   # watchdog
-            terminated = True
+            timed_out = True
             next_state(TERMINATE, t)
             break
 
-    return (state == COMPLETE, conv_time, entered_gridlock, terminated,
-            last_episode)
+    # recording ended mid-gridlock: close the open episode so the CSV's
+    # time_avoidance column reflects it
+    if state == GRIDLOCK and grid_enter_t is not None:
+        last_episode = (T - 1 - grid_enter_t) * dt
+
+    return (state == COMPLETE, conv_time, entered_gridlock,
+            grid_terminated, timed_out, last_episode)
 
 
 def evaluate(distcmd_norm: np.ndarray, ca_active: np.ndarray,
@@ -213,14 +220,15 @@ def evaluate(distcmd_norm: np.ndarray, ca_active: np.ndarray,
       reassigned / assign_valid: (T,) assignment events.
       dt: control tick period (s).
     """
-    converged, conv_time, entered, terminated, last_ep = run_fsm(
+    converged, conv_time, entered, grid_term, timed_out, last_ep = run_fsm(
         distcmd_norm, ca_active, dt)
     ca = np.asarray(ca_active, dtype=np.float64)
     return TrialResult(
         converged=converged,
         convergence_time_s=conv_time,
         gridlocked=entered,
-        gridlock_terminated=terminated,
+        gridlock_terminated=grid_term,
+        timed_out=timed_out,
         last_gridlock_episode_s=last_ep,
         time_in_avoidance_s=np.sum(ca, axis=0) * dt,
         dist_traveled_m=distance_traveled(q),

@@ -1,0 +1,83 @@
+"""Device mesh + sharding layout for the agent axis.
+
+The reference scales by replicating the whole 3-node vehicle stack once per
+vehicle as OS processes wired over TCPROS (SURVEY.md §2.5). The TPU-native
+scaling axis is the same — agents — but realized as array sharding: every
+per-agent quantity (rows of q/vel, goal state, assignment, per-agent gain
+row-blocks) is sharded over a 1-D ``agents`` mesh axis, and every pairwise
+interaction (control einsum, velocity-obstacle masks, auction bids) becomes
+an XLA collective over ICI inserted by GSPMD. The "flooding" of position
+estimates (`localization_ros.cpp:152-185`) is literally an all-gather of the
+``q`` shards; bid max-consensus is a cross-shard max-reduce.
+
+Multi-host: the same `Mesh` spans hosts under `jax.distributed` — the layout
+below needs no change; DCN-vs-ICI placement is the runtime's concern.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from aclswarm_tpu import control, sim
+from aclswarm_tpu.core.types import Formation, SwarmState
+
+AGENT_AXIS = "agents"
+
+
+def make_mesh(n_devices: int | None = None,
+              n_agents: int | None = None) -> Mesh:
+    """A 1-D mesh over the agent axis (all devices by default).
+
+    XLA's jit sharding annotations require the sharded dimension to divide
+    evenly across the mesh, so when ``n_agents`` is given the mesh takes the
+    *largest* device count that divides it — whole agents per device, the
+    sharded analogue of the reference placing whole vehicle stacks per
+    process (`start.sh:141-160`).
+    """
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    if n_agents is not None:
+        k = len(devs)
+        while k > 1 and n_agents % k != 0:
+            k -= 1
+        devs = devs[:k]
+    return Mesh(np.asarray(devs), axis_names=(AGENT_AXIS,))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading axis = agents, sharded."""
+    return NamedSharding(mesh, P(AGENT_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def sim_state_sharding(mesh: Mesh) -> sim.SimState:
+    """Sharding pytree for `sim.SimState`: per-agent leaves row-sharded."""
+    row = row_sharding(mesh)
+    rep = replicated(mesh)
+    return sim.SimState(
+        swarm=SwarmState(q=row, vel=row),
+        goal=control.TrajGoal(pos=row, vel=row, yaw=row, dyaw=row),
+        v2f=row, tick=rep)
+
+
+def formation_sharding(mesh: Mesh) -> Formation:
+    """Sharding pytree for `Formation`: the O(n^2) tensors (gains, dstar,
+    adjmat) shard on their first (formation-point) axis; points replicate
+    (n x 3 is tiny and every agent's alignment needs all of it)."""
+    row = row_sharding(mesh)
+    rep = replicated(mesh)
+    return Formation(points=rep, adjmat=row, gains=row,
+                     dstar_xy=row, dstar_z=row)
+
+
+def shard_problem(state: sim.SimState, formation, mesh: Mesh):
+    """Place a sim state + formation onto the mesh with the standard layout."""
+    st_sh = sim_state_sharding(mesh)
+    f_sh = formation_sharding(mesh)
+    return (jax.device_put(state, st_sh), jax.device_put(formation, f_sh),
+            st_sh, f_sh)

@@ -1,0 +1,42 @@
+"""Sharded closed-loop rollout: the whole swarm step distributed over a mesh.
+
+GSPMD propagates the agent-axis shardings declared in `mesh.py` through the
+entire step — the control einsum contracts a row-sharded gain block against a
+gathered q, the velocity-obstacle pair grid partitions by rows, the auction's
+bid/accept rounds reduce across shards — so the program the reference runs as
+n OS processes + TCPROS becomes one SPMD program with ICI collectives
+(SURVEY.md §2.5, §5.8).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+from aclswarm_tpu import sim
+from aclswarm_tpu.parallel import mesh as meshlib
+
+
+def sharded_step_fn(mesh, formation_sharded, gains, sparams, cfg):
+    """Build a jitted, mesh-sharded single-tick function state -> state."""
+    st_sh = meshlib.sim_state_sharding(mesh)
+
+    @partial(jax.jit, in_shardings=(st_sh,),
+             out_shardings=(st_sh, meshlib.replicated(mesh)))
+    def step(state):
+        return sim.step(state, formation_sharded, gains, sparams, cfg)
+
+    return step
+
+
+def sharded_rollout_fn(mesh, formation_sharded, gains, sparams, cfg,
+                       n_ticks: int):
+    """Build a jitted, mesh-sharded rollout (lax.scan of the sharded step)."""
+    st_sh = meshlib.sim_state_sharding(mesh)
+
+    @partial(jax.jit, in_shardings=(st_sh,), static_argnums=())
+    def roll(state):
+        return sim.rollout(state, formation_sharded, gains, sparams, cfg,
+                           n_ticks)
+
+    return roll

@@ -34,7 +34,7 @@ from flax import struct
 from jax import lax
 
 from aclswarm_tpu import control
-from aclswarm_tpu.assignment import auction, cbaa
+from aclswarm_tpu.assignment import auction, cbaa, sinkhorn
 from aclswarm_tpu.core import geometry
 from aclswarm_tpu.core import perm as permutil
 from aclswarm_tpu.core.types import (ControlGains, Formation, SafetyParams,
@@ -49,12 +49,17 @@ class SimConfig:
     # auto-auction period in control ticks: 1.2 s / 0.01 s
     # (`coordination.launch:23`)
     assign_every: int = struct.field(pytree_node=False, default=120)
-    # 'auction' (centralized exact, operator.py:221-246 semantics), 'cbaa'
+    # 'auction' (centralized exact, operator.py:221-246 semantics),
+    # 'sinkhorn' (entropic-OT fast path, the n>=100 scale mode), 'cbaa'
     # (decentralized consensus parity mode), or 'none' (hold assignment)
     assignment: str = struct.field(pytree_node=False, default="auction")
     dynamics: str = struct.field(pytree_node=False, default="tracking")
     tau: float = struct.field(pytree_node=False, default=0.15)
     use_colavoid: bool = struct.field(pytree_node=False, default=True)
+    # top-k neighbor pruning for collision avoidance (None = dense); see
+    # `control.collision_avoidance` — exact for <= k in-range neighbors
+    colavoid_neighbors: int | None = struct.field(pytree_node=False,
+                                                  default=None)
 
 
 @struct.dataclass
@@ -107,6 +112,11 @@ def _assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
         res = auction.auction_lap(-geometry.cdist(swarm.q, paligned))
         new_v2f = jnp.where(res.valid, res.row_to_col, v2f)
         return new_v2f, res.valid
+    elif cfg.assignment == "sinkhorn":
+        q_form = permutil.veh_to_formation_order(swarm.q, v2f)
+        paligned = geometry.align(formation.points, q_form, d=2)
+        res = sinkhorn.sinkhorn_assign(swarm.q, paligned)
+        return res.row_to_col, jnp.asarray(True)  # valid by construction
     elif cfg.assignment == "cbaa":
         res = cbaa.cbaa_from_state(swarm.q, formation.points,
                                    formation.adjmat, v2f)
@@ -143,7 +153,8 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
     # --- safety shim: saturate -> avoid -> safe trajectory ---
     u = control.saturate_velocity(u, sparams)
     if cfg.use_colavoid:
-        u, ca = control.collision_avoidance(swarm.q, u, sparams)
+        u, ca = control.collision_avoidance(
+            swarm.q, u, sparams, max_neighbors=cfg.colavoid_neighbors)
     else:
         ca = jnp.zeros((u.shape[0],), bool)
     n = u.shape[0]

@@ -1,0 +1,128 @@
+"""Scale benchmarks: the north-star numbers (BASELINE.md) on real hardware.
+
+Prints one JSON line per metric. Methodology: work is chained inside a
+single jit (scan over distinct inputs or dependent rollout steps) so numbers
+are true per-op latencies, not pipelined-dispatch artifacts (the device
+runtime dedupes identical repeated dispatches).
+
+Run: python benchmarks/scale.py [--n 1000] [--quick]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def bench_all(n: int, quick: bool = False):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from aclswarm_tpu import sim
+    from aclswarm_tpu.assignment import lapjv, sinkhorn
+    from aclswarm_tpu.core import geometry
+    from aclswarm_tpu.core.types import (ControlGains, SafetyParams,
+                                         make_formation)
+
+    rng = np.random.default_rng(0)
+    results = []
+
+    def emit(metric, value, unit, baseline=None):
+        row = {"metric": metric, "value": round(float(value), 3),
+               "unit": unit}
+        if baseline is not None:
+            row["vs_baseline"] = round(float(value) / baseline, 2)
+        results.append(row)
+        print(json.dumps(row))
+
+    # --- full 100 Hz control tick at scale (chained rollout) ---
+    pts = rng.normal(size=(n, 3)).astype(np.float32) * 20
+    adj = (np.ones((n, n)) - np.eye(n)).astype(np.float32)
+    gains = (rng.normal(size=(n, n, 3, 3)) * 0.01).astype(np.float32)
+    f = make_formation(jnp.asarray(pts), jnp.asarray(adj),
+                       jnp.asarray(gains))
+    sp = SafetyParams(bounds_min=jnp.asarray([-100.0, -100.0, 0.0]),
+                      bounds_max=jnp.asarray([100.0, 100.0, 20.0]))
+    st = sim.init_state(
+        rng.normal(size=(n, 3)).astype(np.float32) * 20 + [0, 0, 2])
+    cfg = sim.SimConfig(assignment="none",
+                        colavoid_neighbors=16 if n > 64 else None)
+    ticks = 50 if quick else 200
+    roll = jax.jit(lambda s: sim.rollout(s, f, ControlGains(), sp, cfg,
+                                         ticks)[0])
+    jax.block_until_ready(roll(st))
+    t0 = time.perf_counter()
+    jax.block_until_ready(roll(st))
+    dt = (time.perf_counter() - t0) / ticks
+    emit(f"control_tick_n{n}_hz", 1.0 / dt, "Hz", baseline=100.0)
+
+    # --- sinkhorn assignment at scale (chained over distinct instances) ---
+    K = 5 if quick else 20
+    qs = jnp.asarray(rng.normal(size=(K, n, 3)).astype(np.float32) * 20)
+    p = jnp.asarray(pts)
+
+    def chain(qs):
+        def body(c, q):
+            r = sinkhorn.sinkhorn_assign(q, p, n_iters=50)
+            return c + r.row_to_col.sum(), None
+        return lax.scan(body, jnp.int32(0), qs)[0]
+
+    fj = jax.jit(chain)
+    jax.block_until_ready(fj(qs))
+    t0 = time.perf_counter()
+    jax.block_until_ready(fj(qs))
+    dt = (time.perf_counter() - t0) / K
+    emit(f"sinkhorn_assign_n{n}_hz", 1.0 / dt, "Hz", baseline=100.0)
+
+    # quality vs exact LAP
+    v = np.asarray(jax.jit(
+        lambda q: sinkhorn.sinkhorn_assign(q, p, n_iters=50).row_to_col)(
+            qs[0]))
+    cost = np.asarray(geometry.cdist(qs[0], p))
+    opt = cost[np.arange(n), lapjv(cost)].sum()
+    emit(f"sinkhorn_assign_n{n}_subopt", cost[np.arange(n), v].sum() / opt - 1,
+         "ratio")
+
+    # --- gain design (ADMM) ---
+    n_g = min(n, 100)
+    pts_g = rng.normal(size=(n_g, 3)).astype(np.float32) * 10
+    adj_g = np.ones((n_g, n_g)) - np.eye(n_g)
+    from aclswarm_tpu import gains as gl
+    solve = jax.jit(lambda p: gl.solve_gains(p, adj_g))
+
+    # chained over distinct point sets
+    ptss = jnp.asarray(
+        rng.normal(size=(3, n_g, 3)).astype(np.float32) * 10)
+
+    def gchain(ptss):
+        def body(c, pp):
+            return c + gl.solve_gains(pp, adj_g).sum(), None
+        return lax.scan(body, jnp.float32(0), ptss)[0]
+
+    gj = jax.jit(gchain)
+    jax.block_until_ready(gj(ptss))
+    t0 = time.perf_counter()
+    jax.block_until_ready(gj(ptss))
+    dt = (time.perf_counter() - t0) / 3
+    emit(f"admm_gain_design_n{n_g}_ms", dt * 1000, "ms")
+
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1000)
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    bench_all(args.n, args.quick)
+
+
+if __name__ == "__main__":
+    main()

@@ -232,6 +232,28 @@ class TestColAvoid:
             np.linalg.norm(np.asarray(out)[0, :2]), 0.5, atol=1e-9)
         assert abs(float(out[0, 1])) > 0.1  # rotated off the -x axis
 
+    def test_topk_pruning_exact_when_sparse(self):
+        # with <= k vehicles inside the threshold per agent, the pruned
+        # O(n*k^2) path must match the dense O(n^3) path exactly
+        p = self._params()
+        for seed in range(10):
+            rng = np.random.default_rng(300 + seed)
+            n = 12
+            q = rng.uniform(-4, 4, size=(n, 3))
+            vel = rng.normal(size=(n, 3)) * 0.5
+            dense_v, dense_m = control.collision_avoidance(
+                jnp.asarray(q), jnp.asarray(vel), p)
+            # count in-range neighbors to pick a sufficient k
+            d = np.linalg.norm(q[:, None, :2] - q[None, :, :2], axis=-1)
+            within = (d <= p.d_avoid_thresh).sum(1) - 1
+            k = int(within.max()) + 1
+            prun_v, prun_m = control.collision_avoidance(
+                jnp.asarray(q), jnp.asarray(vel), p, max_neighbors=k)
+            np.testing.assert_array_equal(np.asarray(dense_m),
+                                          np.asarray(prun_m))
+            np.testing.assert_allclose(np.asarray(dense_v),
+                                       np.asarray(prun_v), atol=1e-12)
+
     def test_surrounded_stops(self):
         # agent ringed by close obstacles on all sides => full stop
         p = SafetyParams(d_avoid_thresh=3.0, r_keep_out=1.2)
