@@ -84,7 +84,9 @@ class SafetyParams:
     bounds_max: jnp.ndarray = struct.field(
         default_factory=lambda: jnp.array([1.0, 1.0, 1.0]))
     spinup_time: float = 2.0
-    control_dt: float = 0.01
+    # NOTE: the control tick period lives on `sim.SimConfig.control_dt`
+    # (single source of truth); the reference's safety node has its own
+    # control_dt param (`safety.cpp:39`) but both default to 0.01 s.
     takeoff_inc: float = 0.0035
     takeoff_alt: float = 1.0
     # static (not a pytree leaf): selects host-side control flow

@@ -125,7 +125,11 @@ def run_fsm(distcmd_norm: np.ndarray, ca_active: np.ndarray, dt: float):
     """Emulate the supervisor FSM over a recorded rollout (single formation).
 
     Returns (converged, convergence_time_s, entered_gridlock,
-    gridlock_terminated, last_gridlock_episode_s).
+    gridlock_terminated, timed_out, last_gridlock_episode_s, log_stop_tick).
+    `log_stop_tick` is the tick where metric logging stops — COMPLETE/TERMINATE
+    entry, else the end of the recording — matching the reference's
+    start_logging-at-FLYING / stop_logging-at-exit window
+    (`supervisor.py:397-403`), so distance metrics exclude post-trial ticks.
     """
     distcmd_norm = np.asarray(distcmd_norm)
     ca_active = np.asarray(ca_active, dtype=np.float64)
@@ -143,6 +147,7 @@ def run_fsm(distcmd_norm: np.ndarray, ca_active: np.ndarray, dt: float):
     timed_out = False
     grid_enter_t = None
     last_episode = 0.0
+    log_stop_t = T - 1
 
     def elapsed(secs):
         return ticks_in_state * dt >= secs
@@ -181,6 +186,7 @@ def run_fsm(distcmd_norm: np.ndarray, ca_active: np.ndarray, dt: float):
         elif state == IN_FORMATION:
             if elapsed(CONVERGED_WAIT):
                 conv_time = (t - log_start_t) * dt   # stop_logging
+                log_stop_t = t
                 next_state(COMPLETE, t)
                 break
             elif not has_converged(t):
@@ -192,10 +198,12 @@ def run_fsm(distcmd_norm: np.ndarray, ca_active: np.ndarray, dt: float):
                 next_state(FLYING, t)
             elif elapsed(GRIDLOCK_TIMEOUT):
                 grid_terminated = True
+                log_stop_t = t
                 next_state(TERMINATE, t)
                 break
         if t * dt > TRIAL_TIMEOUT:                   # watchdog
             timed_out = True
+            log_stop_t = t
             next_state(TERMINATE, t)
             break
 
@@ -205,7 +213,7 @@ def run_fsm(distcmd_norm: np.ndarray, ca_active: np.ndarray, dt: float):
         last_episode = (T - 1 - grid_enter_t) * dt
 
     return (state == COMPLETE, conv_time, entered_gridlock,
-            grid_terminated, timed_out, last_episode)
+            grid_terminated, timed_out, last_episode, log_stop_t)
 
 
 def evaluate(distcmd_norm: np.ndarray, ca_active: np.ndarray,
@@ -220,8 +228,8 @@ def evaluate(distcmd_norm: np.ndarray, ca_active: np.ndarray,
       reassigned / assign_valid: (T,) assignment events.
       dt: control tick period (s).
     """
-    converged, conv_time, entered, grid_term, timed_out, last_ep = run_fsm(
-        distcmd_norm, ca_active, dt)
+    (converged, conv_time, entered, grid_term, timed_out, last_ep,
+     log_stop) = run_fsm(distcmd_norm, ca_active, dt)
     ca = np.asarray(ca_active, dtype=np.float64)
     return TrialResult(
         converged=converged,
@@ -231,7 +239,7 @@ def evaluate(distcmd_norm: np.ndarray, ca_active: np.ndarray,
         timed_out=timed_out,
         last_gridlock_episode_s=last_ep,
         time_in_avoidance_s=np.sum(ca, axis=0) * dt,
-        dist_traveled_m=distance_traveled(q),
+        dist_traveled_m=distance_traveled(np.asarray(q)[:log_stop + 1]),
         n_reassignments=int(np.sum(np.asarray(reassigned))),
         invalid_auctions=int(np.sum(~np.asarray(assign_valid))),
     )

@@ -62,7 +62,9 @@ def sim_state_sharding(mesh: Mesh) -> sim.SimState:
     return sim.SimState(
         swarm=SwarmState(q=row, vel=row),
         goal=control.TrajGoal(pos=row, vel=row, yaw=row, dyaw=row),
-        v2f=row, tick=rep)
+        v2f=row, tick=rep,
+        flight=sim.FlightState(mode=row, ticks_in_mode=row,
+                               initial_alt=row, takeoff_alt=row))
 
 
 def formation_sharding(mesh: Mesh) -> Formation:

@@ -39,6 +39,8 @@ from aclswarm_tpu.core import geometry
 from aclswarm_tpu.core import perm as permutil
 from aclswarm_tpu.core.types import (ControlGains, Formation, SafetyParams,
                                      SwarmState)
+from aclswarm_tpu.sim import vehicle
+from aclswarm_tpu.sim.vehicle import ExternalInputs, FlightState
 
 
 @struct.dataclass
@@ -56,6 +58,10 @@ class SimConfig:
     dynamics: str = struct.field(pytree_node=False, default="tracking")
     tau: float = struct.field(pytree_node=False, default=0.15)
     use_colavoid: bool = struct.field(pytree_node=False, default=True)
+    # run the per-vehicle flight-mode FSM (takeoff/land/kill lifecycle,
+    # `aclswarm_tpu.sim.vehicle`); off = the historical airborne-start mode
+    # where every vehicle is FLYING for the whole rollout
+    flight_fsm: bool = struct.field(pytree_node=False, default=False)
     # top-k neighbor pruning for collision avoidance (None = dense); see
     # `control.collision_avoidance` — exact for <= k in-range neighbors
     colavoid_neighbors: int | None = struct.field(pytree_node=False,
@@ -70,6 +76,7 @@ class SimState:
     goal: control.TrajGoal
     v2f: jnp.ndarray          # (n,) current assignment
     tick: jnp.ndarray         # () int32
+    flight: FlightState       # per-vehicle flight-mode FSM
 
 
 @struct.dataclass
@@ -81,9 +88,13 @@ class StepMetrics:
     assign_valid: jnp.ndarray   # () bool: this tick's auction produced a perm
     reassigned: jnp.ndarray     # () bool: assignment changed this tick
     q: jnp.ndarray              # (n, 3) positions after the tick
+    mode: jnp.ndarray           # (n,) int32 flight mode after the tick
 
 
-def init_state(q0, v2f0=None) -> SimState:
+def init_state(q0, v2f0=None, flying: bool = True) -> SimState:
+    """``flying=True`` starts airborne in FLYING (historical rollouts);
+    ``flying=False`` starts NOT_FLYING on the ground — send CMD_GO via
+    `ExternalInputs` to take off (requires ``cfg.flight_fsm``)."""
     q0 = jnp.asarray(q0)
     n = q0.shape[0]
     if v2f0 is None:
@@ -92,7 +103,8 @@ def init_state(q0, v2f0=None) -> SimState:
         swarm=SwarmState(q=q0, vel=jnp.zeros_like(q0)),
         goal=control.TrajGoal.hover_at(q0),
         v2f=jnp.asarray(v2f0, jnp.int32),
-        tick=jnp.asarray(0, jnp.int32))
+        tick=jnp.asarray(0, jnp.int32),
+        flight=vehicle.init_flight(n, q0.dtype, flying=flying))
 
 
 def _assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
@@ -128,13 +140,27 @@ def _assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
 
 
 def step(state: SimState, formation: Formation, gains: ControlGains,
-         sparams: SafetyParams, cfg: SimConfig
+         sparams: SafetyParams, cfg: SimConfig,
+         inputs: ExternalInputs | None = None
          ) -> tuple[SimState, StepMetrics]:
     """One 100 Hz control tick for the whole swarm (§3.3 pipeline)."""
-    swarm, goal, v2f = state.swarm, state.goal, state.v2f
+    swarm, goal, v2f, fs = state.swarm, state.goal, state.v2f, state.flight
+    n = swarm.q.shape[0]
+    if inputs is None:
+        inputs = ExternalInputs.none(n, swarm.q.dtype)
+
+    # --- operator flight-mode broadcast (`safety.cpp:101-121`) ---
+    if cfg.flight_fsm:
+        fs = vehicle.apply_command(fs, inputs.cmd)
+    flying = fs.mode == vehicle.FLYING
 
     # --- auto-auction (decimated onto its own period, §2.5) ---
+    # auctions only run once the fleet is airborne: the reference only
+    # starts auctioning after the formation is committed in flight
+    # (`coordination_ros.cpp:136-153`)
     do_assign = (state.tick % cfg.assign_every) == 0
+    if cfg.flight_fsm:
+        do_assign = do_assign & jnp.all(flying)
     if cfg.assignment == "none":
         new_v2f, valid = v2f, jnp.asarray(True)
     else:
@@ -148,18 +174,29 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
 
     # --- distributed control law -> distcmd (§3.3) ---
     u = control.compute(swarm, formation, v2f, gains)
+    if cfg.flight_fsm:
+        # coordination publishes distcmd only while flying
+        u = jnp.where(flying[:, None], u, 0.0)
     distcmd_norm = jnp.linalg.norm(u, axis=-1)
 
-    # --- safety shim: saturate -> avoid -> safe trajectory ---
+    # --- safety shim: saturate -> mux -> avoid -> safe trajectory ---
     u = control.saturate_velocity(u, sparams)
+    u, yawrate = vehicle.mux_goals(u, inputs)
     if cfg.use_colavoid:
         u, ca = control.collision_avoidance(
             swarm.q, u, sparams, max_neighbors=cfg.colavoid_neighbors)
     else:
-        ca = jnp.zeros((u.shape[0],), bool)
-    n = u.shape[0]
-    goal = control.make_safe_traj(cfg.control_dt, u,
-                                  jnp.zeros((n,), u.dtype), goal, sparams)
+        ca = jnp.zeros((n,), bool)
+    safe_goal = control.make_safe_traj(cfg.control_dt, u, yawrate, goal,
+                                       sparams)
+
+    # --- flight FSM: per-mode goal override (takeoff/landing ramps) ---
+    if cfg.flight_fsm:
+        fs, goal = vehicle.flight_step(fs, goal, safe_goal, swarm.q,
+                                       sparams, cfg.control_dt)
+        ca = ca & flying
+    else:
+        goal = safe_goal
 
     # --- vehicle dynamics ---
     if cfg.dynamics == "tracking":
@@ -172,23 +209,26 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
         raise ValueError(f"unknown dynamics model {cfg.dynamics!r}")
 
     new_state = SimState(swarm=swarm, goal=goal, v2f=v2f,
-                         tick=state.tick + 1)
+                         tick=state.tick + 1, flight=fs)
     return new_state, StepMetrics(distcmd_norm=distcmd_norm, ca_active=ca,
                                   assign_valid=valid, reassigned=reassigned,
-                                  q=swarm.q)
+                                  q=swarm.q, mode=fs.mode)
 
 
 @partial(jax.jit, static_argnames=("n_ticks", "cfg"))
 def rollout(state: SimState, formation: Formation, gains: ControlGains,
-            sparams: SafetyParams, cfg: SimConfig, n_ticks: int
+            sparams: SafetyParams, cfg: SimConfig, n_ticks: int,
+            inputs: ExternalInputs | None = None
             ) -> tuple[SimState, StepMetrics]:
     """Roll the swarm forward ``n_ticks`` control ticks; one jitted scan.
 
-    Returns the final state and time-stacked `StepMetrics` (leading axis
-    ``n_ticks``), from which the supervisor predicates are evaluated
-    (`aclswarm_tpu.harness.supervisor`).
+    ``inputs`` (optional) is a time-stacked `ExternalInputs` pytree (leading
+    axis ``n_ticks``) scanned alongside — the operator command schedule and
+    joystick overrides of a full trial. Returns the final state and
+    time-stacked `StepMetrics` (leading axis ``n_ticks``), from which the
+    supervisor predicates are evaluated (`aclswarm_tpu.harness.supervisor`).
     """
-    def body(s, _):
-        return step(s, formation, gains, sparams, cfg)
+    def body(s, x):
+        return step(s, formation, gains, sparams, cfg, x)
 
-    return lax.scan(body, state, None, length=n_ticks)
+    return lax.scan(body, state, inputs, length=n_ticks)

@@ -1,0 +1,198 @@
+"""Vehicle flight-mode FSM + goal mux, batched over the swarm.
+
+Spec: the reference safety node's per-vehicle flight lifecycle
+(`aclswarm/src/safety.cpp:101-121` mode transitions, `:201-318` per-mode
+control behavior, `:263-288` prioritized goal mux). There it is a per-process
+state machine driven by the operator's `/globalflightmode` topic; here the
+whole swarm's modes are one ``(n,)`` integer array advanced inside the jitted
+scan — transitions are `jnp.where` selects, so the rollout stays a single
+compiled program with no data-dependent Python control flow.
+
+Semantics preserved:
+- NOT_FLYING --GO--> TAKEOFF; TAKEOFF/FLYING --LAND--> LANDING; any --KILL-->
+  NOT_FLYING (`safety.cpp:104-120`). Commands are global broadcasts, exactly
+  like the operator's topic.
+- TAKEOFF (`safety.cpp:211-259`): on entry the goal snaps to the current
+  position (vel zero) and the target altitude is computed
+  (``takeoff_alt + initial_alt`` if ``takeoff_rel``); nothing moves until
+  ``spinup_time`` has elapsed; then the z goal ramps by ``takeoff_inc`` per
+  tick, clamped to the target; takeoff completes (-> FLYING) when both the
+  tracking error and the distance-to-target are under 0.1 m.
+- FLYING (`safety.cpp:261-292`): highest-priority active velocity goal
+  (JOY=1 beats DIST=0) goes through collision avoidance and
+  `make_safe_traj`; that pipeline runs in `aclswarm_tpu.sim.engine` — this
+  module only selects its output for FLYING vehicles.
+- LANDING (`safety.cpp:293-313`): vel/dyaw zeroed; z goal decrements fast
+  above ``landing_fast_threshold`` (+initial_alt if relative) and slow below;
+  landing completes (-> NOT_FLYING) when within 5 mm of the initial altitude.
+- NOT_FLYING (`safety.cpp:315-318`): power cut; in sim the vehicle simply
+  stays where it is on the ground.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from aclswarm_tpu.control.safety import TrajGoal
+from aclswarm_tpu.core.types import SafetyParams
+
+# flight modes (`safety.h` Mode enum order)
+NOT_FLYING, TAKEOFF, FLYING, LANDING = 0, 1, 2, 3
+# operator commands (`snapstack_msgs/QuadFlightMode` GO/LAND/KILL)
+CMD_NONE, CMD_GO, CMD_LAND, CMD_KILL = 0, 1, 2, 3
+
+TAKEOFF_THRESHOLD = 0.100   # m, safety.cpp:249
+LANDING_THRESHOLD = 0.005   # m, safety.cpp:299
+
+
+@struct.dataclass
+class FlightState:
+    """Batched per-vehicle FSM state (the safety node's static locals,
+    `safety.cpp:203-209,239-241`)."""
+
+    mode: jnp.ndarray           # (n,) int32
+    ticks_in_mode: jnp.ndarray  # (n,) int32, resets on every transition
+    initial_alt: jnp.ndarray    # (n,) altitude captured at takeoff init
+    takeoff_alt: jnp.ndarray    # (n,) absolute target altitude
+
+
+@struct.dataclass
+class ExternalInputs:
+    """Per-tick operator/pilot inputs (scanned over time in `rollout`).
+
+    ``cmd`` is the global flight-mode broadcast; ``joy_*`` is the JOY goal
+    source — a velocity override with priority over the distributed
+    controller (`safety.cpp:95-96` priorities, `:263-288` mux).
+    """
+
+    cmd: jnp.ndarray         # () int32 broadcast command
+    joy_vel: jnp.ndarray     # (n, 3) joystick velocity goal
+    joy_yawrate: jnp.ndarray  # (n,)
+    joy_active: jnp.ndarray  # (n,) bool
+
+    @classmethod
+    def none(cls, n: int, dtype=jnp.float32) -> "ExternalInputs":
+        return cls(cmd=jnp.asarray(CMD_NONE, jnp.int32),
+                   joy_vel=jnp.zeros((n, 3), dtype),
+                   joy_yawrate=jnp.zeros((n,), dtype),
+                   joy_active=jnp.zeros((n,), bool))
+
+
+def init_flight(n: int, dtype=jnp.float32, flying: bool = True
+                ) -> FlightState:
+    """All vehicles NOT_FLYING on the ground, or already FLYING (the
+    airborne-start mode of pre-round-2 rollouts)."""
+    mode = jnp.full((n,), FLYING if flying else NOT_FLYING, jnp.int32)
+    return FlightState(mode=mode,
+                       ticks_in_mode=jnp.zeros((n,), jnp.int32),
+                       initial_alt=jnp.zeros((n,), dtype),
+                       takeoff_alt=jnp.zeros((n,), dtype))
+
+
+def apply_command(fs: FlightState, cmd: jnp.ndarray) -> FlightState:
+    """Operator-command transitions (`safety.cpp:101-121`), batched."""
+    m = fs.mode
+    new = m
+    new = jnp.where((m == NOT_FLYING) & (cmd == CMD_GO), TAKEOFF, new)
+    new = jnp.where(((m == TAKEOFF) | (m == FLYING)) & (cmd == CMD_LAND),
+                    LANDING, new)
+    new = jnp.where(cmd == CMD_KILL, NOT_FLYING, new)
+    changed = new != m
+    return fs.replace(mode=new,
+                      ticks_in_mode=jnp.where(changed, 0, fs.ticks_in_mode))
+
+
+def mux_goals(dist_vel: jnp.ndarray, inputs: ExternalInputs
+              ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Prioritized goal sources: JOY (priority 1) beats DIST (priority 0)
+    (`safety.cpp:95-96,263-288`). Returns (vel_goal, yawrate)."""
+    vel = jnp.where(inputs.joy_active[:, None], inputs.joy_vel, dist_vel)
+    yawrate = jnp.where(inputs.joy_active, inputs.joy_yawrate,
+                        jnp.zeros_like(inputs.joy_yawrate))
+    return vel, yawrate
+
+
+def flight_step(fs: FlightState, goal_prev: TrajGoal, safe_goal: TrajGoal,
+                q: jnp.ndarray, params: SafetyParams, dt: float
+                ) -> tuple[FlightState, TrajGoal]:
+    """One control tick of the per-mode goal logic (`safety.cpp:201-318`).
+
+    ``safe_goal`` is the FLYING pipeline's output (mux -> colavoid ->
+    `make_safe_traj`) computed for every row; this function selects it only
+    where the vehicle is actually FLYING and runs the takeoff/landing ramps
+    elsewhere. ``dt`` is the engine's control tick period (`SimConfig
+    .control_dt` — the single source of truth for timing). The ramp z goals
+    also carry the matching goal *velocity* so velocity-following dynamics
+    models (``firstorder``) track them, not just position-tracking ones.
+    Returns (new flight state, new goal); NOT_FLYING rows are the power-cut
+    set.
+    """
+    dtype = q.dtype
+    m = fs.mode
+    ticks = fs.ticks_in_mode
+    qz = q[:, 2]
+
+    # --- TAKEOFF init: snap goal to pose, capture altitudes (:216-246) ---
+    entering = (m == TAKEOFF) & (ticks == 0)
+    initial_alt = jnp.where(entering, qz, fs.initial_alt)
+    tk_alt = params.takeoff_alt + (initial_alt if params.takeoff_rel else 0.0)
+    takeoff_alt = jnp.where(entering, tk_alt, fs.takeoff_alt)
+
+    pos = jnp.where(entering[:, None], q, goal_prev.pos)
+    vel = jnp.where(entering[:, None], 0.0, goal_prev.vel)
+    yaw = goal_prev.yaw
+    dyaw = jnp.where(entering, 0.0, goal_prev.dyaw)
+
+    # --- TAKEOFF ramp after spinup (:248-258) ---
+    spun_up = (ticks.astype(dtype) * dt) >= params.spinup_time
+    tk = (m == TAKEOFF) & spun_up
+    tk_done = tk & (jnp.abs(pos[:, 2] - qz) < TAKEOFF_THRESHOLD) \
+        & (jnp.abs(pos[:, 2] - takeoff_alt) < TAKEOFF_THRESHOLD)
+    ramping = tk & ~tk_done
+    ramp_z = jnp.clip(pos[:, 2] + params.takeoff_inc, 0.0, takeoff_alt)
+    ramp_vz = jnp.where(ramping, (ramp_z - pos[:, 2]) / dt, 0.0)
+    pos = pos.at[:, 2].set(jnp.where(ramping, ramp_z, pos[:, 2]))
+    vel = jnp.where((m == TAKEOFF)[:, None],
+                    jnp.stack([jnp.zeros_like(ramp_vz),
+                               jnp.zeros_like(ramp_vz), ramp_vz], -1), vel)
+
+    # --- LANDING decrement (:293-313) ---
+    landing = m == LANDING
+    land_done = landing & ((qz - initial_alt) < LANDING_THRESHOLD)
+    fast_th = params.landing_fast_threshold \
+        + (initial_alt if params.takeoff_rel else 0.0)
+    dec = jnp.where(qz > fast_th, params.landing_fast_dec,
+                    params.landing_slow_dec)
+    descending = landing & ~land_done
+    land_z = jnp.clip(pos[:, 2] - dec, 0.0, params.bounds_max[2])
+    land_vz = jnp.where(descending, (land_z - pos[:, 2]) / dt, 0.0)
+    pos = pos.at[:, 2].set(jnp.where(descending, land_z, pos[:, 2]))
+    vel = jnp.where(landing[:, None],
+                    jnp.stack([jnp.zeros_like(land_vz),
+                               jnp.zeros_like(land_vz), land_vz], -1), vel)
+    dyaw = jnp.where(landing, 0.0, dyaw)
+
+    # --- FLYING: take the safe-trajectory pipeline's output (:261-292) ---
+    flying = m == FLYING
+    pos = jnp.where(flying[:, None], safe_goal.pos, pos)
+    vel = jnp.where(flying[:, None], safe_goal.vel, vel)
+    yaw = jnp.where(flying, safe_goal.yaw, yaw)
+    dyaw = jnp.where(flying, safe_goal.dyaw, dyaw)
+
+    # --- NOT_FLYING: power cut, goal pinned to the ground pose (:315-318) ---
+    grounded = m == NOT_FLYING
+    pos = jnp.where(grounded[:, None], q, pos)
+    vel = jnp.where(grounded[:, None], 0.0, vel)
+    dyaw = jnp.where(grounded, 0.0, dyaw)
+
+    # --- automatic transitions ---
+    new_mode = jnp.where(tk_done, FLYING, m)
+    new_mode = jnp.where(land_done, NOT_FLYING, new_mode)
+    changed = new_mode != m
+    new_fs = FlightState(
+        mode=new_mode,
+        ticks_in_mode=jnp.where(changed, 0, ticks + 1),
+        initial_alt=initial_alt,
+        takeoff_alt=takeoff_alt)
+    goal = TrajGoal(pos=pos, vel=vel, yaw=yaw, dyaw=dyaw)
+    return new_fs, goal

@@ -52,8 +52,8 @@ def bench_all(n: int, quick: bool = False):
                       bounds_max=jnp.asarray([100.0, 100.0, 20.0]))
     st = sim.init_state(
         rng.normal(size=(n, 3)).astype(np.float32) * 20 + [0, 0, 2])
-    cfg = sim.SimConfig(assignment="none",
-                        colavoid_neighbors=16 if n > 64 else None)
+    k_ca = 16 if n > 64 else None
+    cfg = sim.SimConfig(assignment="none", colavoid_neighbors=k_ca)
     ticks = 50 if quick else 200
     roll = jax.jit(lambda s: sim.rollout(s, f, ControlGains(), sp, cfg,
                                          ticks)[0])
@@ -61,7 +61,11 @@ def bench_all(n: int, quick: bool = False):
     t0 = time.perf_counter()
     jax.block_until_ready(roll(st))
     dt = (time.perf_counter() - t0) / ticks
-    emit(f"control_tick_n{n}_hz", 1.0 / dt, "Hz", baseline=100.0)
+    # the pruning parameter is part of the metric name: with k-neighbor
+    # pruning the avoidance kernel is approximate when > k vehicles are
+    # inside d_avoid_thresh (see control.collision_avoidance)
+    ca_tag = f"_k{k_ca}" if k_ca is not None else ""
+    emit(f"control_tick_n{n}{ca_tag}_hz", 1.0 / dt, "Hz", baseline=100.0)
 
     # --- sinkhorn assignment at scale (chained over distinct instances) ---
     K = 5 if quick else 20
@@ -92,10 +96,8 @@ def bench_all(n: int, quick: bool = False):
 
     # --- gain design (ADMM) ---
     n_g = min(n, 100)
-    pts_g = rng.normal(size=(n_g, 3)).astype(np.float32) * 10
     adj_g = np.ones((n_g, n_g)) - np.eye(n_g)
     from aclswarm_tpu import gains as gl
-    solve = jax.jit(lambda p: gl.solve_gains(p, adj_g))
 
     # chained over distinct point sets
     ptss = jnp.asarray(

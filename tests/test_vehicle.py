@@ -1,0 +1,235 @@
+"""Flight-mode FSM + goal mux tests (`aclswarm_tpu.sim.vehicle`).
+
+Spec: `aclswarm/src/safety.cpp:101-121` (transitions), `:201-318` (per-mode
+behavior), `:263-288` (goal mux priority).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aclswarm_tpu import sim
+from aclswarm_tpu.core.types import ControlGains, SafetyParams, make_formation
+from aclswarm_tpu.sim import vehicle
+from aclswarm_tpu.sim.vehicle import (CMD_GO, CMD_KILL, CMD_LAND, CMD_NONE,
+                                      FLYING, LANDING, NOT_FLYING, TAKEOFF)
+
+
+def _room():
+    return SafetyParams(bounds_min=jnp.asarray([-20.0, -20.0, 0.0]),
+                        bounds_max=jnp.asarray([20.0, 20.0, 10.0]))
+
+
+def _inputs_schedule(T, n, cmds: dict):
+    """Time-stacked ExternalInputs with commands at given ticks."""
+    cmd = np.full((T,), CMD_NONE, np.int32)
+    for t, c in cmds.items():
+        cmd[t] = c
+    return sim.ExternalInputs(
+        cmd=jnp.asarray(cmd),
+        joy_vel=jnp.zeros((T, n, 3)),
+        joy_yawrate=jnp.zeros((T, n)),
+        joy_active=jnp.zeros((T, n), bool))
+
+
+def _ground_swarm(n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    q0 = np.zeros((n, 3))
+    q0[:, :2] = rng.uniform(-5, 5, size=(n, 2))
+    ang = np.linspace(0, 2 * np.pi, n, endpoint=False)
+    pts = np.stack([4 * np.cos(ang), 4 * np.sin(ang), np.zeros(n)], 1)
+    adj = np.ones((n, n)) - np.eye(n)
+    formation = make_formation(pts, adj)
+    return q0, formation
+
+
+def test_command_transitions():
+    fs = vehicle.init_flight(3, flying=False)
+    assert np.all(np.asarray(fs.mode) == NOT_FLYING)
+    fs = vehicle.apply_command(fs, jnp.asarray(CMD_GO))
+    assert np.all(np.asarray(fs.mode) == TAKEOFF)
+    # LAND from TAKEOFF is legal (`safety.cpp:110-114`)
+    fs = vehicle.apply_command(fs, jnp.asarray(CMD_LAND))
+    assert np.all(np.asarray(fs.mode) == LANDING)
+    # KILL from anywhere
+    fs = vehicle.apply_command(fs, jnp.asarray(CMD_KILL))
+    assert np.all(np.asarray(fs.mode) == NOT_FLYING)
+    # LAND has no effect on the ground
+    fs = vehicle.apply_command(fs, jnp.asarray(CMD_LAND))
+    assert np.all(np.asarray(fs.mode) == NOT_FLYING)
+
+
+def test_takeoff_ramp_and_completion():
+    """GO -> spinup hold -> z ramp at takeoff_inc -> FLYING at altitude."""
+    n = 4
+    q0, formation = _ground_swarm(n)
+    sp = _room()
+    cfg = sim.SimConfig(assignment="none", flight_fsm=True,
+                        use_colavoid=False)
+    st = sim.init_state(q0, flying=False)
+    T = 800
+    inputs = _inputs_schedule(T, n, {0: CMD_GO})
+    final, m = sim.rollout(st, formation, ControlGains(), sp, cfg, T, inputs)
+    mode = np.asarray(m.mode)
+    q = np.asarray(m.q)
+
+    spinup_ticks = int(round(sp.spinup_time / cfg.control_dt))
+    # nothing moves during spinup
+    assert np.allclose(q[spinup_ticks - 1, :, 2], 0.0, atol=1e-9)
+    assert np.all(mode[spinup_ticks - 1] == TAKEOFF)
+    # ramp: z increases by takeoff_inc per tick once spun up
+    dz = q[spinup_ticks + 10, :, 2] - q[spinup_ticks + 9, :, 2]
+    assert np.allclose(dz, sp.takeoff_inc, atol=1e-9)
+    # takeoff completes near takeoff_alt (+0 initial alt) within threshold
+    # (the 0.1 m completion threshold fires a little before the ramp tops out)
+    ramp_ticks = int(np.ceil(sp.takeoff_alt / sp.takeoff_inc))
+    done = spinup_ticks + ramp_ticks + 5
+    assert np.all(mode[done] == FLYING)
+    assert np.all(np.abs(q[done, :, 2] - sp.takeoff_alt)
+                  < vehicle.TAKEOFF_THRESHOLD + 1e-6)
+    # xy untouched while still in TAKEOFF (control only engages in FLYING)
+    t_first_fly = int(np.argmax(np.any(mode == FLYING, axis=1)))
+    assert np.allclose(q[t_first_fly - 1, :, :2], q0[:, :2], atol=1e-6)
+
+
+def test_landing_fast_then_slow_to_ground():
+    n = 4
+    q0, formation = _ground_swarm(n)
+    q0 = q0 + np.array([0.0, 0.0, 1.0])   # hovering at 1 m
+    sp = _room()
+    cfg = sim.SimConfig(assignment="none", flight_fsm=True,
+                        use_colavoid=False)
+    st = sim.init_state(q0, flying=True)
+    T = 1200
+    inputs = _inputs_schedule(T, n, {0: CMD_LAND})
+    final, m = sim.rollout(st, formation, ControlGains(), sp, cfg, T, inputs)
+    mode = np.asarray(m.mode)
+    q = np.asarray(m.q)
+
+    assert np.all(mode[0] == LANDING)
+    # fast decrement above the threshold, slow below
+    dz_hi = q[1, :, 2] - q[2, :, 2]
+    assert np.allclose(dz_hi, sp.landing_fast_dec, atol=1e-9)
+    low_t = np.argmax(q[:, 0, 2] < sp.landing_fast_threshold - 0.01)
+    dz_lo = q[low_t + 1, :, 2] - q[low_t + 2, :, 2]
+    assert np.allclose(dz_lo, sp.landing_slow_dec, atol=1e-9)
+    # touches down and powers off; initial_alt for an airborne start is 0
+    # (init_flight zeros) so landing runs to the floor
+    assert np.all(mode[-1] == NOT_FLYING)
+    assert np.all(q[-1, :, 2] < vehicle.LANDING_THRESHOLD + 1e-6)
+
+
+def test_takeoff_and_land_with_firstorder_dynamics():
+    """The ramps carry velocity goals, so a velocity-following dynamics
+    model (not just the position-tracking one) completes takeoff/landing."""
+    n = 4
+    q0, formation = _ground_swarm(n)
+    sp = _room()
+    cfg = sim.SimConfig(assignment="none", flight_fsm=True,
+                        use_colavoid=False, dynamics="firstorder")
+    st = sim.init_state(q0, flying=False)
+    spinup_ticks = int(round(sp.spinup_time / cfg.control_dt))
+    ramp = int(np.ceil(sp.takeoff_alt / sp.takeoff_inc))
+    t_land = spinup_ticks + ramp + 300
+    T = t_land + 1500
+    inputs = _inputs_schedule(T, n, {0: CMD_GO, t_land: CMD_LAND})
+    final, m = sim.rollout(st, formation, ControlGains(), sp, cfg, T, inputs)
+    mode = np.asarray(m.mode)
+    q = np.asarray(m.q)
+    # takeoff completes despite the first-order lag
+    assert np.all(mode[t_land - 1] == FLYING)
+    assert np.all(np.abs(q[t_land - 1, :, 2] - sp.takeoff_alt) < 0.2)
+    # landing completes back to the ground
+    assert np.all(mode[-1] == NOT_FLYING)
+    assert np.all(q[-1, :, 2] < 0.05)
+
+
+def test_kill_cuts_everything():
+    n = 4
+    q0, formation = _ground_swarm(n)
+    q0 = q0 + np.array([0.0, 0.0, 2.0])
+    sp = _room()
+    cfg = sim.SimConfig(assignment="none", flight_fsm=True,
+                        use_colavoid=False)
+    st = sim.init_state(q0, flying=True)
+    T = 10
+    inputs = _inputs_schedule(T, n, {3: CMD_KILL})
+    final, m = sim.rollout(st, formation, ControlGains(), sp, cfg, T, inputs)
+    mode = np.asarray(m.mode)
+    assert np.all(mode[2] == FLYING)
+    assert np.all(mode[3:] == NOT_FLYING)
+    # sim's power-cut: vehicle pinned where it was killed
+    q = np.asarray(m.q)
+    assert np.allclose(q[-1], q[3], atol=1e-9)
+
+
+def test_joy_overrides_dist():
+    """JOY (priority 1) beats DIST (priority 0) in the goal mux."""
+    n = 4
+    q0, formation = _ground_swarm(n)
+    q0 = q0 + np.array([0.0, 0.0, 2.0])
+    # real gains would produce a nonzero distcmd; joy must win anyway
+    gains = np.zeros((n, n, 3, 3))
+    sp = _room()
+    cfg = sim.SimConfig(assignment="none", flight_fsm=True,
+                        use_colavoid=False)
+    st = sim.init_state(q0, flying=True)
+    T = 100
+    joy = np.zeros((T, n, 3))
+    joy[:, :, 0] = 0.4   # fly +x at 0.4 m/s
+    inputs = sim.ExternalInputs(
+        cmd=jnp.full((T,), CMD_NONE, jnp.int32),
+        joy_vel=jnp.asarray(joy),
+        joy_yawrate=jnp.zeros((T, n)),
+        joy_active=jnp.ones((T, n), bool))
+    final, m = sim.rollout(st, formation, ControlGains(), sp, cfg, T, inputs)
+    q = np.asarray(m.q)
+    dx = q[-1, :, 0] - q0[:, 0]
+    # accel-limited (0.5 m/s^2) ramp to 0.4 m/s: 0.24 m covered in 1 s
+    assert np.all(np.abs(dx - 0.24) < 0.02)
+    assert np.allclose(q[-1, :, 1:], q0[:, 1:], atol=1e-6)
+
+
+def test_full_lifecycle_ground_to_ground():
+    """IDLE -> takeoff -> formation flight -> land, one scanned rollout."""
+    from aclswarm_tpu import gains as gainslib
+    from aclswarm_tpu.harness import supervisor
+
+    n = 4
+    q0, formation = _ground_swarm(n)
+    A = gainslib.solve_gains_blocks(formation.points, formation.adjmat)
+    formation = formation.replace(gains=A.astype(formation.points.dtype))
+    sp = _room()
+    cfg = sim.SimConfig(assignment="auction", assign_every=120,
+                        flight_fsm=True)
+    st = sim.init_state(q0, flying=False)
+
+    spinup_ticks = int(round(sp.spinup_time / cfg.control_dt))
+    ramp = int(np.ceil(sp.takeoff_alt / sp.takeoff_inc))
+    t_land = spinup_ticks + ramp + 3000
+    T = t_land + 1500
+    inputs = _inputs_schedule(T, n, {0: CMD_GO, t_land: CMD_LAND})
+    final, m = sim.rollout(st, formation, ControlGains(), sp, cfg, T, inputs)
+    mode = np.asarray(m.mode)
+    q = np.asarray(m.q)
+
+    # airborne phase reaches FLYING for everyone, then lands
+    t_flying = spinup_ticks + ramp + 10
+    assert np.all(mode[t_flying] == FLYING)
+    assert np.all(mode[-1] == NOT_FLYING)
+    assert np.all(q[-1, :, 2] < vehicle.LANDING_THRESHOLD + 1e-6)
+
+    # the formation actually converged mid-flight (supervisor oracle over
+    # the airborne window)
+    fly = slice(t_flying, t_land)
+    res = supervisor.evaluate(np.asarray(m.distcmd_norm)[fly],
+                              np.asarray(m.ca_active)[fly],
+                              q[fly], np.asarray(m.reassigned)[fly],
+                              np.asarray(m.assign_valid)[fly],
+                              dt=cfg.control_dt)
+    assert res.converged
+
+    # assignment never ran before everyone was FLYING
+    first_assign = np.argmax(np.asarray(m.reassigned) |
+                             ~np.asarray(m.assign_valid))
+    all_flying_t = np.argmax(np.all(mode == FLYING, axis=1))
+    assert np.sum(np.asarray(m.reassigned)[:all_flying_t]) == 0
