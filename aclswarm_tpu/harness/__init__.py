@@ -1,8 +1,9 @@
-"""Trial harness: formation library, supervisor oracle, trial driver
-(SURVEY.md §7 layer 7)."""
+"""Trial harness: formation library, random formation generator, supervisor
+oracle, Monte-Carlo trial driver (SURVEY.md §7 layer 7)."""
 from aclswarm_tpu.harness.formations import (FormationSpec, load_formation,
                                              load_group)
-from aclswarm_tpu.harness.supervisor import TrialResult, evaluate
+from aclswarm_tpu.harness.supervisor import (TrialFSM, TrialResult,
+                                             TrialState, evaluate)
 
 __all__ = ["FormationSpec", "load_formation", "load_group", "TrialResult",
-           "evaluate"]
+           "TrialFSM", "TrialState", "evaluate"]

@@ -34,9 +34,14 @@ from typing import Optional
 import numpy as np
 
 BUFFER_SECONDS = 1.0          # supervisor.py:47
+ZERO_POS_THR = 0.05           # m, supervisor.py:60
 ORIG_ZERO_VEL_THR = 1.00      # m/s, supervisor.py:61
 AVG_ACTIVE_CA_THR = 0.95      # supervisor.py:62
 EWMA_ALPHA = 0.98             # supervisor.py:83
+SIM_INIT_TIMEOUT = 10.0       # s, supervisor.py:50
+TAKE_OFF_TIMEOUT = 10.0       # s, supervisor.py:51
+HOVER_WAIT = 5.0              # s, supervisor.py:52
+ASSIGNMENT_TIMEOUT = 20.0     # s, supervisor.py:53
 FORMATION_RECEIVED_WAIT = 1.0  # s, supervisor.py:54
 CONVERGED_WAIT = 1.0          # s, supervisor.py:55
 GRIDLOCK_TIMEOUT = 90.0       # s, supervisor.py:56
@@ -238,8 +243,250 @@ def evaluate(distcmd_norm: np.ndarray, ca_active: np.ndarray,
         gridlock_terminated=grid_term,
         timed_out=timed_out,
         last_gridlock_episode_s=last_ep,
-        time_in_avoidance_s=np.sum(ca, axis=0) * dt,
+        time_in_avoidance_s=np.sum(ca[:log_stop + 1], axis=0) * dt,
         dist_traveled_m=distance_traveled(np.asarray(q)[:log_stop + 1]),
-        n_reassignments=int(np.sum(np.asarray(reassigned))),
-        invalid_auctions=int(np.sum(~np.asarray(assign_valid))),
+        n_reassignments=int(np.sum(np.asarray(reassigned)[:log_stop + 1])),
+        invalid_auctions=int(np.sum(~np.asarray(
+            assign_valid)[:log_stop + 1])),
     )
+
+
+# ---------------------------------------------------------------------------
+# Full trial FSM (all nine reference states, `supervisor.py:19-28`)
+# ---------------------------------------------------------------------------
+
+class TrialState:
+    """Reference state numbering (`aclswarm_sim/nodes/supervisor.py:19-28`)."""
+
+    IDLE = 1
+    TAKING_OFF = 2
+    HOVERING = 3
+    WAITING_ON_ASSIGNMENT = 4
+    FLYING = 5
+    IN_FORMATION = 6
+    GRIDLOCK = 7
+    COMPLETE = 8
+    TERMINATE = 9
+
+
+NAMES = {v: k for k, v in vars(TrialState).items() if not k.startswith("_")}
+
+
+class TrialFSM:
+    """The complete reference trial supervisor, stepped tick-by-tick.
+
+    Unlike `run_fsm` (the post-takeoff single-formation oracle kept for
+    rollback-free evaluation of bare rollouts), this class implements the
+    whole experiment lifecycle of `aclswarm_sim/nodes/supervisor.py:160-236`:
+    IDLE -> TAKING_OFF -> [HOVERING -> WAITING_ON_ASSIGNMENT -> FLYING ->
+    IN_FORMATION]* -> COMPLETE, with GRIDLOCK/TERMINATE escapes, the
+    SIM_INIT/TAKE_OFF/ASSIGNMENT timeouts, formation cycling through the
+    group, and the reference's logging exactly: per-formation convergence
+    time / last-gridlock-episode / accepted-assignment count, plus one
+    cumulative EWMA-smoothed planar distance per vehicle accumulated only
+    while logging (`supervisor.py:372-415,441-478`).
+
+    The trial *driver* (`aclswarm_tpu.harness.trials`) owns the simulation;
+    this FSM only observes per-tick signals and returns actions the driver
+    must perform — mirroring the reference split where the supervisor calls
+    the operator's `change_mode` service and the operator/vehicles do the
+    work (`supervisor.py:355-372`).
+
+    Deviations (documented, behavior-preserving in this stack):
+    - `has_sim_initialized` is immediately true (the scan engine has no
+      process bring-up races to wait out), so IDLE emits 'takeoff' on the
+      first tick; the SIM_INIT timeout is retained for API parity.
+    - assignment events are the engine's accepted-assignment ticks
+      (`StepMetrics.reassigned`), the analogue of the reference's
+      `assignment` messages which are published only when an auction result
+      differs from the current assignment (`auctioneer.cpp:310-321`).
+    """
+
+    def __init__(self, n_vehicles: int, n_formations: int,
+                 takeoff_alt: float, dt: float):
+        self.n = n_vehicles
+        self.n_formations = n_formations
+        self.takeoff_alt = takeoff_alt
+        self.dt = dt
+        self.window = max(1, int(round(BUFFER_SECONDS / dt)))
+
+        self.state = TrialState.IDLE
+        self.last_state = None
+        self.timer_ticks = -1
+        self.tick_count = -1
+        self.curr_formation_idx = -1
+        self.received_assignment = False
+        self.is_logging = False
+        self._conv = _Buffer(self.window)
+        self._grid = _Buffer(self.window)
+
+        # reference log structure (`supervisor.py:372-401,441-478`)
+        self.dist = np.zeros(n_vehicles)
+        self._fx = None
+        self._fy = None
+        self.times: list[float] = []
+        self.time_avoidance: list[float] = []
+        self.assignments: list[int] = []
+        self._log_start_tick = 0
+        self._grid_enter_tick = None
+
+    # -- predicates (`supervisor.py:270-350`) --
+
+    def _elapsed(self, secs: float) -> bool:
+        return self.timer_ticks * self.dt >= secs
+
+    def _has_taken_off(self, q) -> bool:
+        return bool(np.all(np.abs(q[:, 2] - self.takeoff_alt)
+                           < ZERO_POS_THR))
+
+    def _has_converged(self, distcmd_norm) -> bool:
+        self._conv.push(distcmd_norm)
+        return self._conv.full and bool(
+            np.all(self._conv.mean() < ORIG_ZERO_VEL_THR))
+
+    def _has_gridlocked(self, ca_active) -> bool:
+        self._grid.push(np.asarray(ca_active, dtype=np.float64))
+        return self._grid.full and bool(
+            np.any(self._grid.mean() > AVG_ACTIVE_CA_THR))
+
+    # -- transitions --
+
+    def _next_state(self, state: int, reset: bool = True) -> None:
+        self.last_state = self.state
+        self.state = state
+        self.timer_ticks = -1
+        if reset:
+            self._conv = _Buffer(self.window)
+            self._grid = _Buffer(self.window)
+        # gridlock episode bookkeeping (`supervisor.py:256-265`)
+        if self.state is TrialState.GRIDLOCK:
+            self._grid_enter_tick = self.tick_count
+        if self.last_state is TrialState.GRIDLOCK and self.time_avoidance:
+            self.time_avoidance[-1] = (
+                (self.tick_count - self._grid_enter_tick) * self.dt)
+        # a TERMINATE mid-formation finalizes the open log entry so times[]
+        # holds elapsed seconds, never a raw start tick (the reference never
+        # reads the open entry because it only writes the CSV on COMPLETE)
+        if self.state is TrialState.TERMINATE:
+            self._stop_logging()
+
+    def _start_logging(self) -> None:
+        if self.is_logging:
+            return
+        self.assignments.append(1)
+        self.times.append(self.tick_count)    # finalized in _stop_logging
+        self.time_avoidance.append(0.0)
+        self.is_logging = True
+        self._log_start_tick = self.tick_count
+
+    def _stop_logging(self) -> None:
+        if not self.is_logging:
+            return
+        self.is_logging = False
+        self.times[-1] = (self.tick_count - self.times[-1]) * self.dt
+
+    def _log_signals(self, q) -> None:
+        """EWMA position smoothing + planar distance (`supervisor.py:441-478`).
+        """
+        x, y = q[:, 0], q[:, 1]
+        if self._fx is None:
+            self._fx, self._fy = x.copy(), y.copy()
+            return
+        nx = EWMA_ALPHA * self._fx + (1 - EWMA_ALPHA) * x
+        ny = EWMA_ALPHA * self._fy + (1 - EWMA_ALPHA) * y
+        self.dist += np.hypot(nx - self._fx, ny - self._fy)
+        self._fx, self._fy = nx, ny
+
+    @property
+    def done(self) -> bool:
+        return self.state in (TrialState.COMPLETE, TrialState.TERMINATE)
+
+    @property
+    def completed(self) -> bool:
+        return self.state is TrialState.COMPLETE
+
+    def step(self, q, distcmd_norm, ca_active, assign_event):
+        """One supervisor tick (`supervisor.py:160-236`).
+
+        Args are this tick's signals: q (n, 3) true positions, (n,) |distcmd|,
+        (n,) collision-avoidance-active, and whether a new assignment was
+        accepted this tick. Returns an action for the driver: 'takeoff'
+        (send CMD_GO), 'dispatch' (commit the next formation in the group,
+        index `curr_formation_idx`), or None.
+        """
+        if self.done:
+            return None
+        self.timer_ticks += 1
+        self.tick_count += 1
+        if assign_event:
+            self.received_assignment = True
+            if self.is_logging:
+                self.assignments[-1] += 1
+        action = None
+        S = TrialState
+
+        if self.state is S.IDLE:
+            # has_sim_initialized is true by construction in the scan engine
+            # (no process bring-up), so IDLE emits 'takeoff' immediately; the
+            # reference's SIM_INIT_TIMEOUT escape has nothing to guard
+            action = "takeoff"
+            self._next_state(S.TAKING_OFF)
+
+        elif self.state is S.TAKING_OFF:
+            if self._has_taken_off(q):
+                self._next_state(S.HOVERING)
+            elif self._elapsed(TAKE_OFF_TIMEOUT):
+                self._next_state(S.TERMINATE)
+
+        elif self.state is S.HOVERING:
+            if self._elapsed(HOVER_WAIT):
+                if self.curr_formation_idx == self.n_formations - 1:
+                    self._next_state(S.COMPLETE)
+                else:
+                    self.curr_formation_idx += 1
+                    self.received_assignment = False
+                    action = "dispatch"
+                    self._next_state(S.WAITING_ON_ASSIGNMENT)
+
+        elif self.state is S.WAITING_ON_ASSIGNMENT:
+            if self.received_assignment:
+                self._start_logging()
+                self._next_state(S.FLYING)
+            elif self._elapsed(ASSIGNMENT_TIMEOUT):
+                self._next_state(S.TERMINATE)
+
+        elif self.state is S.FLYING:
+            if self._elapsed(FORMATION_RECEIVED_WAIT):
+                if self._has_converged(distcmd_norm):
+                    self._next_state(S.IN_FORMATION, reset=False)
+                elif self._has_gridlocked(ca_active):
+                    self._next_state(S.GRIDLOCK)
+
+        elif self.state is S.IN_FORMATION:
+            if self._elapsed(CONVERGED_WAIT):
+                self._stop_logging()
+                self._next_state(S.HOVERING)
+            elif not self._has_converged(distcmd_norm):
+                self._next_state(S.FLYING)
+
+        elif self.state is S.GRIDLOCK:
+            left = (not self._has_gridlocked(ca_active)) and self._grid.full
+            if left:
+                self._next_state(S.FLYING)
+            elif self._elapsed(GRIDLOCK_TIMEOUT):
+                self._next_state(S.TERMINATE)
+
+        if self.is_logging:
+            self._log_signals(q)
+
+        # trial watchdog (`supervisor.py:229-236`)
+        if self.tick_count * self.dt > TRIAL_TIMEOUT and not self.done:
+            self._next_state(S.TERMINATE)
+
+        return action
+
+    def csv_row(self, trial: int) -> list:
+        """The reference CSV schema (`supervisor.py:404-415`): [trial,
+        dist x n, time x K, time_avoidance x K, assignments x K]."""
+        return ([trial] + self.dist.tolist() + list(self.times)
+                + list(self.time_avoidance) + list(self.assignments))

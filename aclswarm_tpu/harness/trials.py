@@ -1,0 +1,326 @@
+"""Monte-Carlo trial driver: the reference's `trials.sh`/`trial.sh` stack.
+
+Spec (SURVEY.md §3.5): `trials.sh -f <formation> -m K -s` loops seeded
+trials; each `trial.sh` brings up roscore + n snap_sim + n vehicle stacks,
+generates a random formation for `simformN` configs
+(`generate_random_formation.py`, seed = trial number, box 15x15x2,
+`trial.sh:55-61`), samples non-overlapping initial circles (20 x 20 m area,
+0.75 m buffer radius, `trial.sh:7-9`, `start.sh:20-61`), runs
+`supervisor.py` as the experiment FSM, and appends one CSV row per
+*completed* trial (`supervisor.py:404-415`). `analyze_simtrials.m:38-59`
+reduces the CSV to completion %, time/avoidance/assignment statistics.
+
+Here the whole per-trial fleet is one jitted scan rollout
+(`aclswarm_tpu.sim.engine`), chunked so the host-side `TrialFSM`
+(`aclswarm_tpu.harness.supervisor`) can observe every control tick and
+steer the trial. FSM actions (CMD_GO, formation dispatch) take effect at the
+next chunk boundary — the analogue of the reference's dispatch latency
+(service call -> operator publish -> 5 Hz coordination spin + settle time,
+`coordination_ros.cpp:94-160`); chunks default to 0.5 s. Assignment events
+between a dispatch decision and its application are suppressed, since they
+belong to the outgoing formation.
+
+Run:
+    python -m aclswarm_tpu.harness.trials -f swarm6_3d -m 5 -s 1
+    python -m aclswarm_tpu.harness.trials -f simform10 -m 20 -s 1
+    python -m aclswarm_tpu.harness.trials --analyze trials.csv -n 6 -m 20
+Full parameterization is reproducible from a yaml file (--config) with CLI
+overrides (--set key=value), per SURVEY.md §5.6.
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import dataclasses
+import re
+import sys
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from aclswarm_tpu.core import config as configlib
+from aclswarm_tpu.core import perm as permutil
+from aclswarm_tpu.core.types import ControlGains, SafetyParams, make_formation
+from aclswarm_tpu.harness import formations as formlib
+from aclswarm_tpu.harness import formgen
+from aclswarm_tpu.harness.formations import FormationSpec
+from aclswarm_tpu.harness.supervisor import TRIAL_TIMEOUT, NAMES, TrialFSM
+
+
+@dataclasses.dataclass
+class TrialConfig:
+    """Trial parameterization. Defaults mirror the reference SIL trial
+    scripts (`trial.sh:7-9,55-61,96`, `coordination.launch:22-24`)."""
+
+    formation: str = "swarm6_3d"    # library group name, or simformN
+    library: Optional[str] = None   # formations.yaml path (None = shipped)
+    trials: int = 1                 # Monte-Carlo trial count (trials.sh -m)
+    seed: int = 1                   # trial t runs with seed+t (trial.sh:31)
+    out: str = "trials.csv"         # CSV results path (append, reference-style)
+    # engine knobs (SimConfig mirror)
+    assignment: str = "auction"     # auction | sinkhorn | cbaa
+    dynamics: str = "tracking"      # tracking | firstorder
+    tau: float = 0.15
+    control_dt: float = 0.01
+    assign_every: int = 120
+    colavoid_neighbors: Optional[int] = None
+    chunk_ticks: int = 50           # FSM action latency bound (0.5 s)
+    # initial-condition sampling (trial.sh:7-9: 20 x 20 area, r=0.75)
+    init_area_w: float = 20.0
+    init_area_h: float = 20.0
+    init_radius: float = 0.75
+    # room bounds (trial.sh:96)
+    room_x: float = 100.0
+    room_y: float = 100.0
+    room_z: float = 30.0
+    # simformN generation (trial.sh:60: -l 15 -w 15 -h 2)
+    sim_l: float = 15.0
+    sim_w: float = 15.0
+    sim_h: float = 2.0
+    sim_min_dist: float = 2.0
+    sim_formations: int = 2
+    verbose: bool = True
+
+
+_SIMFORM = re.compile(r"^simform(\d+)$")
+
+
+def _formations_for_trial(cfg: TrialConfig, seed: int
+                          ) -> list[FormationSpec]:
+    m = _SIMFORM.match(cfg.formation)
+    if m:
+        return formgen.generate_specs(
+            int(m.group(1)), seed=seed, l=cfg.sim_l, w=cfg.sim_w,
+            h=cfg.sim_h, min_dist=cfg.sim_min_dist, k=cfg.sim_formations)
+    return formlib.load_group(cfg.library, cfg.formation)
+
+
+def _gains_for(spec: FormationSpec) -> np.ndarray:
+    """Library gains if shipped, else the on-dispatch device ADMM solve
+    (`coordination_ros.cpp:112-119`)."""
+    if spec.gains is not None:
+        return np.asarray(spec.gains)
+    from aclswarm_tpu import gains as gainslib
+    return np.asarray(gainslib.solve_gains(spec.points, spec.adjmat))
+
+
+def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
+    """One seeded trial: ground start -> takeoff -> cycle through the
+    group's formations -> COMPLETE/TERMINATE. Returns the finished FSM."""
+    import jax.numpy as jnp
+
+    from aclswarm_tpu import sim
+
+    seed = cfg.seed + trial_idx
+    rng = np.random.default_rng(seed)
+    specs = _formations_for_trial(cfg, seed)
+    n = specs[0].n
+
+    # non-overlapping ground starts (start.sh:20-61; z = 0)
+    q0 = formgen.sample_cylinder_points(
+        rng, n, cfg.init_area_w, cfg.init_area_h, 0.0,
+        min_dist=2 * cfg.init_radius)
+
+    sparams = SafetyParams(
+        bounds_min=jnp.asarray([-cfg.room_x, -cfg.room_y, 0.0]),
+        bounds_max=jnp.asarray([cfg.room_x, cfg.room_y, cfg.room_z]))
+
+    engine_kw = dict(control_dt=cfg.control_dt, assign_every=cfg.assign_every,
+                     dynamics=cfg.dynamics, tau=cfg.tau,
+                     colavoid_neighbors=cfg.colavoid_neighbors,
+                     flight_fsm=True)
+    hover_cfg = sim.SimConfig(assignment="none", **engine_kw)
+    fly_cfg = sim.SimConfig(assignment=cfg.assignment, **engine_kw)
+
+    # pre-dispatch: no formation committed -> no graph, no gains, no control
+    hover_formation = make_formation(specs[0].points,
+                                     np.zeros((n, n)), None)
+    gains_cache: dict[int, np.ndarray] = {}
+
+    state = sim.init_state(q0, flying=False)
+    fsm = TrialFSM(n, len(specs), takeoff_alt=sparams.takeoff_alt,
+                   dt=cfg.control_dt)
+    cgains = ControlGains()
+
+    cur_formation, cur_cfg = hover_formation, hover_cfg
+    pending_go = False
+    pending_dispatch: Optional[int] = None
+    # the first valid auction after a formation commit always counts as an
+    # accepted assignment, even if unchanged — the reference's
+    # `formation_just_received_` semantics (`auctioneer.cpp:310-316`)
+    formation_just_received = False
+    chunk = cfg.chunk_ticks
+    max_ticks = int(TRIAL_TIMEOUT / cfg.control_dt) + 10 * chunk
+
+    for _ in range(max_ticks // chunk + 1):
+        if fsm.done:
+            break
+        cmd = np.zeros((chunk,), np.int32)
+        if pending_go:
+            cmd[0] = sim.vehicle.CMD_GO
+            pending_go = False
+        inputs = sim.ExternalInputs(
+            cmd=jnp.asarray(cmd),
+            joy_vel=jnp.zeros((chunk, n, 3), state.swarm.q.dtype),
+            joy_yawrate=jnp.zeros((chunk, n), state.swarm.q.dtype),
+            joy_active=jnp.zeros((chunk, n), bool))
+        state, metrics = sim.rollout(state, cur_formation, cgains, sparams,
+                                     cur_cfg, chunk, inputs)
+        q = np.asarray(metrics.q)
+        dn = np.asarray(metrics.distcmd_norm)
+        ca = np.asarray(metrics.ca_active)
+        reassigned = np.asarray(metrics.reassigned)
+        auction_ok = (np.asarray(metrics.auctioned)
+                      & np.asarray(metrics.assign_valid))
+
+        suppress_events = False
+        for t in range(chunk):
+            event = bool(reassigned[t])
+            if formation_just_received and bool(auction_ok[t]):
+                event = True
+                formation_just_received = False
+            event = event and not suppress_events
+            action = fsm.step(q[t], dn[t], ca[t], event)
+            if action == "takeoff":
+                pending_go = True
+            elif action == "dispatch":
+                pending_dispatch = fsm.curr_formation_idx
+                suppress_events = True   # stale events belong to the old form
+            if fsm.done:
+                break
+
+        if pending_dispatch is not None and not fsm.done:
+            spec = specs[pending_dispatch]
+            if pending_dispatch not in gains_cache:
+                gains_cache[pending_dispatch] = _gains_for(spec)
+            cur_formation = make_formation(spec.points, spec.adjmat,
+                                           gains_cache[pending_dispatch])
+            cur_cfg = fly_cfg
+            # the auctioneer resets to the identity assignment on a new
+            # formation (`auctioneer.cpp:42-62`)
+            state = state.replace(v2f=permutil.identity(n))
+            formation_just_received = True
+            pending_dispatch = None
+
+    return fsm
+
+
+def analyze(data: np.ndarray, n: int, m: int) -> dict:
+    """CSV reduction (`analyze_simtrials.m:38-59`): completion %, totals
+    across the formation cycle, mean/std statistics."""
+    data = np.atleast_2d(np.asarray(data, dtype=np.float64))
+    if data.size == 0:
+        return {"completion_pct": 0.0, "trials_completed": 0, "trials": m}
+    f = (data.shape[1] - 1 - n) // 3
+    r = 1
+    dist = data[:, r:r + n]; r += n
+    time = data[:, r:r + f]; r += f
+    coltime = data[:, r:r + f]; r += f
+    nassign = data[:, r:r + f]
+    total_time = time.sum(axis=1)
+    total_col = coltime.sum(axis=1)
+    total_assign = nassign.sum(axis=1)
+    avgdist = dist.mean(axis=1)
+    return {
+        "trials": m,
+        "trials_completed": int(data.shape[0]),
+        "completion_pct": 100.0 * data.shape[0] / m,
+        "formations_per_trial": int(f),
+        "time_mean_s": float(total_time.mean()),
+        "time_std_s": float(total_time.std()),
+        "colavoid_time_mean_s": float(total_col.mean()),
+        "colavoid_time_std_s": float(total_col.std()),
+        "assignments_mean": float(total_assign.mean()),
+        "assignments_std": float(total_assign.std()),
+        "dist_min_m": float(avgdist.min()),
+        "dist_mean_m": float(avgdist.mean()),
+        "dist_std_m": float(avgdist.std()),
+    }
+
+
+def print_analysis(stats: dict) -> None:
+    print(f"Completion: {stats['completion_pct']:.2f} % "
+          f"({stats['trials_completed']}/{stats['trials']})")
+    if stats["trials_completed"] == 0:
+        return
+    print(f"Average Time: {stats['time_mean_s']:.2f} s "
+          f"(std {stats['time_std_s']:.2f})")
+    print(f"Average Time in ColAvoid: {stats['colavoid_time_mean_s']:.2f} s "
+          f"(std {stats['colavoid_time_std_s']:.2f})")
+    print(f"Average Num Assignments: {stats['assignments_mean']:.2f} "
+          f"(std {stats['assignments_std']:.2f})")
+    print(f"Average Distance: min {stats['dist_min_m']:.2f} / "
+          f"mean {stats['dist_mean_m']:.2f} / std {stats['dist_std_m']:.2f} m")
+
+
+def run_trials(cfg: TrialConfig) -> dict:
+    """The `trials.sh` loop: K seeded trials, append completed rows to the
+    CSV, print the `analyze_simtrials` summary. Returns the stats dict."""
+    rows = []
+    n = None
+    for t in range(cfg.trials):
+        fsm = run_trial(cfg, t)
+        n = fsm.n
+        status = NAMES[fsm.state]
+        if cfg.verbose:
+            times = ", ".join(f"{x:.2f}" for x in fsm.times)
+            print(f"trial {t} (seed {cfg.seed + t}): {status}"
+                  f" [conv times: {times}]", flush=True)
+        if fsm.completed:
+            row = fsm.csv_row(t)
+            rows.append(row)
+            with open(cfg.out, "a", newline="") as f:
+                csv.writer(f).writerow(row)
+    if rows:
+        stats = analyze(np.asarray(rows, dtype=np.float64), n, cfg.trials)
+    else:
+        stats = analyze(np.empty((0, 0)), n or 0, cfg.trials)
+    if cfg.verbose:
+        print_analysis(stats)
+    return stats
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Monte-Carlo formation trials (trials.sh equivalent)")
+    ap.add_argument("-f", "--formation", default=None,
+                    help="formation group or simformN")
+    ap.add_argument("-m", "--trials", type=int, default=None)
+    ap.add_argument("-s", "--seed", type=int, default=None)
+    ap.add_argument("-o", "--out", default=None, help="CSV output path")
+    ap.add_argument("--config", default=None, help="yaml config file")
+    ap.add_argument("--set", action="append", default=[],
+                    metavar="KEY=VALUE", help="config override")
+    ap.add_argument("--save-config", default=None,
+                    help="write the resolved config to this yaml and exit")
+    ap.add_argument("--analyze", default=None, metavar="CSV",
+                    help="only analyze an existing results file")
+    ap.add_argument("-n", "--agents", type=int, default=None,
+                    help="(with --analyze) vehicle count of the CSV")
+    args = ap.parse_args(argv)
+
+    if args.analyze:
+        if args.agents is None or args.trials is None:
+            ap.error("--analyze requires -n (agents) and -m (total trials)")
+        data = np.loadtxt(args.analyze, delimiter=",", ndmin=2)
+        print_analysis(analyze(data, args.agents, args.trials))
+        return 0
+
+    overrides = dict(configlib.parse_overrides(args.set))
+    for key in ("formation", "trials", "seed", "out"):
+        val = getattr(args, key)
+        if val is not None:
+            overrides[key] = str(val)
+    cfg = configlib.load_layers(TrialConfig, file=args.config,
+                                overrides=overrides)
+    if args.save_config:
+        configlib.to_yaml(cfg, args.save_config)
+        print(f"wrote {args.save_config}")
+        return 0
+    stats = run_trials(cfg)
+    return 0 if stats["trials_completed"] == stats["trials"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

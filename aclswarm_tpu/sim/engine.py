@@ -87,6 +87,7 @@ class StepMetrics:
     ca_active: jnp.ndarray      # (n,) collision avoidance modified the cmd
     assign_valid: jnp.ndarray   # () bool: this tick's auction produced a perm
     reassigned: jnp.ndarray     # () bool: assignment changed this tick
+    auctioned: jnp.ndarray      # () bool: an auction ran this tick
     q: jnp.ndarray              # (n, 3) positions after the tick
     mode: jnp.ndarray           # (n,) int32 flight mode after the tick
 
@@ -170,6 +171,8 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
             lambda s, f, p: (p, jnp.asarray(True)),
             swarm, formation, v2f)
     reassigned = do_assign & jnp.any(new_v2f != v2f)
+    auctioned = (do_assign if cfg.assignment != "none"
+                 else jnp.asarray(False))
     v2f = new_v2f
 
     # --- distributed control law -> distcmd (§3.3) ---
@@ -212,7 +215,8 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
                          tick=state.tick + 1, flight=fs)
     return new_state, StepMetrics(distcmd_norm=distcmd_norm, ca_active=ca,
                                   assign_valid=valid, reassigned=reassigned,
-                                  q=swarm.q, mode=fs.mode)
+                                  auctioned=auctioned, q=swarm.q,
+                                  mode=fs.mode)
 
 
 @partial(jax.jit, static_argnames=("n_ticks", "cfg"))

@@ -146,8 +146,16 @@ def flight_step(fs: FlightState, goal_prev: TrajGoal, safe_goal: TrajGoal,
     # --- TAKEOFF ramp after spinup (:248-258) ---
     spun_up = (ticks.astype(dtype) * dt) >= params.spinup_time
     tk = (m == TAKEOFF) & spun_up
+    # completion: the z ramp has clamped at takeoff_alt and tracking has
+    # caught up. The reference tests |goal_z - takeoff_alt| < 0.1 instead of
+    # ramp-clamp; with its laggy autopilot the ramp reaches the clamp before
+    # tracking error drops below 0.1 anyway, while with this sim's
+    # tight-tracking dynamics the 0.1 test would stop 0.1 m short and break
+    # the trial supervisor's has_taken_off (|z - takeoff_alt| < 0.05,
+    # `aclswarm_sim/nodes/supervisor.py:285-291`). Requiring the clamp keeps
+    # the whole stack self-consistent at z = takeoff_alt exactly.
     tk_done = tk & (jnp.abs(pos[:, 2] - qz) < TAKEOFF_THRESHOLD) \
-        & (jnp.abs(pos[:, 2] - takeoff_alt) < TAKEOFF_THRESHOLD)
+        & (pos[:, 2] >= takeoff_alt - 1e-6)
     ramping = tk & ~tk_done
     ramp_z = jnp.clip(pos[:, 2] + params.takeoff_inc, 0.0, takeoff_alt)
     ramp_vz = jnp.where(ramping, (ramp_z - pos[:, 2]) / dt, 0.0)

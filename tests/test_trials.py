@@ -1,0 +1,242 @@
+"""Trial harness tests: random formation generator, config layering, the
+full trial FSM, and end-to-end Monte-Carlo trials.
+
+Specs: `aclswarm_sim/nodes/generate_random_formation.py` (formgen),
+`aclswarm_sim/nodes/supervisor.py` (FSM), `trials.sh`/`trial.sh` (driver),
+`analyze_simtrials.m` (analysis), SURVEY.md §5.6 (config layers).
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from aclswarm_tpu.core import config as configlib
+from aclswarm_tpu.harness import formgen, supervisor, trials
+from aclswarm_tpu.harness.supervisor import TrialFSM, TrialState
+
+
+# ---------------------------------------------------------------- formgen
+
+def test_formgen_spacing_seed_and_format():
+    group = formgen.generate_group(10, seed=42, l=15, w=15, h=2)
+    assert group["agents"] == 10
+    assert len(group["formations"]) == 2
+    for f in group["formations"]:
+        pts = np.asarray(f["points"])
+        assert pts.shape == (10, 3)
+        # box bounds (generate_random_formation.py:20-24)
+        assert np.all(np.abs(pts[:, 0]) <= 7.5)
+        assert np.all((pts[:, 2] >= 0) & (pts[:, 2] <= 2))
+        # cylinder non-overlap: pairwise xy distance >= min_dist
+        d = np.linalg.norm(pts[:, None, :2] - pts[None, :, :2], axis=-1)
+        d[np.eye(10, dtype=bool)] = np.inf
+        assert d.min() >= 2.0
+    # determinism + seed sensitivity
+    again = formgen.generate_group(10, seed=42, l=15, w=15, h=2)
+    assert group == again
+    other = formgen.generate_group(10, seed=43, l=15, w=15, h=2)
+    assert group != other
+
+
+def test_formgen_adjmat_rules():
+    rng = np.random.default_rng(0)
+    # n < 5 is always fully connected (generate_random_formation.py:118-120)
+    A = formgen.random_adjmat(rng, 4, fc=False)
+    assert np.array_equal(A, np.ones((4, 4)) - np.eye(4))
+    # sparse removals: symmetric, zero diagonal, at most n-4 edges removed
+    for _ in range(20):
+        n = 10
+        A = formgen.random_adjmat(rng, n, fc=False)
+        assert np.array_equal(A, A.T)
+        assert np.all(np.diag(A) == 0)
+        removed = (n * (n - 1)) // 2 - int(A.sum()) // 2
+        assert 0 <= removed <= n - 4
+
+
+def test_formgen_graphs_stay_rigid():
+    """The <= n-4 removal rule keeps generic 2D rigidity — check with the
+    rigidity-matrix rank on the sampled (generic) points."""
+    for seed in range(8):
+        specs = formgen.generate_specs(12, seed=seed)
+        for s in specs:
+            assert formgen.is_rigid_2d(s.points, s.adjmat), seed
+
+
+def test_rigidity_check_detects_flexible_graph():
+    rng = np.random.default_rng(1)
+    pts = rng.normal(size=(6, 3))
+    # a path graph is flexible
+    A = np.zeros((6, 6))
+    for i in range(5):
+        A[i, i + 1] = A[i + 1, i] = 1
+    assert not formgen.is_rigid_2d(pts, A)
+    # the complete graph is rigid
+    assert formgen.is_rigid_2d(pts, np.ones((6, 6)) - np.eye(6))
+
+
+# ----------------------------------------------------------------- config
+
+def test_config_layering(tmp_path):
+    p = tmp_path / "trial.yaml"
+    p.write_text("formation: simform8\ntrials: 5\ntau: 0.2\n")
+    cfg = configlib.load_layers(trials.TrialConfig, file=p,
+                                overrides=["trials=7", "seed=3",
+                                           "colavoid_neighbors=none"])
+    assert cfg.formation == "simform8"   # file beats default
+    assert cfg.trials == 7               # cli beats file
+    assert cfg.tau == 0.2
+    assert cfg.seed == 3
+    assert cfg.colavoid_neighbors is None
+    # defaults fill the rest
+    assert cfg.assignment == "auction"
+    with pytest.raises(KeyError):
+        configlib.load_layers(trials.TrialConfig, overrides=["nope=1"])
+
+
+def test_config_roundtrip_yaml(tmp_path):
+    cfg = trials.TrialConfig(formation="simform6", trials=2, seed=9)
+    out = tmp_path / "resolved.yaml"
+    configlib.to_yaml(cfg, out)
+    cfg2 = configlib.load_layers(trials.TrialConfig, file=out)
+    assert cfg2 == cfg
+
+
+# ---------------------------------------------------------------- TrialFSM
+
+def _tick_n(fsm, k, q, dn, ca, ev=False):
+    acts = []
+    for _ in range(k):
+        acts.append(fsm.step(q, dn, ca, ev))
+        ev = False
+    return acts
+
+
+def test_trial_fsm_happy_path():
+    """IDLE -> TAKING_OFF -> HOVERING -> WAITING -> FLYING -> IN_FORMATION
+    -> HOVERING -> ... -> COMPLETE with reference timing semantics."""
+    n, dt = 3, 0.1
+    fsm = TrialFSM(n, n_formations=1, takeoff_alt=1.0, dt=dt)
+    ground = np.zeros((n, 3))
+    air = np.array([[0, 0, 1.0]] * n)
+    quiet = np.zeros(n)
+    no_ca = np.zeros(n, bool)
+
+    assert fsm.step(ground, quiet, no_ca, False) == "takeoff"
+    assert fsm.state == TrialState.TAKING_OFF
+    # not at altitude yet
+    _tick_n(fsm, 5, ground, quiet, no_ca)
+    assert fsm.state == TrialState.TAKING_OFF
+    fsm.step(air, quiet, no_ca, False)
+    assert fsm.state == TrialState.HOVERING
+    # HOVER_WAIT (5 s) then dispatch formation 0
+    acts = _tick_n(fsm, int(5 / dt) + 1, air, quiet, no_ca)
+    assert acts[-1] == "dispatch"
+    assert fsm.curr_formation_idx == 0
+    assert fsm.state == TrialState.WAITING_ON_ASSIGNMENT
+    # assignment event -> FLYING, logging starts
+    fsm.step(air, quiet, no_ca, True)
+    assert fsm.state == TrialState.FLYING
+    assert fsm.is_logging and fsm.assignments == [1]
+    # 1 s formation wait + 1 s convergence buffer -> IN_FORMATION
+    _tick_n(fsm, int(2 / dt) + 2, air, quiet, no_ca)
+    assert fsm.state == TrialState.IN_FORMATION
+    # CONVERGED_WAIT -> back to HOVERING, logging stopped
+    _tick_n(fsm, int(1 / dt) + 1, air, quiet, no_ca)
+    assert fsm.state == TrialState.HOVERING
+    assert not fsm.is_logging
+    assert len(fsm.times) == 1 and fsm.times[0] > 0
+    # all formations done -> COMPLETE after hover wait
+    _tick_n(fsm, int(5 / dt) + 1, air, quiet, no_ca)
+    assert fsm.completed
+    row = fsm.csv_row(0)
+    assert len(row) == 1 + n + 3 * 1
+
+
+def test_trial_fsm_assignment_timeout():
+    n, dt = 3, 0.1
+    fsm = TrialFSM(n, 1, takeoff_alt=1.0, dt=dt)
+    air = np.array([[0, 0, 1.0]] * n)
+    quiet = np.zeros(n)
+    no_ca = np.zeros(n, bool)
+    fsm.step(np.zeros((n, 3)), quiet, no_ca, False)       # takeoff
+    fsm.step(air, quiet, no_ca, False)                    # -> HOVERING
+    _tick_n(fsm, int(5 / dt) + 1, air, quiet, no_ca)      # -> WAITING
+    assert fsm.state == TrialState.WAITING_ON_ASSIGNMENT
+    # no assignment ever arrives -> TERMINATE after 20 s
+    _tick_n(fsm, int(supervisor.ASSIGNMENT_TIMEOUT / dt) + 2,
+            air, quiet, no_ca)
+    assert fsm.state == TrialState.TERMINATE
+
+
+def test_trial_fsm_gridlock_episode_logged():
+    n, dt = 2, 0.1
+    fsm = TrialFSM(n, 1, takeoff_alt=1.0, dt=dt)
+    air = np.array([[0, 0, 1.0]] * n)
+    quiet = np.zeros(n)
+    loud = np.full(n, 5.0)
+    no_ca = np.zeros(n, bool)
+    all_ca = np.ones(n, bool)
+    fsm.step(np.zeros((n, 3)), quiet, no_ca, False)
+    fsm.step(air, quiet, no_ca, False)
+    _tick_n(fsm, int(5 / dt) + 1, air, quiet, no_ca)
+    fsm.step(air, quiet, no_ca, True)                     # -> FLYING
+    # not converged + full CA buffer -> GRIDLOCK
+    _tick_n(fsm, int(2 / dt) + 2, air, loud, all_ca)
+    assert fsm.state == TrialState.GRIDLOCK
+    # leave gridlock (buffer must refill with quiet CA), then converge
+    _tick_n(fsm, int(1 / dt) + 1, air, quiet, no_ca)
+    assert fsm.state == TrialState.FLYING
+    _tick_n(fsm, int(2 / dt) + 2, air, quiet, no_ca)
+    assert fsm.state == TrialState.IN_FORMATION
+    _tick_n(fsm, int(1 / dt) + 1, air, quiet, no_ca)
+    _tick_n(fsm, int(5 / dt) + 1, air, quiet, no_ca)
+    assert fsm.completed
+    # the gridlock episode duration landed in time_avoidance
+    assert fsm.time_avoidance[0] > 0
+
+
+# ------------------------------------------------------------- end-to-end
+
+def test_monte_carlo_simform_trial(tmp_path):
+    """Seeded simformN trial completes, writes the reference CSV schema,
+    and the analysis reduces it (`analyze_simtrials.m:38-59`)."""
+    out = tmp_path / "mc.csv"
+    cfg = trials.TrialConfig(formation="simform8", trials=2, seed=1,
+                             out=str(out), verbose=False)
+    stats = trials.run_trials(cfg)
+    assert stats["trials_completed"] == 2
+    assert stats["completion_pct"] == 100.0
+    data = np.loadtxt(out, delimiter=",", ndmin=2)
+    n, f = 8, 2
+    assert data.shape == (2, 1 + n + 3 * f)
+    # trial numbers, positive convergence times, assignment counts >= 1
+    assert list(data[:, 0]) == [0.0, 1.0]
+    assert np.all(data[:, 1 + n:1 + n + f] > 0)
+    assert np.all(data[:, 1 + n + 2 * f:] >= 1)
+    # determinism: same seed -> identical trial outcome
+    out2 = tmp_path / "mc2.csv"
+    cfg2 = dataclasses.replace(cfg, out=str(out2), trials=1)
+    trials.run_trials(cfg2)
+    data2 = np.loadtxt(out2, delimiter=",", ndmin=2)
+    np.testing.assert_allclose(data2[0], data[0], rtol=1e-12)
+
+
+def test_trials_cli(tmp_path):
+    out = tmp_path / "cli.csv"
+    rc = trials.main(["-f", "simform6", "-m", "1", "-s", "2",
+                      "-o", str(out), "--set", "verbose=false"])
+    assert rc == 0
+    assert out.exists()
+    # analysis entry point over the written file
+    rc = trials.main(["--analyze", str(out), "-n", "6", "-m", "1"])
+    assert rc == 0
+
+
+def test_library_group_trial_runs(tmp_path):
+    """A library-group trial (swarm4, precalc'd gains, complete graph) runs
+    the full lifecycle through the driver."""
+    out = tmp_path / "sw4.csv"
+    cfg = trials.TrialConfig(formation="swarm4", trials=1, seed=3,
+                             out=str(out), verbose=False)
+    stats = trials.run_trials(cfg)
+    assert stats["trials_completed"] == 1

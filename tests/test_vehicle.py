@@ -79,13 +79,11 @@ def test_takeoff_ramp_and_completion():
     # ramp: z increases by takeoff_inc per tick once spun up
     dz = q[spinup_ticks + 10, :, 2] - q[spinup_ticks + 9, :, 2]
     assert np.allclose(dz, sp.takeoff_inc, atol=1e-9)
-    # takeoff completes near takeoff_alt (+0 initial alt) within threshold
-    # (the 0.1 m completion threshold fires a little before the ramp tops out)
+    # takeoff completes when the ramp clamps at takeoff_alt (+0 initial alt)
     ramp_ticks = int(np.ceil(sp.takeoff_alt / sp.takeoff_inc))
     done = spinup_ticks + ramp_ticks + 5
     assert np.all(mode[done] == FLYING)
-    assert np.all(np.abs(q[done, :, 2] - sp.takeoff_alt)
-                  < vehicle.TAKEOFF_THRESHOLD + 1e-6)
+    assert np.all(np.abs(q[done, :, 2] - sp.takeoff_alt) < 1e-6)
     # xy untouched while still in TAKEOFF (control only engages in FLYING)
     t_first_fly = int(np.argmax(np.any(mode == FLYING, axis=1)))
     assert np.allclose(q[t_first_fly - 1, :, :2], q0[:, :2], atol=1e-6)
