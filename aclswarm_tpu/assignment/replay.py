@@ -1,0 +1,98 @@
+"""Auction record/replay: deterministic cross-validation of the device CBAA.
+
+The reference's answer to "how do you test a distributed algorithm
+deterministically" (SURVEY.md §4.2): the C++ auctioneer dumps every accepted
+assignment as a binary record {n, q, adjmat, sigma1, p, aligned, sigma2}
+(`auctioneer.cpp:577-597` logAssignment) and `matlab/test_alignment.m:14-31`
+reloads it, re-runs the sequential MATLAB CBAA on the same inputs, and
+compares. Here:
+
+- `record_auctions` extracts the same records from a recorded rollout
+  (`sim.rollout` metrics carry per-tick q and v2f, so the auction inputs at
+  tick t are the previous tick's outputs);
+- `save_records`/`load_records` persist them (npz instead of the
+  reference's raw binary — same fields);
+- `replay_record` re-runs both the sequential NumPy oracle
+  (`assignment.cbaa_ref`) and the device kernel (`assignment.cbaa`) on the
+  recorded inputs and compares their decisions.
+"""
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class AuctionRecord:
+    """One auction event (the logAssignment fields, `auctioneer.cpp:577-597`:
+    n, q, adjmat, sigma1=P_prev, p, aligned, sigma2=result)."""
+
+    q: np.ndarray        # (n, 3) swarm positions at auction start
+    points: np.ndarray   # (n, 3) formation points
+    adjmat: np.ndarray   # (n, n)
+    v2f_prev: np.ndarray  # (n,) sigma1: assignment before the auction
+    v2f_new: np.ndarray  # (n,) sigma2: assignment after
+
+
+def record_auctions(metrics, q0, v2f0, formation) -> list[AuctionRecord]:
+    """Extract auction events from rollout metrics.
+
+    The engine auctions on the pre-step state, so the inputs of an auction
+    at tick t are the tick t-1 outputs (q0/v2f0 for t = 0).
+    """
+    auctioned = np.asarray(metrics.auctioned)
+    q = np.asarray(metrics.q)
+    v2f = np.asarray(metrics.v2f)
+    points = np.asarray(formation.points)
+    adjmat = np.asarray(formation.adjmat)
+    out = []
+    for t in np.nonzero(auctioned)[0]:
+        q_in = q[t - 1] if t > 0 else np.asarray(q0)
+        v2f_in = v2f[t - 1] if t > 0 else np.asarray(v2f0)
+        out.append(AuctionRecord(q=q_in, points=points, adjmat=adjmat,
+                                 v2f_prev=v2f_in, v2f_new=v2f[t]))
+    return out
+
+
+def save_records(records: list[AuctionRecord], path: str | Path) -> None:
+    arrays = {}
+    for k, r in enumerate(records):
+        for f in dataclasses.fields(AuctionRecord):
+            arrays[f"{k}_{f.name}"] = getattr(r, f.name)
+    np.savez_compressed(path, n_records=len(records), **arrays)
+
+
+def load_records(path: str | Path) -> list[AuctionRecord]:
+    data = np.load(path)
+    out = []
+    for k in range(int(data["n_records"])):
+        out.append(AuctionRecord(**{
+            f.name: data[f"{k}_{f.name}"]
+            for f in dataclasses.fields(AuctionRecord)}))
+    return out
+
+
+def replay_record(rec: AuctionRecord) -> dict:
+    """Replay one record through the sequential oracle and the device CBAA
+    kernel; returns both results plus agreement flags."""
+    import jax.numpy as jnp
+
+    from aclswarm_tpu.assignment import cbaa, cbaa_ref
+
+    oracle = cbaa_ref.cbaa_oracle(rec.q, rec.points, rec.adjmat,
+                                  rec.v2f_prev)
+    dev = cbaa.cbaa_from_state(jnp.asarray(rec.q), jnp.asarray(rec.points),
+                               jnp.asarray(rec.adjmat),
+                               jnp.asarray(rec.v2f_prev, jnp.int32))
+    dev_f2v = np.asarray(dev.f2v)
+    dev_valid = bool(dev.valid)
+    return {
+        "oracle": oracle,
+        "device_f2v": dev_f2v,
+        "device_valid": dev_valid,
+        "match": (dev_valid == oracle["valid"]
+                  and (not dev_valid
+                       or np.array_equal(dev_f2v, oracle["f2v"]))),
+    }

@@ -28,7 +28,7 @@ class SinkhornResult(NamedTuple):
     err: jnp.ndarray         # () final row-marginal L1 error
 
 
-def sinkhorn_log(cost: jnp.ndarray, tau: float = 0.05,
+def sinkhorn_log(cost: jnp.ndarray, tau: float = 0.03,
                  n_iters: int = 200) -> jnp.ndarray:
     """Log-domain Sinkhorn on a square cost matrix; returns log plan (n, n).
 
@@ -179,7 +179,7 @@ def two_opt_refine(cost: jnp.ndarray, v2f: jnp.ndarray,
 
 
 def sinkhorn_assign(q: jnp.ndarray, p_aligned: jnp.ndarray,
-                    tau: float = 0.05, n_iters: int = 200,
+                    tau: float = 0.03, n_iters: int = 200,
                     rounding: str = "dominant",
                     refine_sweeps: int = 20) -> SinkhornResult:
     """Fast assignment: vehicle->point distances, Sinkhorn, rounding, repair.

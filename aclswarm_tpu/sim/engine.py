@@ -90,6 +90,7 @@ class StepMetrics:
     auctioned: jnp.ndarray      # () bool: an auction ran this tick
     q: jnp.ndarray              # (n, 3) positions after the tick
     mode: jnp.ndarray           # (n,) int32 flight mode after the tick
+    v2f: jnp.ndarray            # (n,) assignment after the tick
 
 
 def init_state(q0, v2f0=None, flying: bool = True) -> SimState:
@@ -216,7 +217,7 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
     return new_state, StepMetrics(distcmd_norm=distcmd_norm, ca_active=ca,
                                   assign_valid=valid, reassigned=reassigned,
                                   auctioned=auctioned, q=swarm.q,
-                                  mode=fs.mode)
+                                  mode=fs.mode, v2f=v2f)
 
 
 @partial(jax.jit, static_argnames=("n_ticks", "cfg"))

@@ -1,61 +1,48 @@
 """Benchmark driver contract: prints ONE JSON line.
 
-Headline metric: the centralized assignment pipeline (align + cdist + LAP) —
-the only hard number the reference publishes: "for n = 15, takes 5-10 ms"
-on the base-station CPU (`aclswarm/nodes/operator.py:241`, BASELINE.md).
-We time the identical pipeline (2D Umeyama alignment, pairwise distances,
-exact LAP via the device auction kernel) fully jitted on one TPU chip and
-report throughput in assignments/second; ``vs_baseline`` is the speedup over
-the reference's midpoint (7.5 ms => 133.3 Hz).
+Headline metric (the north star, BASELINE.md): n=1000 swarm assignment on
+one TPU chip, reported as sustained assignment throughput. The reference's
+centralized path does align + cdist + Hungarian for n=15 in 5-10 ms on a
+base-station CPU (`aclswarm/nodes/operator.py:241`); its decentralized path
+needs 2n sequential bid rounds. The target here is >= 100 Hz at n=1000
+(`vs_baseline` = value / 100 Hz).
+
+Methodology (pinned after round-1 variance, see VERDICT r1 weak #9):
+- Work is chained inside a single jit: `lax.scan` over K=50 *distinct*
+  problem instances, so the device cannot dedupe repeated dispatches and
+  each scan step is a true dependent computation. Reported value =
+  wall-clock / K, median of 5 repeats (median kills one-off host jitter).
+- This is sustained throughput, not single-shot dispatch latency: this
+  environment adds a fixed ~100 ms per-executable-launch overhead through
+  the remote-TPU tunnel (measured: a no-op jit call is ~micro-seconds, any
+  kernel-sized program pays ~100 ms per launch regardless of how much work
+  is inside), which would swamp a single ~3.5 ms assignment. Amortizing
+  over a scanned chain measures the device, not the tunnel.
+- Quality is guarded, not assumed: the same kernel config is checked
+  against the exact host LAP (`assignment.lapjv`) and the line includes the
+  measured suboptimality ratio (target <= 2%).
 """
 import json
-import time
+import sys
+from pathlib import Path
 
-import numpy as np
-
-BASELINE_HZ = 1000.0 / 7.5  # operator.py:241 midpoint
+BASELINE_HZ = 100.0  # north-star target at n=1000 (BASELINE.md)
+N = 1000
+K = 50
 
 
 def main():
-    import jax
-    import jax.numpy as jnp
+    # single source of truth for the measurement lives in benchmarks/scale.py
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "benchmarks"))
+    from scale import sinkhorn_throughput
 
-    from aclswarm_tpu.assignment import auction
-    from aclswarm_tpu.core import geometry
-    from aclswarm_tpu.core import perm as permutil
-
-    n = 15
-    rng = np.random.default_rng(0)
-    points = rng.normal(size=(n, 3)) * 3.0
-    q = rng.normal(size=(n, 3)) * 3.0
-    v2f = jnp.asarray(rng.permutation(n).astype(np.int32))
-
-    @jax.jit
-    def assign(q, points, v2f):
-        q_form = permutil.veh_to_formation_order(q, v2f)
-        paligned = geometry.align(points, q_form, d=2)
-        res = auction.auction_lap(-geometry.cdist(q, paligned))
-        return res.row_to_col
-
-    qd = jnp.asarray(q, jnp.float32)
-    pd = jnp.asarray(points, jnp.float32)
-    out = assign(qd, pd, v2f)
-    jax.block_until_ready(out)  # compile + warm
-
-    # block every call: the baseline is a *latency* figure, so measure
-    # latency, not pipelined dispatch throughput
-    iters = 200
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        jax.block_until_ready(assign(qd, pd, v2f))
-    dt = (time.perf_counter() - t0) / iters
-    hz = 1.0 / dt
-
+    sk = sinkhorn_throughput(N, K, reps=5)
     print(json.dumps({
-        "metric": "central_assignment_n15_hz",
-        "value": round(hz, 1),
+        "metric": f"sinkhorn_assign_n{N}_hz",
+        "value": round(sk["hz"], 1),
         "unit": "Hz",
-        "vs_baseline": round(hz / BASELINE_HZ, 2),
+        "vs_baseline": round(sk["hz"] / BASELINE_HZ, 2),
+        "subopt_vs_lap": round(sk["subopt"], 4),
     }))
 
 

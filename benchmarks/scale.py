@@ -1,11 +1,18 @@
 """Scale benchmarks: the north-star numbers (BASELINE.md) on real hardware.
 
-Prints one JSON line per metric. Methodology: work is chained inside a
-single jit (scan over distinct inputs or dependent rollout steps) so numbers
-are true per-op latencies, not pipelined-dispatch artifacts (the device
-runtime dedupes identical repeated dispatches).
+Prints one JSON line per metric and (with --out) appends them to a results
+file for committing as artifacts.
 
-Run: python benchmarks/scale.py [--n 1000] [--quick]
+Methodology (pinned, see also bench.py): every metric chains K *distinct*
+problem instances inside one jitted `lax.scan`, so numbers are sustained
+per-instance throughput, immune to both dispatch-dedupe and the ~100 ms
+fixed per-executable-launch overhead this environment's remote-TPU tunnel
+adds (which would dominate any single-shot measurement; single-shot latency
+is reported separately as *_latency_ms for honesty). Medians of `reps`
+repeats.
+
+Run: python benchmarks/scale.py [--n 1000] [--quick] [--sharded]
+     [--out benchmarks/results/scale.json]
 """
 from __future__ import annotations
 
@@ -20,7 +27,55 @@ import numpy as np
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
-def bench_all(n: int, quick: bool = False):
+def _median_time(fn, arg, per: int, reps: int) -> float:
+    import jax
+    jax.block_until_ready(fn(arg))
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(arg))
+        times.append((time.perf_counter() - t0) / per)
+    return float(np.median(times))
+
+
+def sinkhorn_throughput(n: int, K: int, reps: int, n_iters: int = 50,
+                        seed: int = 0) -> dict:
+    """The headline measurement, shared with the repo-root `bench.py`
+    driver contract: sustained Hz over a scanned chain of K distinct
+    instances + suboptimality vs the exact host LAP. One source of truth
+    for the pinned methodology."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from aclswarm_tpu.assignment import lapjv, sinkhorn
+    from aclswarm_tpu.core import geometry
+
+    rng = np.random.default_rng(seed)
+    p = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32) * 20)
+    qs = jnp.asarray(rng.normal(size=(K, n, 3)).astype(np.float32) * 20)
+
+    def chain(qs):
+        def body(c, q):
+            r = sinkhorn.sinkhorn_assign(q, p, n_iters=n_iters)
+            return c + r.row_to_col.sum(), None
+        return lax.scan(body, jnp.int32(0), qs)[0]
+
+    dt = _median_time(jax.jit(chain), qs, K, reps)
+
+    f1 = jax.jit(
+        lambda q: sinkhorn.sinkhorn_assign(q, p, n_iters=n_iters).row_to_col)
+    latency = _median_time(f1, qs[0], 1, reps)
+    v = np.asarray(f1(qs[0]))
+    cost = np.asarray(geometry.cdist(qs[0], p))
+    opt = cost[np.arange(n), lapjv(cost)].sum()
+    subopt = float(cost[np.arange(n), v].sum() / opt - 1.0)
+    return {"hz": 1.0 / dt, "latency_ms": latency * 1000.0,
+            "subopt": subopt, "chain_k": K, "n_iters": n_iters}
+
+
+def bench_all(n: int, quick: bool = False, sharded: bool = False,
+              out: str | None = None):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -33,12 +88,16 @@ def bench_all(n: int, quick: bool = False):
 
     rng = np.random.default_rng(0)
     results = []
+    reps = 2 if quick else 5
 
-    def emit(metric, value, unit, baseline=None):
+    def emit(metric, value, unit, baseline=None, **extra):
         row = {"metric": metric, "value": round(float(value), 3),
-               "unit": unit}
+               "unit": unit,
+               "device": jax.devices()[0].platform,
+               "n_devices": len(jax.devices())}
         if baseline is not None:
             row["vs_baseline"] = round(float(value) / baseline, 2)
+        row.update(extra)
         results.append(row)
         print(json.dumps(row))
 
@@ -57,10 +116,7 @@ def bench_all(n: int, quick: bool = False):
     ticks = 50 if quick else 200
     roll = jax.jit(lambda s: sim.rollout(s, f, ControlGains(), sp, cfg,
                                          ticks)[0])
-    jax.block_until_ready(roll(st))
-    t0 = time.perf_counter()
-    jax.block_until_ready(roll(st))
-    dt = (time.perf_counter() - t0) / ticks
+    dt = _median_time(roll, st, ticks, reps)
     # the pruning parameter is part of the metric name: with k-neighbor
     # pruning the avoidance kernel is approximate when > k vehicles are
     # inside d_avoid_thresh (see control.collision_avoidance)
@@ -68,53 +124,81 @@ def bench_all(n: int, quick: bool = False):
     emit(f"control_tick_n{n}{ca_tag}_hz", 1.0 / dt, "Hz", baseline=100.0)
 
     # --- sinkhorn assignment at scale (chained over distinct instances) ---
-    K = 5 if quick else 20
-    qs = jnp.asarray(rng.normal(size=(K, n, 3)).astype(np.float32) * 20)
-    p = jnp.asarray(pts)
+    K = 10 if quick else 50
+    n_iters = 50
+    sk = sinkhorn_throughput(n, K, reps, n_iters=n_iters)
+    emit(f"sinkhorn_assign_n{n}_hz", sk["hz"], "Hz", baseline=100.0,
+         chain_k=K)
+    # single-shot latency (includes this environment's fixed per-launch
+    # tunnel overhead — see module docstring; honest but pessimistic)
+    emit(f"sinkhorn_assign_n{n}_latency_ms", sk["latency_ms"], "ms")
+    emit(f"sinkhorn_assign_n{n}_subopt", sk["subopt"], "ratio")
 
-    def chain(qs):
-        def body(c, q):
-            r = sinkhorn.sinkhorn_assign(q, p, n_iters=50)
-            return c + r.row_to_col.sum(), None
-        return lax.scan(body, jnp.int32(0), qs)[0]
+    # --- sharded assignment over the device mesh (agent-axis GSPMD) ---
+    if sharded and len(jax.devices()) > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
-    fj = jax.jit(chain)
-    jax.block_until_ready(fj(qs))
-    t0 = time.perf_counter()
-    jax.block_until_ready(fj(qs))
-    dt = (time.perf_counter() - t0) / K
-    emit(f"sinkhorn_assign_n{n}_hz", 1.0 / dt, "Hz", baseline=100.0)
+        from aclswarm_tpu.parallel import mesh as meshlib
+        # the mesh helper trims to the largest device count dividing n
+        mesh = meshlib.make_mesh(n_agents=n)
+        ndev = len(mesh.devices.ravel())
+        qs = jnp.asarray(rng.normal(size=(K, n, 3)).astype(np.float32) * 20)
+        p = jnp.asarray(pts)
+        row_t = NamedSharding(mesh, P(None, meshlib.AGENT_AXIS))
+        rep = meshlib.replicated(mesh)
 
-    # quality vs exact LAP
-    v = np.asarray(jax.jit(
-        lambda q: sinkhorn.sinkhorn_assign(q, p, n_iters=50).row_to_col)(
-            qs[0]))
-    cost = np.asarray(geometry.cdist(qs[0], p))
-    opt = cost[np.arange(n), lapjv(cost)].sum()
-    emit(f"sinkhorn_assign_n{n}_subopt", cost[np.arange(n), v].sum() / opt - 1,
-         "ratio")
+        def chain(qs):
+            def body(c, q):
+                r = sinkhorn.sinkhorn_assign(q, p, n_iters=n_iters)
+                return c + r.row_to_col.sum(), None
+            return lax.scan(body, jnp.int32(0), qs)[0]
 
-    # --- gain design (ADMM) ---
+        fsh = jax.jit(chain, in_shardings=(row_t,), out_shardings=rep)
+        dt = _median_time(fsh, jax.device_put(qs, row_t), K, reps)
+        emit(f"sinkhorn_assign_n{n}_sharded{ndev}_hz", 1.0 / dt, "Hz",
+             baseline=100.0, chain_k=K)
+        # correctness: sharded == single-device rounding decisions
+        v_ref = np.asarray(jax.jit(
+            lambda q: sinkhorn.sinkhorn_assign(
+                q, p, n_iters=n_iters).row_to_col)(qs[0]))
+        v_sh = np.asarray(jax.jit(
+            lambda q: sinkhorn.sinkhorn_assign(
+                q, p, n_iters=n_iters).row_to_col,
+            in_shardings=(meshlib.row_sharding(mesh),))(
+                jax.device_put(qs[0], meshlib.row_sharding(mesh))))
+        emit(f"sinkhorn_assign_n{n}_sharded{ndev}_match", float(
+            np.mean(v_sh == v_ref)), "ratio")
+
+    # --- gain design (ADMM), simform100-shape sparse graph ---
     n_g = min(n, 100)
-    adj_g = np.ones((n_g, n_g)) - np.eye(n_g)
     from aclswarm_tpu import gains as gl
+    from aclswarm_tpu.harness import formgen
 
-    # chained over distinct point sets
+    G = 4 if quick else 40
     ptss = jnp.asarray(
-        rng.normal(size=(3, n_g, 3)).astype(np.float32) * 10)
+        rng.normal(size=(G, n_g, 3)).astype(np.float32) * 10)
+    for tag, adj_g in (
+            ("", np.ones((n_g, n_g)) - np.eye(n_g)),
+            ("_sparse", formgen.random_adjmat(
+                np.random.default_rng(7), n_g, fc=False))):
 
-    def gchain(ptss):
-        def body(c, pp):
-            return c + gl.solve_gains(pp, adj_g).sum(), None
-        return lax.scan(body, jnp.float32(0), ptss)[0]
+        def gchain(ptss, adj_g=adj_g):
+            def body(c, pp):
+                return c + gl.solve_gains(
+                    pp, adj_g, max_nonedges=n_g - 4).sum(), None
+            return lax.scan(body, jnp.float32(0), ptss)[0]
 
-    gj = jax.jit(gchain)
-    jax.block_until_ready(gj(ptss))
-    t0 = time.perf_counter()
-    jax.block_until_ready(gj(ptss))
-    dt = (time.perf_counter() - t0) / 3
-    emit(f"admm_gain_design_n{n_g}_ms", dt * 1000, "ms")
+        dt = _median_time(jax.jit(gchain), ptss, G, reps)
+        emit(f"admm_gain_design_n{n_g}{tag}_ms", dt * 1000, "ms",
+             chain_k=G)
 
+    if out:
+        path = Path(out)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "a") as fh:
+            for row in results:
+                fh.write(json.dumps(row) + "\n")
+        print(f"# appended {len(results)} rows to {path}")
     return results
 
 
@@ -122,8 +206,16 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=1000)
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--sharded", action="store_true")
+    ap.add_argument("--out", default=None)
     args = ap.parse_args()
-    bench_all(args.n, args.quick)
+    # the axon TPU plugin ignores JAX_PLATFORMS=cpu; apply it through
+    # jax.config so virtual-mesh runs actually land on CPU
+    import os
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    bench_all(args.n, args.quick, args.sharded, args.out)
 
 
 if __name__ == "__main__":

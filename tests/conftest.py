@@ -5,16 +5,43 @@ Multi-chip hardware is not available in CI; per the framework's test strategy
 mesh. float64 is enabled so golden-value tests can match the reference's
 double-precision C++/MATLAB outputs (`aclswarm/test/test_admm.cpp` uses 1e-8
 tolerances).
+
+The f32 device tier (`pytest -m f32`, tests/test_f32.py) toggles x64 off per
+test; run it on the real chip with ACLSWARM_TEST_TPU=1 (which skips the
+CPU forcing below — the axon plugin then provides the default TPU backend).
 """
 import os
 
+import pytest
+
+ON_TPU = os.environ.get("ACLSWARM_TEST_TPU", "") == "1"
+
 flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
+if not ON_TPU and "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+if not ON_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "f32: device-precision tier — runs the core kernels at float32 "
+        "(on TPU when ACLSWARM_TEST_TPU=1) with justified tolerances")
+
+
+@pytest.fixture
+def f32_mode():
+    """Run a test at f32 (x64 off), restoring the suite's f64 default."""
+    jax.config.update("jax_enable_x64", False)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_x64", True)

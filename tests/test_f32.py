@@ -1,0 +1,127 @@
+"""f32 device-precision tier (`pytest -m f32`).
+
+The main suite validates golden parity in f64 on CPU; "works in f64 on CPU"
+is not "works on TPU". These tests run the assignment, gain-design, and
+closed-loop paths at float32 — the TPU execution precision — with
+tolerances justified by measurement:
+
+- alignment: `precision="highest"` contractions keep the planted-transform
+  recovery error ~1e-5 at f32 (without it, bf16 matmuls reach 1e-2 — the
+  hazard documented in `core/geometry.py`);
+- gain design: the f32 solve leaves residue in the kernel eigenmodes —
+  measured ~3e-5 per mode on CPU-f32 and up to ~7e-5 on the v5e chip
+  (different matmul rounding), against a ~1.0 spectral gap to the
+  structural modes — so eigenstructure validates at tol=2e-4; the
+  zero-block masking claim (`gains/admm.py`) must hold *exactly* at f32 —
+  that is the point of the mask;
+- assignment: rounding decisions are discrete, so f32 only moves ties;
+  quality stays within the same <=2% LAP-suboptimality budget as f64;
+- closed loop: convergence thresholds are physical (m, m/s), far above f32
+  noise — the supervisor oracle must reach the same verdict.
+
+Run on the real chip: ACLSWARM_TEST_TPU=1 python -m pytest -m f32 tests/.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aclswarm_tpu import gains as gainslib
+from aclswarm_tpu import sim
+from aclswarm_tpu.assignment import lapjv, sinkhorn
+from aclswarm_tpu.core import geometry
+from aclswarm_tpu.core.types import ControlGains, SafetyParams, make_formation
+from aclswarm_tpu.harness import formgen, supervisor
+
+pytestmark = pytest.mark.f32
+
+
+def test_alignment_planted_transform(f32_mode):
+    """Arun alignment at f32 recovers a planted rotation+translation of a
+    scrambled swarm to ~1e-4 (needs precision='highest' contractions)."""
+    n = 50
+    rng = np.random.default_rng(0)
+    pts = rng.normal(size=(n, 3)).astype(np.float32) * 10
+    th = 1.2
+    R = np.array([[np.cos(th), -np.sin(th), 0],
+                  [np.sin(th), np.cos(th), 0],
+                  [0, 0, 1]], np.float32)
+    # z translation stays 0: the forced-d=2 alignment only recovers the
+    # rot-about-z + xy-translation the control law is invariant to
+    # (`assignment.py:76-78`)
+    q = pts @ R.T + np.float32([3.0, -2.0, 0.0])
+    aligned = np.asarray(jax.jit(
+        lambda p, q: geometry.align(p, q, d=2))(jnp.asarray(pts),
+                                                jnp.asarray(q)))
+    assert aligned.dtype == np.float32
+    err = np.abs(aligned - q).max()
+    assert err < 1e-3, err
+
+
+def test_assignment_quality_and_validity(f32_mode):
+    """f32 Sinkhorn assignment: always a valid permutation, within the
+    2% LAP-suboptimality budget at n=200."""
+    n = 200
+    rng = np.random.default_rng(1)
+    p = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32) * 20)
+    subs = []
+    for k in range(3):
+        q = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32) * 20)
+        v = np.asarray(jax.jit(
+            lambda q: sinkhorn.sinkhorn_assign(q, p).row_to_col)(q))
+        assert sorted(v.tolist()) == list(range(n))  # valid permutation
+        cost = np.asarray(geometry.cdist(q, p), np.float64)
+        opt = cost[np.arange(n), lapjv(cost)].sum()
+        subs.append(cost[np.arange(n), v].sum() / opt - 1)
+    assert max(subs) < 0.02, subs
+
+
+def test_gain_design_invariants(f32_mode):
+    """f32 on-device gain design (Newton-Schulz PSD path) on a sparse
+    simformN-shape graph: zero blocks EXACT, trace within f32 accumulation
+    error, eigenstructure at the measured f32 tolerance."""
+    n = 40
+    rng = np.random.default_rng(2)
+    pts = (rng.normal(size=(n, 3)) * 10).astype(np.float32)
+    adj = formgen.random_adjmat(np.random.default_rng(2), n, fc=False)
+    A = np.asarray(jax.jit(
+        lambda p: gainslib.solve_gains(p, adj, max_nonedges=n - 4))(
+            jnp.asarray(pts)))
+    assert A.dtype == np.float32
+    blocks = A.reshape(n, 3, n, 3)
+    for i in range(n):
+        for j in range(n):
+            if i != j and adj[i, j] == 0:
+                # the masking claim: no f32 residue leaks outside the graph
+                assert np.all(blocks[i, :, j, :] == 0.0), (i, j)
+    np.testing.assert_allclose(np.trace(A.astype(np.float64)), -3 * (n - 2),
+                               atol=0.2)
+    v = gainslib.validate_gains(A.astype(np.float64), pts.astype(np.float64),
+                                tol=2e-4)
+    assert v["no_positive"] and v["kernel_ok"] \
+        and v["strictly_negative_rest"], v["eigenvalues"][-8:]
+
+
+def test_closed_loop_convergence(f32_mode):
+    """Short f32 closed-loop rollout with f32-designed gains: the
+    supervisor oracle declares convergence, same as the f64 tier."""
+    n = 6
+    rng = np.random.default_rng(3)
+    ang = np.linspace(0, 2 * np.pi, n, endpoint=False)
+    pts = np.stack([4 * np.cos(ang), 4 * np.sin(ang),
+                    np.zeros(n)], 1).astype(np.float32)
+    adj = (np.ones((n, n)) - np.eye(n)).astype(np.float32)
+    A = gainslib.solve_gains_blocks(pts, adj)
+    formation = make_formation(pts, adj, A.astype(jnp.float32))
+    sp = SafetyParams(bounds_min=jnp.asarray([-20.0, -20.0, 0.0]),
+                      bounds_max=jnp.asarray([20.0, 20.0, 10.0]))
+    cfg = sim.SimConfig(assignment="auction", assign_every=120)
+    q0 = (rng.normal(size=(n, 3)) * 3 + [0, 0, 2]).astype(np.float32)
+    st = sim.init_state(q0)
+    final, m = sim.rollout(st, formation, ControlGains(), sp, cfg, 3000)
+    assert np.asarray(m.q).dtype == np.float32
+    res = supervisor.evaluate(np.asarray(m.distcmd_norm),
+                              np.asarray(m.ca_active), np.asarray(m.q),
+                              np.asarray(m.reassigned),
+                              np.asarray(m.assign_valid), dt=cfg.control_dt)
+    assert res.converged
