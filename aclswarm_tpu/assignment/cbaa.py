@@ -139,8 +139,7 @@ def cbaa_assign(q_veh: jnp.ndarray,
     vehids = jnp.arange(n, dtype=jnp.int32)
 
     # comm graph in vehicle space: v hears w iff adj[v2f[v], v2f[w]] or v==w
-    comm_mask = adjmat[jnp.ix_(v2f_prev, v2f_prev)] > 0
-    comm_mask = comm_mask | jnp.eye(n, dtype=bool)
+    comm_mask = permutil.comm_mask(adjmat, v2f_prev, self_loop=True)
 
     myprice = bid_prices(q_veh, paligned)
 
@@ -169,9 +168,16 @@ def cbaa_assign(q_veh: jnp.ndarray,
     return CBAAResult(v2f=v2f, f2v=f2v, valid=valid, price=price, who=who)
 
 
-def cbaa_from_state(q_veh, formation_points, adjmat, v2f_prev, n_iters=None):
+def cbaa_from_state(q_veh, formation_points, adjmat, v2f_prev, n_iters=None,
+                    est=None):
     """Convenience wrapper: local alignment + auction, the full `start()` ->
-    consensus pipeline of `auctioneer.cpp:78-120` for the whole swarm."""
+    consensus pipeline of `auctioneer.cpp:78-120` for the whole swarm.
+
+    ``est`` (optional, (n, n, 3)) routes each agent's *localization
+    estimates* into its alignment instead of shared ground truth — the
+    information model the reference actually runs under (the auctioneer's
+    `q_` snapshot comes from `vehicle_estimates`). Own positions stay exact
+    (the diagonal of ``est`` is the autopilot feed)."""
     paligned = geometry.align_formation_local(
-        q_veh, formation_points, adjmat, v2f_prev)
+        q_veh, formation_points, adjmat, v2f_prev, est=est)
     return cbaa_assign(q_veh, paligned, adjmat, v2f_prev, n_iters=n_iters)

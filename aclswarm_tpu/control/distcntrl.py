@@ -51,17 +51,31 @@ def scale_control(qij: jnp.ndarray, dstar_xy: jnp.ndarray,
 
 
 def compute(state: SwarmState, formation: Formation, v2f: jnp.ndarray,
-            gains: ControlGains) -> jnp.ndarray:
+            gains: ControlGains, rel: jnp.ndarray | None = None) -> jnp.ndarray:
     """All n vehicles' velocity commands (vehicle order), one batched step.
 
     Replaces n independent calls to `DistCntrl::compute`
     (`distcntrl.cpp:46-102`). Returns (n, 3) commanded velocities.
-    """
-    q_form = permutil.veh_to_formation_order(state.q, v2f)
-    adj = (formation.adjmat > 0).astype(q_form.dtype)
 
-    # qij[i, j] = q_j - q_i in formation space (`distcntrl.cpp:67`)
-    qij = q_form[None, :, :] - q_form[:, None, :]
+    ``rel`` (optional) is the per-agent relative-position view in *vehicle*
+    order, ``rel[v, w]`` = vehicle v's estimate of (w's position − its own)
+    — what the reference's control law actually receives from the
+    localization node (`coordination_ros.cpp:240-250` feeds `q_` from
+    `vehicle_estimates`, not ground truth). ``None`` keeps the exact-state
+    path (each agent's view built from the shared true state).
+    """
+    adj = (formation.adjmat > 0).astype(state.q.dtype)
+
+    if rel is None:
+        q_form = permutil.veh_to_formation_order(state.q, v2f)
+        # qij[i, j] = q_j - q_i in formation space (`distcntrl.cpp:67`)
+        qij = q_form[None, :, :] - q_form[:, None, :]
+    else:
+        # per-agent localization views: the row agent at formation point i
+        # is vehicle f2v[i]; its (estimated) offset to the vehicle at
+        # formation point j is rel[f2v[i], f2v[j]]
+        f2v = permutil.invert(v2f)
+        qij = rel[f2v][:, f2v]
 
     # linear term A_ij @ qij + nonlinear scale term F_ij * qij, masked by graph
     F = scale_control(qij, formation.dstar_xy, formation.dstar_z, gains)

@@ -113,7 +113,8 @@ def align(p: jnp.ndarray, q: jnp.ndarray, w: jnp.ndarray | None = None,
 
 
 def align_formation_local(q_veh: jnp.ndarray, p: jnp.ndarray,
-                          adjmat: jnp.ndarray, v2f: jnp.ndarray) -> jnp.ndarray:
+                          adjmat: jnp.ndarray, v2f: jnp.ndarray,
+                          est: jnp.ndarray | None = None) -> jnp.ndarray:
     """Per-agent neighborhood-restricted alignment, batched over all agents.
 
     Replaces `Auctioneer::alignFormation` (`auctioneer.cpp:347-415`) run
@@ -126,15 +127,27 @@ def align_formation_local(q_veh: jnp.ndarray, p: jnp.ndarray,
       p: (n, 3) desired formation points.
       adjmat: (n, n) adjacency over formation points.
       v2f: (n,) current assignment, vehicle -> formation point.
+      est: optional (n, n, 3) per-agent position estimates (vehicle order,
+        agent axis first) from the localization layer — each agent then
+        aligns against *its own belief* of where its neighbors are, which is
+        exactly the information the reference auctioneer gets (its `q_`
+        comes from `vehicle_estimates`, `coordination_ros.cpp:240-250`).
+        ``None`` = every agent sees the shared true state.
 
     Returns:
       (n, n, 3): per-agent aligned formation (agent axis first).
     """
-    q_form = permutil.veh_to_formation_order(q_veh, v2f)  # q of veh at formpt j
-    eye = jnp.eye(adjmat.shape[0], dtype=bool)
+    n = adjmat.shape[0]
+    f2v = permutil.invert(v2f)
+    if est is None:
+        q_form = permutil.veh_to_formation_order(q_veh, v2f)
+        q_form_per_agent = jnp.broadcast_to(q_form[None], (n, n, 3))
+    else:
+        q_form_per_agent = est[:, f2v]   # [agent v, formation pt j]
+    eye = jnp.eye(n, dtype=bool)
 
-    def one_agent(i):
+    def one_agent(i, q_form_v):
         w = (adjmat[i] > 0) | eye[i]
-        return align(p, q_form, w=w.astype(q_veh.dtype), d=2)
+        return align(p, q_form_v, w=w.astype(q_veh.dtype), d=2)
 
-    return jax.vmap(one_agent)(v2f)
+    return jax.vmap(one_agent)(v2f, q_form_per_agent)

@@ -58,6 +58,23 @@ def is_valid(perm: jnp.ndarray) -> jnp.ndarray:
     return jnp.all(counts == 1)
 
 
+def comm_mask(adjmat: jnp.ndarray, v2f: jnp.ndarray,
+              self_loop: bool = False) -> jnp.ndarray:
+    """Vehicle-space communication graph: v hears w iff their *formation
+    points* are adjacent under the current assignment — the single home of
+    the "who hears whom" rule both the bid exchange and the localization
+    flood follow (`coordination_ros.cpp:392-431`,
+    `localization_ros.cpp:152-185` both re-subscribe per adjmat∘assignment).
+
+    ``self_loop=True`` adds the diagonal (CBAA's consensus max includes the
+    agent's own table; the flood excludes it — own state comes from the
+    autopilot)."""
+    comm = adjmat[jnp.ix_(v2f, v2f)] > 0
+    if self_loop:
+        comm = comm | jnp.eye(v2f.shape[0], dtype=bool)
+    return comm
+
+
 def compose(outer: jnp.ndarray, inner: jnp.ndarray) -> jnp.ndarray:
     """Compose permutations: apply `inner` (vehicle -> formation pt) first,
     then `outer`, a *formation-space* relabeling (f -> f) produced by a

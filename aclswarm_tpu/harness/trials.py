@@ -61,6 +61,7 @@ class TrialConfig:
     # engine knobs (SimConfig mirror)
     assignment: str = "auction"     # auction | sinkhorn | cbaa
     dynamics: str = "tracking"      # tracking | firstorder
+    localization: str = "truth"     # truth | flooded (L3 estimate tables)
     tau: float = 0.15
     control_dt: float = 0.01
     assign_every: int = 120
@@ -128,6 +129,7 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
 
     engine_kw = dict(control_dt=cfg.control_dt, assign_every=cfg.assign_every,
                      dynamics=cfg.dynamics, tau=cfg.tau,
+                     localization=cfg.localization,
                      colavoid_neighbors=cfg.colavoid_neighbors,
                      flight_fsm=True)
     hover_cfg = sim.SimConfig(assignment="none", **engine_kw)
@@ -138,7 +140,8 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
                                      np.zeros((n, n)), None)
     gains_cache: dict[int, np.ndarray] = {}
 
-    state = sim.init_state(q0, flying=False)
+    state = sim.init_state(q0, flying=False,
+                           localization=cfg.localization == "flooded")
     fsm = TrialFSM(n, len(specs), takeoff_alt=sparams.takeoff_alt,
                    dt=cfg.control_dt)
     cgains = ControlGains()

@@ -39,7 +39,9 @@ from aclswarm_tpu.core import geometry
 from aclswarm_tpu.core import perm as permutil
 from aclswarm_tpu.core.types import (ControlGains, Formation, SafetyParams,
                                      SwarmState)
+from aclswarm_tpu.sim import localization as loclib
 from aclswarm_tpu.sim import vehicle
+from aclswarm_tpu.sim.localization import EstimateTable
 from aclswarm_tpu.sim.vehicle import ExternalInputs, FlightState
 
 
@@ -66,6 +68,16 @@ class SimConfig:
     # `control.collision_avoidance` — exact for <= k in-range neighbors
     colavoid_neighbors: int | None = struct.field(pytree_node=False,
                                                   default=None)
+    # information model: 'truth' = every consumer sees the exact batched
+    # state (the engine's historical mode; also the reference's centralized
+    # comparison mode, `operator.py:221-246`); 'flooded' = control and CBAA
+    # consume per-agent estimates from the timestamped-flooding localization
+    # layer (`aclswarm_tpu.sim.localization`) — the reference's actual
+    # information model (L3, `localization_ros.cpp`)
+    localization: str = struct.field(pytree_node=False, default="truth")
+    # flood decimation in control ticks: tracking_dt=0.02 / control_dt=0.01
+    # (`localization_ros.cpp:34`)
+    flood_every: int = struct.field(pytree_node=False, default=2)
 
 
 @struct.dataclass
@@ -77,6 +89,7 @@ class SimState:
     v2f: jnp.ndarray          # (n,) current assignment
     tick: jnp.ndarray         # () int32
     flight: FlightState       # per-vehicle flight-mode FSM
+    loc: EstimateTable | None = None   # localization tables ('flooded' mode)
 
 
 @struct.dataclass
@@ -93,10 +106,13 @@ class StepMetrics:
     v2f: jnp.ndarray            # (n,) assignment after the tick
 
 
-def init_state(q0, v2f0=None, flying: bool = True) -> SimState:
+def init_state(q0, v2f0=None, flying: bool = True,
+               localization: bool = False) -> SimState:
     """``flying=True`` starts airborne in FLYING (historical rollouts);
     ``flying=False`` starts NOT_FLYING on the ground — send CMD_GO via
-    `ExternalInputs` to take off (requires ``cfg.flight_fsm``)."""
+    `ExternalInputs` to take off (requires ``cfg.flight_fsm``).
+    ``localization=True`` allocates the estimate tables (required iff the
+    rollout runs with ``cfg.localization='flooded'``)."""
     q0 = jnp.asarray(q0)
     n = q0.shape[0]
     if v2f0 is None:
@@ -106,11 +122,12 @@ def init_state(q0, v2f0=None, flying: bool = True) -> SimState:
         goal=control.TrajGoal.hover_at(q0),
         v2f=jnp.asarray(v2f0, jnp.int32),
         tick=jnp.asarray(0, jnp.int32),
-        flight=vehicle.init_flight(n, q0.dtype, flying=flying))
+        flight=vehicle.init_flight(n, q0.dtype, flying=flying),
+        loc=loclib.init_table(q0) if localization else None)
 
 
 def _assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
-            cfg: SimConfig):
+            cfg: SimConfig, est: jnp.ndarray | None = None):
     """One re-assignment: returns (new v2f, valid flag).
 
     'auction' follows the centralized path (`assignment.py:94-137`): order the
@@ -119,6 +136,11 @@ def _assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
     path (`auctioneer.cpp:78-120`): per-agent local alignment + synchronous
     max-consensus auction, invalid outcomes rejected (detect-and-skip,
     `auctioneer.cpp:283-292`).
+
+    Information model: the centralized modes always use ground truth (the
+    reference operator subscribes the vehicles' true poses,
+    `operator.py:221-246`); only the decentralized CBAA consumes the
+    localization estimates ``est`` when the flooded model is on.
     """
     if cfg.assignment == "auction":
         q_form = permutil.veh_to_formation_order(swarm.q, v2f)
@@ -133,7 +155,7 @@ def _assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
         return res.row_to_col, jnp.asarray(True)  # valid by construction
     elif cfg.assignment == "cbaa":
         res = cbaa.cbaa_from_state(swarm.q, formation.points,
-                                   formation.adjmat, v2f)
+                                   formation.adjmat, v2f, est=est)
         new_v2f = jnp.where(res.valid, res.v2f, v2f)
         return new_v2f, res.valid
     elif cfg.assignment == "none":
@@ -156,6 +178,20 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
         fs = vehicle.apply_command(fs, inputs.cmd)
     flying = fs.mode == vehicle.FLYING
 
+    # --- mutual localization (L3, §3.4): flood at its own 50 Hz rate ---
+    loc = state.loc
+    if cfg.localization == "flooded":
+        if loc is None:
+            raise ValueError("cfg.localization='flooded' needs "
+                             "init_state(..., localization=True)")
+        loc = loclib.tick(loc, swarm.q, formation.adjmat, v2f,
+                          (state.tick % cfg.flood_every) == 0)
+        est = loc.est
+    elif cfg.localization == "truth":
+        est = None
+    else:
+        raise ValueError(f"unknown localization mode {cfg.localization!r}")
+
     # --- auto-auction (decimated onto its own period, §2.5) ---
     # auctions only run once the fleet is airborne: the reference only
     # starts auctioning after the formation is committed in flight
@@ -168,16 +204,17 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
     else:
         new_v2f, valid = lax.cond(
             do_assign,
-            lambda s, f, p: _assign(s, f, p, cfg),
-            lambda s, f, p: (p, jnp.asarray(True)),
-            swarm, formation, v2f)
+            lambda s, f, p, e: _assign(s, f, p, cfg, e),
+            lambda s, f, p, e: (p, jnp.asarray(True)),
+            swarm, formation, v2f, est)
     reassigned = do_assign & jnp.any(new_v2f != v2f)
     auctioned = (do_assign if cfg.assignment != "none"
                  else jnp.asarray(False))
     v2f = new_v2f
 
     # --- distributed control law -> distcmd (§3.3) ---
-    u = control.compute(swarm, formation, v2f, gains)
+    rel = None if est is None else loclib.relative_views(loc)
+    u = control.compute(swarm, formation, v2f, gains, rel=rel)
     if cfg.flight_fsm:
         # coordination publishes distcmd only while flying
         u = jnp.where(flying[:, None], u, 0.0)
@@ -213,7 +250,7 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
         raise ValueError(f"unknown dynamics model {cfg.dynamics!r}")
 
     new_state = SimState(swarm=swarm, goal=goal, v2f=v2f,
-                         tick=state.tick + 1, flight=fs)
+                         tick=state.tick + 1, flight=fs, loc=loc)
     return new_state, StepMetrics(distcmd_norm=distcmd_norm, ca_active=ca,
                                   assign_valid=valid, reassigned=reassigned,
                                   auctioned=auctioned, q=swarm.q,

@@ -1,0 +1,138 @@
+"""Mutual localization by timestamped flooding, batched over the swarm.
+
+Spec: the reference localization node + VehicleTracker
+(`aclswarm/src/localization_ros.cpp`, `aclswarm/src/vehicle_tracker.cpp`).
+There each vehicle runs a process holding an n-vector of (position, stamp)
+estimates: its own state arrives from the autopilot
+(`localization_ros.cpp:101-110`), neighbors' full estimate vectors arrive on
+`vehicle_estimates` topics and are merged element-wise with
+newest-timestamp-wins (`vehicle_tracker.cpp:31-45`), and a 50 Hz timer
+re-floods the merged vector to the comm-graph neighbors
+(`localization_ros.cpp:132-148`, tracking_dt=0.02 at `:34`). Subscriptions
+follow adjacency composed with the current assignment
+(`connectToNeighbors`, `localization_ros.cpp:152-185`) — so estimates of
+non-neighbors propagate multi-hop through the flood, one graph hop per
+flood period, going stale along the way.
+
+TPU-native design: the n per-process estimate tables become one
+``(n, n, 3)`` array ``est`` (row v = vehicle v's belief about every
+vehicle) plus an ``(n, n)`` integer ``age`` in control ticks since each
+estimate's source stamp. One flood step is a masked min-age reduction over
+the neighbor axis with strictly-newer-wins merge semantics — no topics, no
+per-pair subscriptions; the comm graph is a mask. The 50 Hz cadence is the
+engine's ``flood_every`` decimation counter (SURVEY.md §2.5), exactly how
+the reference multiplexes its timer rates.
+
+Divergences (documented):
+- The table initializes with the true starting positions (a "startup
+  census") instead of the reference's zeros-until-first-message, so
+  rollouts don't begin with every agent believing everyone is at the
+  origin; the reference's SIL reaches the same state after the first few
+  floods.
+- Ages are exact hop-counts in ticks; the reference's wall-clock stamps
+  add jitter from TCPROS delivery that a bulk-synchronous step doesn't
+  model.
+
+Memory note: the merge materializes an ``(n, n, n)`` age broadcast — fine
+at trial scale (n=100 -> 4 MB); the n=1000 scale path runs the engine's
+``localization='truth'`` mode (the reference's centralized comparison mode
+has ground truth too, `aclswarm/nodes/operator.py:221-246`).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from aclswarm_tpu.core import perm as permutil
+
+# "infinitely old" sentinel for masked candidates; int32-safe headroom so
+# age+1 never overflows
+MAX_AGE = jnp.int32(2**30)
+
+
+@struct.dataclass
+class EstimateTable:
+    """All n vehicles' estimate vectors (the VehicleTracker state,
+    `vehicle_tracker.h`), batched: row v is vehicle v's table."""
+
+    est: jnp.ndarray   # (n, n, 3) est[v, w] = v's estimate of w's position
+    age: jnp.ndarray   # (n, n) int32 ticks since the estimate's source stamp
+
+
+def init_table(q0: jnp.ndarray) -> EstimateTable:
+    """Every vehicle starts knowing the true initial positions (startup
+    census; see module docstring for the divergence note)."""
+    q0 = jnp.asarray(q0)
+    n = q0.shape[0]
+    return EstimateTable(est=jnp.broadcast_to(q0[None], (n, n, 3)).copy(),
+                         age=jnp.zeros((n, n), jnp.int32))
+
+
+def comm_mask(adjmat: jnp.ndarray, v2f: jnp.ndarray) -> jnp.ndarray:
+    """Vehicle-space communication graph (`localization_ros.cpp:152-185`
+    follows adjmat∘assignment, like the coordination node). No self-loop —
+    own state comes from the autopilot, not the flood. Single home of the
+    rule: `aclswarm_tpu.core.perm.comm_mask`."""
+    return permutil.comm_mask(adjmat, v2f, self_loop=False)
+
+
+def observe_self(table: EstimateTable, q_true: jnp.ndarray) -> EstimateTable:
+    """Autopilot state update (`localization_ros.cpp:101-110`): each
+    vehicle's own entry is ground truth with a fresh stamp."""
+    n = q_true.shape[0]
+    rows = jnp.arange(n)
+    return EstimateTable(est=table.est.at[rows, rows].set(q_true),
+                         age=table.age.at[rows, rows].set(0))
+
+
+def flood(table: EstimateTable, comm: jnp.ndarray) -> EstimateTable:
+    """One synchronous flood round: every vehicle broadcasts its table to
+    its comm-graph neighbors, receivers merge with newest-stamp-wins
+    (`vehicle_tracker.cpp:31-45`: an incoming estimate replaces the stored
+    one only if *strictly* newer).
+
+    The per-receiver merge is a masked min over the sender axis:
+    ``cand[v, w_src, j]`` = sender w_src's age for vehicle j as seen by
+    receiver v. Ties keep the receiver's own entry (strict-> semantics);
+    among equally-fresh senders the lowest id wins (argmin's first-hit),
+    which in the reference is message-arrival order — load-bearing nowhere,
+    since equal age means equal source stamp means identical payload.
+    """
+    age, est = table.age, table.est
+    cand = jnp.where(comm[:, :, None], age[None, :, :], MAX_AGE)  # (n,n,n)
+    best = jnp.min(cand, axis=1)            # (n, n) freshest neighbor age
+    src = jnp.argmin(cand, axis=1)          # (n, n) who provides it
+    take = best < age                       # strictly newer wins
+    est_new = jnp.take_along_axis(
+        est, src[:, :, None].astype(jnp.int32), axis=0)  # est[src[v,j], j]
+    # take_along_axis over axis 0 with index (n, n, 1) broadcasts the last
+    # axis; the gather above picks est[src[v, j], j, :] as required
+    return EstimateTable(est=jnp.where(take[:, :, None], est_new, est),
+                         age=jnp.where(take, best, age))
+
+
+def tick(table: EstimateTable, q_true: jnp.ndarray, adjmat: jnp.ndarray,
+         v2f: jnp.ndarray, do_flood: jnp.ndarray) -> EstimateTable:
+    """One control tick of the localization layer: ages advance, own state
+    refreshes (the autopilot feed outruns the flood), and on decimated
+    ticks (50 Hz, `localization_ros.cpp:34`) the flood round runs."""
+    table = EstimateTable(est=table.est, age=table.age + 1)
+    table = observe_self(table, q_true)
+    comm = comm_mask(adjmat, v2f)
+    return lax.cond(do_flood, lambda t: flood(t, comm), lambda t: t, table)
+
+
+def relative_views(table: EstimateTable) -> jnp.ndarray:
+    """(n, n, 3) rel[v, w] = v's estimate of (w's position − its own) —
+    the quantity the distributed control law actually consumes
+    (`distcntrl.cpp:67` computes q_j − q_i from the localization feed)."""
+    n = table.est.shape[0]
+    own = table.est[jnp.arange(n), jnp.arange(n)]       # (n, 3) == truth
+    return table.est - own[:, None, :]
+
+
+def staleness(table: EstimateTable, q_true: jnp.ndarray) -> jnp.ndarray:
+    """(n, n) estimate error vs ground truth — observability/debug metric
+    (no reference equivalent; the SIL plots this by hand via rqt)."""
+    return jnp.linalg.norm(table.est - q_true[None, :, :], axis=-1)

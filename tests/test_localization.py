@@ -1,0 +1,185 @@
+"""Localization layer tests (L3): timestamped flooding, newest-wins merge,
+multi-hop propagation, and the flooded information model end-to-end.
+
+Spec anchors: `aclswarm/src/vehicle_tracker.cpp:31-45` (strictly-newer-wins
+merge), `aclswarm/src/localization_ros.cpp:101-148` (own-state feed + 50 Hz
+flood), `:152-185` (comm graph follows adjmat∘assignment).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aclswarm_tpu import sim
+from aclswarm_tpu.core import perm as permutil
+from aclswarm_tpu.core.types import (ControlGains, SafetyParams,
+                                     make_formation)
+from aclswarm_tpu.sim import localization as loc
+
+
+def line_graph(n):
+    adj = np.zeros((n, n))
+    for i in range(n - 1):
+        adj[i, i + 1] = adj[i + 1, i] = 1
+    return jnp.asarray(adj)
+
+
+class TestFlood:
+    def test_self_observation_is_truth(self):
+        q0 = jnp.asarray(np.random.default_rng(0).normal(size=(5, 3)))
+        t = loc.init_table(jnp.zeros((5, 3)))
+        t = loc.observe_self(t, q0)
+        np.testing.assert_allclose(np.asarray(t.est)[np.arange(5),
+                                                     np.arange(5)], q0)
+        assert np.all(np.asarray(t.age)[np.arange(5), np.arange(5)] == 0)
+
+    def test_one_hop_per_flood(self):
+        """On a line graph, news of vehicle 0's move reaches vehicle k after
+        exactly k flood rounds (the multi-hop propagation of
+        `localization_ros.cpp:132-148`: each round re-publishes the merged
+        vector one hop further)."""
+        n = 5
+        adj = line_graph(n)
+        v2f = permutil.identity(n)
+        q = jnp.zeros((n, 3)).at[:, 0].set(jnp.arange(n, dtype=jnp.float64))
+        t = loc.init_table(q)
+        # vehicle 0 moves; everyone else still believes the census position
+        q_new = q.at[0, 1].set(7.0)
+        comm = loc.comm_mask(adj, v2f)
+        t = loc.observe_self(t, q_new)
+        for hop in range(1, n):
+            t = loc.EstimateTable(est=t.est, age=t.age + 1)
+            t = loc.observe_self(t, q_new)
+            t = loc.flood(t, comm)
+            est = np.asarray(t.est)
+            for v in range(n):
+                knows = est[v, 0, 1] == 7.0
+                assert knows == (v <= hop), (v, hop)
+
+    def test_strictly_newer_wins(self):
+        """A stale incoming estimate must not overwrite a fresher stored one
+        (`vehicle_tracker.cpp:31-45` strict > comparison)."""
+        n = 3
+        adj = line_graph(n)  # 0-1-2
+        v2f = permutil.identity(n)
+        comm = loc.comm_mask(adj, v2f)
+        t = loc.init_table(jnp.zeros((n, 3)))
+        # vehicle 1 holds a fresh estimate of vehicle 2 (age 1); vehicle 0
+        # holds a stale one (age 5) with a different value
+        est = t.est.at[1, 2, 0].set(42.0).at[0, 2, 0].set(-1.0)
+        age = t.age.at[1, 2].set(1).at[0, 2].set(5)
+        t = loc.EstimateTable(est=est, age=age)
+        t2 = loc.flood(t, comm)
+        # 0 hears 1: takes the fresher 42 estimate
+        assert float(t2.est[0, 2, 0]) == 42.0
+        assert int(t2.age[0, 2]) == 1
+        # 1 hears 0 and 2: 2's self-entry (age 0) beats everything
+        assert float(t2.est[1, 2, 0]) == 0.0
+        # equal ages do NOT overwrite (strict): give 0 and 1 equal-age
+        # conflicting estimates of 2 and check both keep their own
+        est = t.est.at[1, 2, 0].set(42.0).at[0, 2, 0].set(-1.0)
+        age = t.age.at[1, 2].set(3).at[0, 2].set(3).at[2, 2].set(9)
+        t3 = loc.flood(loc.EstimateTable(est=est, age=age), comm)
+        assert float(t3.est[0, 2, 0]) == -1.0
+
+    def test_comm_graph_follows_assignment(self):
+        """v hears w iff their formation points are adjacent
+        (`localization_ros.cpp:152-185`)."""
+        n = 4
+        adj = line_graph(n)  # formation pts 0-1-2-3
+        v2f = jnp.asarray([2, 0, 3, 1], jnp.int32)
+        comm = np.asarray(loc.comm_mask(adj, v2f))
+        for v in range(n):
+            for w in range(n):
+                assert comm[v, w] == bool(
+                    adj[int(v2f[v]), int(v2f[w])] > 0)
+
+    def test_no_graph_no_flood(self):
+        """With an empty adjmat (pre-dispatch), estimates only age."""
+        n = 3
+        t = loc.init_table(jnp.ones((n, 3)))
+        q = jnp.full((n, 3), 2.0)
+        t = loc.tick(t, q, jnp.zeros((n, n)), permutil.identity(n),
+                     jnp.asarray(True))
+        est = np.asarray(t.est)
+        off = ~np.eye(n, dtype=bool)
+        assert np.all(est[off] == 1.0)      # off-diagonal stays at census
+        assert np.all(np.asarray(t.age)[off] == 1)
+
+
+class TestFloodedRollout:
+    """End-to-end: the engine's 'flooded' information model."""
+
+    def _setup(self, seed=3):
+        rng = np.random.default_rng(seed)
+        n = 6
+        # sparse ring+chords graph so multi-hop staleness exists
+        adj = np.zeros((n, n))
+        for i in range(n):
+            adj[i, (i + 1) % n] = adj[(i + 1) % n, i] = 1
+        adj[0, 3] = adj[3, 0] = 1
+        pts = np.array([[np.cos(a), np.sin(a), 1.5]
+                        for a in np.linspace(0, 2 * np.pi, n, endpoint=False)])
+        pts[:, :2] *= 3.0
+        from aclswarm_tpu import gains as gainslib
+        G = gainslib.solve_gains(jnp.asarray(pts), jnp.asarray(adj))
+        formation = make_formation(pts, adj, np.asarray(G))
+        q0 = rng.normal(size=(n, 3)) * 2.0
+        q0[:, 2] = 1.5
+        return n, formation, jnp.asarray(q0)
+
+    def test_estimates_differ_from_truth_midflight(self):
+        """The layer must DO something: while vehicles move, multi-hop
+        estimates lag the true state (VERDICT r1 item 5 'done' criterion)."""
+        n, formation, q0 = self._setup()
+        cfg = sim.SimConfig(assignment="cbaa", localization="flooded",
+                            dynamics="firstorder")
+        state = sim.init_state(q0, localization=True)
+        state, _ = sim.rollout(state, formation, ControlGains(),
+                               SafetyParams(), cfg, 50)
+        stale = np.asarray(loc.staleness(state.loc, state.swarm.q))
+        off = ~np.eye(n, dtype=bool)
+        # mid-flight, someone's belief about someone else must lag truth
+        assert stale[off].max() > 1e-3
+        # own entries lag by at most one control tick of motion (the table
+        # snapshots the autopilot state at the top of the tick, then the
+        # dynamics integrate) — bounded by vmax * dt, far fresher than the
+        # multi-hop flood path
+        assert stale[~off].max() < 0.02
+
+    def test_convergence_under_flooded_localization(self):
+        """swarm converges to formation shape with the real information
+        model (CBAA + flooded estimates), matching the reference SIL."""
+        n, formation, q0 = self._setup()
+        cfg = sim.SimConfig(assignment="cbaa", localization="flooded",
+                            dynamics="firstorder")
+        state = sim.init_state(q0, localization=True)
+        state, metrics = sim.rollout(state, formation, ControlGains(),
+                                     SafetyParams(), cfg, 4000)
+        # converged: distributed command ~0 for everyone
+        dn = np.asarray(metrics.distcmd_norm)[-100:]
+        assert dn.mean() < 0.25, dn.mean()
+        # estimates have converged too (static swarm => floods catch up)
+        stale = np.asarray(loc.staleness(state.loc, state.swarm.q))
+        assert stale.max() < 0.05
+
+    def test_truth_and_flooded_agree_when_static(self):
+        """A hovering swarm (no motion) has zero estimate error, so the
+        flooded control command equals the truth-mode command."""
+        n, formation, q0 = self._setup()
+        from aclswarm_tpu import control
+        from aclswarm_tpu.core.types import SwarmState
+        swarm = SwarmState(q=q0, vel=jnp.zeros_like(q0))
+        v2f = permutil.identity(n)
+        table = loc.init_table(q0)
+        u_truth = control.compute(swarm, formation, v2f, ControlGains())
+        u_flood = control.compute(swarm, formation, v2f, ControlGains(),
+                                  rel=loc.relative_views(table))
+        np.testing.assert_allclose(np.asarray(u_truth), np.asarray(u_flood),
+                                   atol=1e-12)
+
+    def test_flooded_requires_table(self):
+        n, formation, q0 = self._setup()
+        cfg = sim.SimConfig(localization="flooded")
+        state = sim.init_state(q0, localization=False)
+        with pytest.raises(ValueError, match="flooded"):
+            sim.step(state, formation, ControlGains(), SafetyParams(), cfg)
