@@ -1,0 +1,30 @@
+"""Interop: the `aclswarm_msgs` wire boundary, ROS-free (SURVEY.md §7 L8).
+
+- ``messages``  — dataclass equivalents of the 4 wire messages (O6).
+- ``codec``     — framed binary encoding (Python reference impl).
+- ``native``    — ctypes bindings to the C++ codec + shm ring
+  (`native/`, byte-identical to ``codec`` by test).
+- ``transport`` — host-local channels over the native shared-memory ring.
+- ``planner``   — the `backend=tpu` coordination stack driven purely
+  through wire messages.
+
+The planner (which pulls in jax and the sim engine) is exposed lazily so
+lightweight bridge/recorder processes can import the codec, messages, and
+transport without the JAX stack — the zero-dependency wire boundary the
+codec exists for.
+"""
+from aclswarm_tpu.interop import codec, messages
+from aclswarm_tpu.interop.messages import (CBAA, Formation, Header,
+                                           SafetyStatus, VehicleEstimates,
+                                           formation_from_spec)
+
+__all__ = ["codec", "messages", "Header", "Formation", "CBAA",
+           "VehicleEstimates", "SafetyStatus", "formation_from_spec",
+           "TpuPlanner", "PlannerOutput"]
+
+
+def __getattr__(name):
+    if name in ("TpuPlanner", "PlannerOutput"):
+        from aclswarm_tpu.interop import planner
+        return getattr(planner, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

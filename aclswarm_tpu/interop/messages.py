@@ -1,0 +1,121 @@
+"""Wire-API message types: the `aclswarm_msgs` boundary, ROS-free.
+
+The reference's entire inter-agent + operator API is four ROS messages
+(SURVEY.md §2.4 O6, `aclswarm_msgs/msg/{Formation,CBAA,VehicleEstimates,
+SafetyStatus}.msg`). The north star keeps that boundary so existing SIL
+tooling can drive the TPU planner: these dataclasses carry the same fields
+with the same meaning, and `aclswarm_tpu.interop.codec` gives them a stable
+framed binary encoding (implemented twice — pure Python and native C++ —
+byte-identical, so a ROS bridge or any host process can speak it without
+Python). A final ROS plugin is then a transport swap: rosmsg <-> these
+types is field-for-field.
+
+Field provenance (reference .msg files):
+- `Formation`: name, 3D points, adjacency matrix, optional precomputed
+  gains (`Formation.msg:1-18`; points are geometry_msgs/Point = f64,
+  adjmat UInt8MultiArray, gains Float32MultiArray).
+- `CBAA`: auctionId, iter, per-task price table (f32) and winner table
+  (i32, -1 = unset) (`CBAA.msg:1-12`).
+- `VehicleEstimates`: per-vehicle stamped positions, zeros when unknown
+  (`VehicleEstimates.msg:1-10`; PointStamped = stamp + f64 xyz).
+- `SafetyStatus`: collision_avoidance_active (`SafetyStatus.msg:1-5`) —
+  the gridlock health signal the trial supervisor consumes.
+
+Every message carries a `Header` (seq, stamp-in-seconds, frame), the
+std_msgs/Header equivalent.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+# frame type tags (codec wire format)
+MSG_FORMATION = 1
+MSG_CBAA = 2
+MSG_VEHICLE_ESTIMATES = 3
+MSG_SAFETY_STATUS = 4
+
+
+@dataclasses.dataclass
+class Header:
+    """std_msgs/Header equivalent: sequence, stamp (seconds), frame id."""
+
+    seq: int = 0
+    stamp: float = 0.0
+    frame_id: str = ""
+
+
+@dataclasses.dataclass
+class Formation:
+    """`aclswarm_msgs/Formation` (`Formation.msg:1-18`): the operator's
+    formation dispatch — name, points, adjacency, optional gains."""
+
+    header: Header
+    name: str
+    points: np.ndarray              # (n, 3) float64
+    adjmat: np.ndarray              # (n, n) uint8
+    gains: Optional[np.ndarray] = None  # (3n, 3n) float32, or None
+
+    def __post_init__(self):
+        self.points = np.ascontiguousarray(self.points, dtype=np.float64)
+        self.adjmat = np.ascontiguousarray(self.adjmat, dtype=np.uint8)
+        if self.gains is not None:
+            self.gains = np.ascontiguousarray(self.gains, dtype=np.float32)
+
+    @property
+    def n(self) -> int:
+        return self.points.shape[0]
+
+
+@dataclasses.dataclass
+class CBAA:
+    """`aclswarm_msgs/CBAA` (`CBAA.msg:1-12`): one agent's bid — its price
+    table and winner beliefs for the current auction iteration."""
+
+    header: Header
+    auction_id: int
+    iter: int
+    price: np.ndarray               # (n,) float32
+    who: np.ndarray                 # (n,) int32, -1 = unset
+
+    def __post_init__(self):
+        self.price = np.ascontiguousarray(self.price, dtype=np.float32)
+        self.who = np.ascontiguousarray(self.who, dtype=np.int32)
+
+
+@dataclasses.dataclass
+class VehicleEstimates:
+    """`aclswarm_msgs/VehicleEstimates` (`VehicleEstimates.msg:1-10`): one
+    vehicle's flooded estimate vector — a stamped position per vehicle id,
+    zeros when unknown."""
+
+    header: Header
+    positions: np.ndarray           # (n, 3) float64
+    stamps: np.ndarray              # (n,) float64 seconds (per-entry stamp)
+
+    def __post_init__(self):
+        self.positions = np.ascontiguousarray(self.positions,
+                                              dtype=np.float64)
+        self.stamps = np.ascontiguousarray(self.stamps, dtype=np.float64)
+
+
+@dataclasses.dataclass
+class SafetyStatus:
+    """`aclswarm_msgs/SafetyStatus` (`SafetyStatus.msg:1-5`): live health
+    signal — is collision avoidance currently overriding the command?"""
+
+    header: Header
+    collision_avoidance_active: bool
+
+
+def formation_from_spec(spec, seq: int = 0, stamp: float = 0.0) -> Formation:
+    """Build a Formation message from a harness `FormationSpec` (the
+    operator's `buildFormationMessage`, `aclswarm/nodes/operator.py:155-213`:
+    gains included only when precomputed)."""
+    gains = None if spec.gains is None else np.asarray(spec.gains,
+                                                       np.float32)
+    return Formation(header=Header(seq=seq, stamp=stamp),
+                     name=spec.name, points=np.asarray(spec.points),
+                     adjmat=np.asarray(spec.adjmat), gains=gains)

@@ -1,0 +1,152 @@
+"""`backend=tpu` planner: the coordination stack behind the wire boundary.
+
+The north star (SURVEY.md §7 layer 8): a host process that speaks the
+`aclswarm_msgs` semantics — Formation in, per-tick state in, distcmd +
+assignment out — and dispatches to the jitted batched planner, so the
+reference's SIL tooling can drive the TPU implementation through the same
+message boundary its ROS nodes use. This module is that process's core,
+transport-free: wire `messages` in, wire-shaped results out. Bolting it to
+a transport (the shm ring in `aclswarm_tpu.interop.transport`, a ROS
+bridge, a socket) is a pure I/O loop.
+
+What it replaces: the n per-vehicle `coordination` nodes
+(`coordination_ros.cpp`) — formation commit incl. on-demand gain solve
+(`:112-119`), the auto-auction timer (`:322-359`), and the 100 Hz control
+tick (`:370-378`) — batched for the whole swarm in one jitted call per
+tick.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from aclswarm_tpu import control
+from aclswarm_tpu.core import perm as permutil
+from aclswarm_tpu.core.types import (ControlGains, Formation as DevFormation,
+                                     SwarmState, make_formation)
+from aclswarm_tpu.interop import messages as m
+from aclswarm_tpu.sim import engine
+
+
+@dataclasses.dataclass
+class PlannerOutput:
+    """One tick's wire-shaped outputs.
+
+    ``distcmd`` is the batched `distcmd` topic (Vector3Stamped velocity
+    goal per vehicle, `coordination_ros.cpp:80,370-378`); ``assignment``
+    is the `assignment` topic payload (UInt8MultiArray permutation,
+    `coordination_ros.cpp:293-297`), present only on ticks where a new
+    assignment was accepted.
+    """
+
+    distcmd: np.ndarray                       # (n, 3) float
+    assignment: Optional[np.ndarray] = None   # (n,) uint8 v2f, when accepted
+    auction_valid: bool = True                # detect-and-skip flag
+    safety: Optional[m.SafetyStatus] = None   # reserved (safety is L2)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _tick(swarm: SwarmState, formation: DevFormation, v2f: jnp.ndarray,
+          cgains: ControlGains, do_assign: jnp.ndarray, cfg):
+    new_v2f, valid = jax.lax.cond(
+        do_assign,
+        lambda s, f, p: engine._assign(s, f, p, cfg),
+        lambda s, f, p: (p, jnp.asarray(True)),
+        swarm, formation, v2f)
+    u = control.compute(swarm, formation, new_v2f, cgains)
+    return u, new_v2f, valid
+
+
+class TpuPlanner:
+    """Host-side planner speaking the wire API.
+
+    Usage (one instance per swarm, e.g. inside a bridge process):
+
+        planner = TpuPlanner(n)
+        planner.handle_formation(formation_msg)         # operator dispatch
+        out = planner.tick(estimates_msg)               # each control tick
+        # out.distcmd -> safety/autopilot; out.assignment -> peers
+
+    Matches the reference coordination node's observable behavior:
+    - a Formation without gains triggers the on-device ADMM solve
+      (`coordination_ros.cpp:112-119`);
+    - a new formation resets the assignment to identity and re-arms the
+      auto-auction (`auctioneer.cpp:42-62`, `coordination_ros.cpp:136-153`);
+    - auctions run every ``assign_every`` ticks (autoauction_dt /
+      control_dt, `coordination.launch:23-24`), first one immediately after
+      the commit settles; invalid auctions are skipped, keeping the old
+      assignment (`auctioneer.cpp:283-292`).
+    """
+
+    def __init__(self, n: int, assignment: str = "auction",
+                 assign_every: int = 120,
+                 cgains: Optional[ControlGains] = None):
+        self.n = n
+        self.cfg = engine.SimConfig(assignment=assignment,
+                                    assign_every=assign_every)
+        self.cgains = cgains or ControlGains()
+        self.formation: Optional[DevFormation] = None
+        self.v2f = permutil.identity(n)
+        self._ticks_since_commit = 0
+        self._await_first_accept = True
+
+    # -- operator boundary ------------------------------------------------
+    def handle_formation(self, msg: m.Formation) -> None:
+        """Commit a formation dispatch (`formationCb` + the spin-loop
+        commit, `coordination_ros.cpp:94-160`)."""
+        if msg.n != self.n:
+            raise ValueError(f"formation for {msg.n} vehicles, planner "
+                             f"has {self.n}")
+        gains = msg.gains
+        if gains is None:
+            from aclswarm_tpu import gains as gainslib
+            gains = gainslib.solve_gains(jnp.asarray(msg.points),
+                                         np.asarray(msg.adjmat))
+        self.formation = make_formation(
+            jnp.asarray(msg.points), jnp.asarray(msg.adjmat, jnp.float32),
+            jnp.asarray(gains))
+        self.v2f = permutil.identity(self.n)
+        self._ticks_since_commit = 0
+        # the first *valid* auction after a commit is always published,
+        # even if the assignment is unchanged (`auctioneer.cpp:310-316`
+        # formation_just_received); persists across invalid auctions
+        self._await_first_accept = True
+
+    # -- per-tick boundary ------------------------------------------------
+    def tick(self, estimates, vel: Optional[np.ndarray] = None
+             ) -> PlannerOutput:
+        """One control tick. ``estimates`` is a `VehicleEstimates` message
+        (or a plain (n, 3) position array); ``vel`` the vehicles' own
+        velocities (zeros when not provided — the damping term then drops,
+        as when the reference's twist feed is absent)."""
+        if self.formation is None:
+            # no formation committed: zero command, hold assignment
+            # (`coordination_ros.cpp:102-106` zeros the cmd on commit gaps)
+            return PlannerOutput(distcmd=np.zeros((self.n, 3)))
+        q = (estimates.positions if isinstance(estimates, m.VehicleEstimates)
+             else np.asarray(estimates))
+        if q.shape != (self.n, 3):
+            raise ValueError(f"estimates shape {q.shape} != {(self.n, 3)}")
+        v = jnp.zeros((self.n, 3), jnp.asarray(q).dtype) if vel is None \
+            else jnp.asarray(vel)
+        swarm = SwarmState(q=jnp.asarray(q), vel=v)
+        do_assign = (self._ticks_since_commit % self.cfg.assign_every) == 0
+        u, new_v2f, valid = _tick(swarm, self.formation, self.v2f,
+                                  self.cgains, jnp.asarray(do_assign),
+                                  self.cfg)
+        self._ticks_since_commit += 1
+        accepted = do_assign and bool(valid)
+        changed = accepted and (bool(jnp.any(new_v2f != self.v2f))
+                                or self._await_first_accept)
+        if accepted:
+            self._await_first_accept = False
+        self.v2f = new_v2f
+        return PlannerOutput(
+            distcmd=np.asarray(u),
+            assignment=(np.asarray(new_v2f, np.uint8) if changed else None),
+            auction_valid=bool(valid))

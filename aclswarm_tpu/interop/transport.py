@@ -1,0 +1,106 @@
+"""Host-local message transport over the native shared-memory ring.
+
+The reference's transport is ROS TCPROS pub/sub between the n per-vehicle
+process stacks on one machine (SURVEY.md §5.8). The TPU framework keeps
+all *device* traffic on ICI collectives; what remains at the host boundary
+— operator dispatches, planner outputs, telemetry to a recorder or a ROS
+bridge process — moves over named SPSC shared-memory rings
+(`native/shmring.cpp`): one ring per directed channel, length-prefixed
+frames, lock-free, bounded (write returns False on backpressure instead of
+silently dropping — the reference's "queue size 1 but don't want to lose
+any" bid subscriptions, `coordination_ros.cpp:417-418`, made explicit).
+
+Requires the native library (``make -C native``); `Channel` raises
+RuntimeError otherwise — there is deliberately no slow Python fallback for
+a component whose reason to exist is being out of Python's way.
+"""
+from __future__ import annotations
+
+import ctypes as C
+
+import numpy as np
+
+from aclswarm_tpu.interop import codec
+from aclswarm_tpu.interop import native as nat
+
+DEFAULT_CAPACITY = 1 << 20  # 1 MiB per channel
+
+
+class Channel:
+    """One directed message channel (≈ one ROS topic between two hosts).
+
+    The creating side owns the shm object (``create=True``); the peer opens
+    it by name. Either side may write or read, but the ring is
+    single-producer single-consumer: exactly one writer process and one
+    reader process per channel, like a directed topic edge.
+    """
+
+    def __init__(self, name: str, create: bool = False,
+                 capacity: int = DEFAULT_CAPACITY):
+        lib = nat.load()
+        if lib is None:
+            raise RuntimeError(
+                "native transport needs native/build/libaclswarm_native.so "
+                "(run: make -C native)")
+        self._lib = lib
+        self.name = name if name.startswith("/") else "/" + name
+        self._h = lib.asw_ring_open(self.name.encode(), capacity,
+                                    1 if create else 0)
+        if not self._h:
+            raise OSError(f"cannot {'create' if create else 'open'} ring "
+                          f"{self.name}")
+        self._owner = create
+        # the creator dictates the size; openers read the true capacity
+        # from the shm control block (their `capacity` arg is ignored)
+        self._capacity = int(lib.asw_ring_capacity(self._h))
+        self._buf = (C.c_uint8 * self._capacity)()
+
+    def send(self, msg) -> bool:
+        """Encode + enqueue one wire message; False on backpressure."""
+        return self.send_bytes(codec.encode(msg))
+
+    def send_bytes(self, frame: bytes) -> bool:
+        """False means the ring is momentarily full (backpressure — retry
+        after draining). A frame that can NEVER fit raises instead, so a
+        retry loop can't spin forever."""
+        if len(frame) + 8 > self._capacity:
+            raise ValueError(
+                f"frame of {len(frame)} bytes can never fit channel "
+                f"{self.name} (capacity {self._capacity}); create the "
+                f"channel with a larger capacity")
+        arr = (C.c_uint8 * len(frame)).from_buffer_copy(frame)
+        return self._lib.asw_ring_write(self._h, arr, len(frame)) == 0
+
+    def recv(self):
+        """Dequeue + decode one message, or None if the channel is empty."""
+        b = self.recv_bytes()
+        return None if b is None else codec.decode(b)
+
+    def recv_bytes(self) -> bytes | None:
+        n = self._lib.asw_ring_read(self._h, self._buf, len(self._buf))
+        if n == 0:
+            return None
+        if n < 0:
+            raise OSError(f"ring {self.name}: corrupt or oversized message")
+        return bytes(np.ctypeslib.as_array(self._buf, (n,))[:n])
+
+    @property
+    def queued_bytes(self) -> int:
+        return int(self._lib.asw_ring_used(self._h))
+
+    def close(self):
+        if self._h:
+            self._lib.asw_ring_close(self._h, 1 if self._owner else 0)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,320 @@
+// Native wire-API codec: the aclswarm_msgs boundary as bytes, C ABI.
+//
+// Implements the exact frame + payload layouts documented in
+// aclswarm_tpu/interop/codec.py (the Python reference implementation);
+// the two are byte-identical by test (tests/test_interop.py). This is the
+// piece a non-Python host process (the reference's C++ vehicle nodes, a
+// ROS bridge, a telemetry recorder) links against to speak planner
+// traffic with zero dependencies — the reference's equivalent machinery
+// is the ROS message (de)serialization generated from
+// aclswarm_msgs/msg/*.msg and carried by TCPROS.
+//
+// Build: make -C native   (produces build/libaclswarm_native.so)
+//
+// Conventions: all integers little-endian (asserted at build time), no
+// struct padding — buffers are assembled byte-by-byte via memcpy so the
+// code is UB-free on any alignment. Every encode_* returns the number of
+// bytes written, or -1 if the output buffer is too small. Every decode_*
+// returns 0 on success, negative error codes otherwise.
+
+#include <cstdint>
+#include <cstring>
+
+static_assert(sizeof(float) == 4 && sizeof(double) == 8, "IEEE 754 required");
+
+namespace {
+
+constexpr uint32_t kMagic = 0x4D575341u;  // "ASWM" little-endian
+constexpr uint8_t kVersion = 1;
+constexpr size_t kFrameHeader = 16;  // magic,u8 ver,u8 type,u16 rsvd,u32 len,u32 crc
+
+// little-endian only: the framework targets x86-64/aarch64 hosts
+#if defined(__BYTE_ORDER__) && __BYTE_ORDER__ != __ORDER_LITTLE_ENDIAN__
+#error "big-endian hosts unsupported"
+#endif
+
+// ---- CRC32 (IEEE 802.3 / zlib polynomial, reflected) ----
+uint32_t crc_table[256];
+bool crc_init_done = []() {
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+  return true;
+}();
+
+uint32_t crc32_ieee(const uint8_t* p, size_t n) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i) c = crc_table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// ---- byte-stream writer/reader ----
+struct Writer {
+  uint8_t* out;
+  size_t cap, off = 0;
+  bool ok = true;
+  void bytes(const void* p, size_t n) {
+    if (!ok || off + n > cap) { ok = false; return; }
+    std::memcpy(out + off, p, n);
+    off += n;
+  }
+  template <typename T> void scalar(T v) { bytes(&v, sizeof(T)); }
+  void str(const char* s) {
+    size_t n = s ? std::strlen(s) : 0;
+    if (n > 0xFFFF) { ok = false; return; }
+    scalar<uint16_t>((uint16_t)n);
+    bytes(s, n);
+  }
+};
+
+struct Reader {
+  const uint8_t* in;
+  size_t len, off = 0;
+  bool ok = true;
+  void bytes(void* p, size_t n) {
+    if (!ok || off + n > len) { ok = false; return; }
+    std::memcpy(p, in + off, n);
+    off += n;
+  }
+  template <typename T> T scalar() {
+    T v{};
+    bytes(&v, sizeof(T));
+    return v;
+  }
+  // copies the string into dst (cap incl. NUL); always NUL-terminates
+  void str(char* dst, size_t cap) {
+    uint16_t n = scalar<uint16_t>();
+    if (!ok || off + n > len) { ok = false; return; }
+    if (dst && cap) {
+      size_t c = n < cap - 1 ? n : cap - 1;
+      std::memcpy(dst, in + off, c);
+      dst[c] = 0;
+    }
+    off += n;
+  }
+};
+
+void put_header(Writer& w, uint32_t seq, double stamp, const char* frame_id) {
+  w.scalar<uint32_t>(seq);
+  w.scalar<double>(stamp);
+  w.str(frame_id);
+}
+
+void get_header(Reader& r, uint32_t* seq, double* stamp, char* frame,
+                size_t frame_cap) {
+  uint32_t s = r.scalar<uint32_t>();
+  double st = r.scalar<double>();
+  if (seq) *seq = s;
+  if (stamp) *stamp = st;
+  r.str(frame, frame_cap);
+}
+
+int64_t finish_frame(Writer& w, uint8_t type) {
+  if (!w.ok) return -1;
+  size_t plen = w.off - kFrameHeader;
+  uint8_t* f = w.out;
+  uint32_t magic = kMagic, len32 = (uint32_t)plen;
+  uint32_t crc = crc32_ieee(f + kFrameHeader, plen);
+  std::memcpy(f, &magic, 4);
+  f[4] = kVersion;
+  f[5] = type;
+  f[6] = f[7] = 0;
+  std::memcpy(f + 8, &len32, 4);
+  std::memcpy(f + 12, &crc, 4);
+  return (int64_t)w.off;
+}
+
+Writer begin_frame(uint8_t* out, size_t cap) {
+  Writer w{out, cap};
+  w.off = kFrameHeader;  // header patched by finish_frame
+  if (cap < kFrameHeader) w.ok = false;
+  return w;
+}
+
+}  // namespace
+
+extern "C" {
+
+// message type tags (aclswarm_tpu/interop/messages.py MSG_*)
+enum { ASW_FORMATION = 1, ASW_CBAA = 2, ASW_ESTIMATES = 3, ASW_STATUS = 4 };
+
+uint32_t asw_crc32(const uint8_t* p, uint64_t n) { return crc32_ieee(p, n); }
+
+// Validate a frame; returns the message type (>0) and sets *payload_off /
+// *payload_len, or a negative error: -1 short, -2 magic, -3 version,
+// -4 truncated, -5 crc.
+int asw_parse_frame(const uint8_t* buf, uint64_t len, uint64_t* payload_off,
+                    uint64_t* payload_len) {
+  if (len < kFrameHeader) return -1;
+  uint32_t magic, plen, crc;
+  std::memcpy(&magic, buf, 4);
+  std::memcpy(&plen, buf + 8, 4);
+  std::memcpy(&crc, buf + 12, 4);
+  if (magic != kMagic) return -2;
+  if (buf[4] != kVersion) return -3;
+  if (len < kFrameHeader + (uint64_t)plen) return -4;
+  if (crc32_ieee(buf + kFrameHeader, plen) != crc) return -5;
+  if (payload_off) *payload_off = kFrameHeader;
+  if (payload_len) *payload_len = plen;
+  return buf[5];
+}
+
+// ---- Formation ----
+int64_t asw_encode_formation(uint32_t seq, double stamp, const char* frame_id,
+                             const char* name, uint32_t n,
+                             const double* points /* n*3 */,
+                             const uint8_t* adjmat /* n*n */,
+                             const float* gains /* 9*n*n or NULL */,
+                             uint8_t* out, uint64_t cap) {
+  Writer w = begin_frame(out, cap);
+  put_header(w, seq, stamp, frame_id);
+  w.str(name);
+  w.scalar<uint32_t>(n);
+  w.bytes(points, (size_t)n * 3 * 8);
+  w.bytes(adjmat, (size_t)n * n);
+  w.scalar<uint8_t>(gains ? 1 : 0);
+  if (gains) w.bytes(gains, (size_t)9 * n * n * 4);
+  return finish_frame(w, ASW_FORMATION);
+}
+
+// Phase 1: query n (and gains presence) so the caller can size buffers.
+int asw_formation_dims(const uint8_t* buf, uint64_t len, uint32_t* n,
+                       int* has_gains) {
+  uint64_t off, plen;
+  if (asw_parse_frame(buf, len, &off, &plen) != ASW_FORMATION) return -1;
+  Reader r{buf + off, plen};
+  get_header(r, nullptr, nullptr, nullptr, 0);
+  r.str(nullptr, 0);
+  uint32_t nn = r.scalar<uint32_t>();
+  if (!r.ok) return -2;
+  if (r.off + (uint64_t)nn * 3 * 8 + (uint64_t)nn * nn + 1 > plen) return -3;
+  if (n) *n = nn;
+  if (has_gains) *has_gains = buf[off + r.off + nn * 3 * 8 + nn * nn] != 0;
+  return 0;
+}
+
+int asw_decode_formation(const uint8_t* buf, uint64_t len, uint32_t* seq,
+                         double* stamp, char* frame_id, uint64_t frame_cap,
+                         char* name, uint64_t name_cap, double* points,
+                         uint8_t* adjmat, float* gains /* may be NULL */) {
+  uint64_t off, plen;
+  if (asw_parse_frame(buf, len, &off, &plen) != ASW_FORMATION) return -1;
+  Reader r{buf + off, plen};
+  get_header(r, seq, stamp, frame_id, frame_cap);
+  r.str(name, name_cap);
+  uint32_t n = r.scalar<uint32_t>();
+  r.bytes(points, (size_t)n * 3 * 8);
+  r.bytes(adjmat, (size_t)n * n);
+  uint8_t hg = r.scalar<uint8_t>();
+  if (hg && gains) r.bytes(gains, (size_t)9 * n * n * 4);
+  return r.ok ? 0 : -2;
+}
+
+// ---- CBAA ----
+int64_t asw_encode_cbaa(uint32_t seq, double stamp, const char* frame_id,
+                        uint32_t auction_id, uint32_t iter, uint32_t n,
+                        const float* price, const int32_t* who, uint8_t* out,
+                        uint64_t cap) {
+  Writer w = begin_frame(out, cap);
+  put_header(w, seq, stamp, frame_id);
+  w.scalar<uint32_t>(auction_id);
+  w.scalar<uint32_t>(iter);
+  w.scalar<uint32_t>(n);
+  w.bytes(price, (size_t)n * 4);
+  w.bytes(who, (size_t)n * 4);
+  return finish_frame(w, ASW_CBAA);
+}
+
+int asw_cbaa_n(const uint8_t* buf, uint64_t len, uint32_t* n) {
+  uint64_t off, plen;
+  if (asw_parse_frame(buf, len, &off, &plen) != ASW_CBAA) return -1;
+  Reader r{buf + off, plen};
+  get_header(r, nullptr, nullptr, nullptr, 0);
+  r.scalar<uint32_t>();
+  r.scalar<uint32_t>();
+  uint32_t nn = r.scalar<uint32_t>();
+  if (!r.ok) return -2;
+  if (n) *n = nn;
+  return 0;
+}
+
+int asw_decode_cbaa(const uint8_t* buf, uint64_t len, uint32_t* seq,
+                    double* stamp, uint32_t* auction_id, uint32_t* iter,
+                    float* price, int32_t* who) {
+  uint64_t off, plen;
+  if (asw_parse_frame(buf, len, &off, &plen) != ASW_CBAA) return -1;
+  Reader r{buf + off, plen};
+  get_header(r, seq, stamp, nullptr, 0);
+  uint32_t aid = r.scalar<uint32_t>();
+  uint32_t it = r.scalar<uint32_t>();
+  uint32_t n = r.scalar<uint32_t>();
+  if (auction_id) *auction_id = aid;
+  if (iter) *iter = it;
+  r.bytes(price, (size_t)n * 4);
+  r.bytes(who, (size_t)n * 4);
+  return r.ok ? 0 : -2;
+}
+
+// ---- VehicleEstimates ----
+int64_t asw_encode_estimates(uint32_t seq, double stamp, const char* frame_id,
+                             uint32_t n, const double* stamps /* n */,
+                             const double* positions /* n*3 */, uint8_t* out,
+                             uint64_t cap) {
+  Writer w = begin_frame(out, cap);
+  put_header(w, seq, stamp, frame_id);
+  w.scalar<uint32_t>(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    w.scalar<double>(stamps[i]);
+    w.bytes(positions + (size_t)i * 3, 24);
+  }
+  return finish_frame(w, ASW_ESTIMATES);
+}
+
+int asw_estimates_n(const uint8_t* buf, uint64_t len, uint32_t* n) {
+  uint64_t off, plen;
+  if (asw_parse_frame(buf, len, &off, &plen) != ASW_ESTIMATES) return -1;
+  Reader r{buf + off, plen};
+  get_header(r, nullptr, nullptr, nullptr, 0);
+  uint32_t nn = r.scalar<uint32_t>();
+  if (!r.ok) return -2;
+  if (n) *n = nn;
+  return 0;
+}
+
+int asw_decode_estimates(const uint8_t* buf, uint64_t len, uint32_t* seq,
+                         double* stamp, double* stamps, double* positions) {
+  uint64_t off, plen;
+  if (asw_parse_frame(buf, len, &off, &plen) != ASW_ESTIMATES) return -1;
+  Reader r{buf + off, plen};
+  get_header(r, seq, stamp, nullptr, 0);
+  uint32_t n = r.scalar<uint32_t>();
+  for (uint32_t i = 0; i < n && r.ok; ++i) {
+    stamps[i] = r.scalar<double>();
+    r.bytes(positions + (size_t)i * 3, 24);
+  }
+  return r.ok ? 0 : -2;
+}
+
+// ---- SafetyStatus ----
+int64_t asw_encode_status(uint32_t seq, double stamp, const char* frame_id,
+                          int active, uint8_t* out, uint64_t cap) {
+  Writer w = begin_frame(out, cap);
+  put_header(w, seq, stamp, frame_id);
+  w.scalar<uint8_t>(active ? 1 : 0);
+  return finish_frame(w, ASW_STATUS);
+}
+
+int asw_decode_status(const uint8_t* buf, uint64_t len, uint32_t* seq,
+                      double* stamp, int* active) {
+  uint64_t off, plen;
+  if (asw_parse_frame(buf, len, &off, &plen) != ASW_STATUS) return -1;
+  Reader r{buf + off, plen};
+  get_header(r, seq, stamp, nullptr, 0);
+  uint8_t a = r.scalar<uint8_t>();
+  if (active) *active = a;
+  return r.ok ? 0 : -2;
+}
+
+}  // extern "C"

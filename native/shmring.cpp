@@ -1,0 +1,182 @@
+// Shared-memory message ring: the host-side transport of the wire API.
+//
+// The reference moves its four aclswarm_msgs over TCPROS loopback between
+// the per-vehicle processes (SURVEY.md §5.8); this is the TPU framework's
+// native equivalent for host-local traffic: a single-producer
+// single-consumer lock-free byte ring in POSIX shared memory, carrying
+// length-prefixed frames (typically the codec.cpp format). One ring per
+// directed channel mirrors ROS's one-topic-one-publisher usage here; no
+// locks, no syscalls on the hot path, and the "queue size 1 but don't
+// want to lose any" intent of the reference's bid subscriptions
+// (coordination_ros.cpp:417-418) becomes a real bounded FIFO with
+// backpressure (write fails when full; caller decides to drop or retry).
+//
+// Memory layout (page 0 is the control block):
+//   u32 magic, u32 capacity, u64 head (write cursor), u64 tail (read
+//   cursor), both monotonically increasing byte offsets; data region
+//   follows at offset 64. Messages are [u32 len][len bytes], contiguous;
+//   a message never wraps — if it doesn't fit before the end, a u32
+//   0xFFFFFFFF pad marker skips to the start (classic ring framing).
+//
+// SPSC correctness: producer only writes head, consumer only writes tail;
+// release/acquire fences order payload writes against cursor publication.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x52575341u;  // "ASWR"
+constexpr size_t kCtrl = 64;
+constexpr uint32_t kPad = 0xFFFFFFFFu;
+
+struct Ctrl {
+  uint32_t magic;
+  uint32_t capacity;
+  std::atomic<uint64_t> head;
+  std::atomic<uint64_t> tail;
+};
+static_assert(sizeof(Ctrl) <= kCtrl, "control block overflow");
+
+struct Ring {
+  Ctrl* ctrl;
+  uint8_t* data;
+  size_t map_len;
+  bool owner;
+  char name[256];
+};
+
+}  // namespace
+
+extern "C" {
+
+// Create (owner=1) or open (owner=0) a named ring; capacity is the data
+// region size in bytes (power of two not required). Returns NULL on error.
+void* asw_ring_open(const char* name, uint32_t capacity, int create) {
+  capacity = (capacity + 3u) & ~3u;  // see alignment invariant below
+  int flags = create ? (O_CREAT | O_EXCL | O_RDWR) : O_RDWR;
+  int fd = shm_open(name, flags, 0600);
+  if (fd < 0) return nullptr;
+  size_t len = kCtrl + capacity;
+  if (create && ftruncate(fd, (off_t)len) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  if (!create) {
+    struct stat st;
+    if (fstat(fd, &st) != 0 || (size_t)st.st_size < kCtrl) {
+      close(fd);
+      return nullptr;
+    }
+    len = (size_t)st.st_size;
+  }
+  void* mem = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Ring* r = new Ring;
+  r->ctrl = (Ctrl*)mem;
+  r->data = (uint8_t*)mem + kCtrl;
+  r->map_len = len;
+  r->owner = create != 0;
+  std::snprintf(r->name, sizeof(r->name), "%s", name);
+  if (create) {
+    r->ctrl->capacity = capacity;
+    r->ctrl->head.store(0, std::memory_order_relaxed);
+    r->ctrl->tail.store(0, std::memory_order_relaxed);
+    std::atomic_thread_fence(std::memory_order_release);
+    r->ctrl->magic = kMagic;
+  } else if (r->ctrl->magic != kMagic) {
+    munmap(mem, len);
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+void asw_ring_close(void* h, int unlink_shm) {
+  Ring* r = (Ring*)h;
+  if (!r) return;
+  munmap((void*)r->ctrl, r->map_len);
+  if (unlink_shm) shm_unlink(r->name);
+  delete r;
+}
+
+// Alignment invariant: capacity, every stored record (4-byte length word
+// + payload padded to a 4-byte multiple), and the pad-marker skip are all
+// multiples of 4 — so cursors mod capacity always leave >= 4 bytes before
+// the wrap point and a length word never straddles it.
+
+// Producer: append one message. Returns 0, or -1 if the ring is full
+// (backpressure — caller retries or drops) or the message can never fit.
+int asw_ring_write(void* h, const uint8_t* msg, uint32_t len) {
+  Ring* r = (Ring*)h;
+  uint32_t cap = r->ctrl->capacity;
+  uint32_t stored = (len + 3u) & ~3u;
+  uint64_t need = 4 + (uint64_t)stored;
+  if (need > cap || len >= kPad) return -1;
+  uint64_t head = r->ctrl->head.load(std::memory_order_relaxed);
+  uint64_t tail = r->ctrl->tail.load(std::memory_order_acquire);
+  size_t pos = head % cap;
+  size_t room_to_end = cap - pos;
+  if (room_to_end < need) {
+    // wrap: pad marker skips the remainder, record restarts at offset 0
+    if ((head - tail) + room_to_end + need > cap) return -1;
+    std::memcpy(r->data + pos, &kPad, 4);
+    head += room_to_end;
+    pos = 0;
+  } else if ((head - tail) + need > cap) {
+    return -1;
+  }
+  std::memcpy(r->data + pos, &len, 4);
+  std::memcpy(r->data + pos + 4, msg, len);
+  r->ctrl->head.store(head + need, std::memory_order_release);
+  return 0;
+}
+
+// Consumer: pop one message into out (cap bytes). Returns the message
+// length, 0 if the ring is empty, or -1 if out is too small (message is
+// left in the ring) / the ring is corrupt.
+int64_t asw_ring_read(void* h, uint8_t* out, uint32_t out_cap) {
+  Ring* r = (Ring*)h;
+  uint32_t cap = r->ctrl->capacity;
+  uint64_t tail = r->ctrl->tail.load(std::memory_order_relaxed);
+  uint64_t head = r->ctrl->head.load(std::memory_order_acquire);
+  while (true) {
+    if (tail == head) return 0;
+    size_t pos = tail % cap;
+    uint32_t len;
+    std::memcpy(&len, r->data + pos, 4);
+    if (len == kPad) {
+      tail += cap - pos;  // pad marker: skip to ring start
+      continue;
+    }
+    uint32_t stored = (len + 3u) & ~3u;
+    if (4 + (uint64_t)stored > head - tail) return -1;  // corrupt
+    if (len > out_cap) return -1;
+    std::memcpy(out, r->data + pos + 4, len);
+    r->ctrl->tail.store(tail + 4 + stored, std::memory_order_release);
+    return (int64_t)len;
+  }
+}
+
+// Data-region capacity in bytes (as created — openers read the true size).
+uint32_t asw_ring_capacity(void* h) {
+  return ((Ring*)h)->ctrl->capacity;
+}
+
+// Diagnostics: bytes currently queued.
+uint64_t asw_ring_used(void* h) {
+  Ring* r = (Ring*)h;
+  return r->ctrl->head.load(std::memory_order_acquire) -
+         r->ctrl->tail.load(std::memory_order_acquire);
+}
+
+}  // extern "C"
