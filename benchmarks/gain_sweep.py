@@ -1,0 +1,128 @@
+"""Gain-design benchmark sweep: the `matlab/Benchmark.m` equivalent.
+
+The reference sweeps its SDP-vs-ADMM gain design over n in [60, 200]
+(`Benchmark.m:18`: numAgt = round(linspace(60,200,15))) and commits no
+results; here the sweep is runnable on real hardware and the artifact is
+committed (`benchmarks/results/gain_sweep.json`). Two parts:
+
+1. **Timing sweep** (device ADMM): per-solve wall time over n, complete
+   and simform-style sparse graphs, chained-scan methodology (see
+   bench.py: K distinct instances inside one jit amortize the ~100 ms
+   remote-tunnel launch overhead; medians over reps).
+2. **Quality sweep** (small n): spectral-gap ratio of the device ADMM
+   gains vs the independent SDP oracle (`aclswarm_tpu.gains.sdp`, the
+   reference's `solve_original_sdp` formulation) — the cross-validation
+   the reference gets from running both MATLAB solvers side by side.
+
+Run: python benchmarks/gain_sweep.py [--quick] [--full]
+     [--out benchmarks/results/gain_sweep.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from scale import _median_time  # noqa: E402  (readback-synced timer)
+
+
+def sweep(quick: bool = False, full: bool = False, out: str | None = None):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from aclswarm_tpu import gains as gl
+    from aclswarm_tpu.gains import sdp
+    from aclswarm_tpu.harness import formgen
+
+    rng = np.random.default_rng(0)
+    results = []
+
+    def emit(row):
+        row = {**row, "device": jax.devices()[0].platform}
+        results.append(row)
+        print(json.dumps(row))
+
+    # --- timing sweep (Benchmark.m:18 range) ---
+    if full:
+        sizes = [int(round(x)) for x in np.linspace(60, 200, 15)]
+    elif quick:
+        sizes = [60, 100]
+    else:
+        sizes = [60, 100, 150, 200]
+    K = 2 if quick else 8
+    reps = 2 if quick else 5
+    for n in sizes:
+        ptss = jnp.asarray(rng.normal(size=(K, n, 3)).astype(np.float32)
+                           * 10)
+        for tag, adj in (
+                ("fc", np.ones((n, n)) - np.eye(n)),
+                ("sparse", formgen.random_adjmat(
+                    np.random.default_rng(n), n, fc=False))):
+            nonedges = int(np.sum(np.triu(1 - adj, 1)))
+
+            def chain(ptss, adj=adj, n=n):
+                def body(c, pp):
+                    return c + gl.solve_gains(
+                        pp, adj, max_nonedges=max(n - 4, 1)).sum(), None
+                return lax.scan(body, jnp.float32(0), ptss)[0]
+
+            dt = _median_time(jax.jit(chain), ptss, K, reps)
+            emit({"metric": f"admm_gain_n{n}_{tag}_ms",
+                  "value": round(dt * 1e3, 3),
+                  "unit": "ms", "n": n, "graph": tag,
+                  "nonedges": nonedges, "chain_k": K})
+
+    # --- quality sweep vs the independent SDP oracle ---
+    qsizes = [8, 12] if quick else [8, 12, 16, 20]
+    iters = 400 if quick else 1200
+    for n in qsizes:
+        pts = rng.normal(size=(n, 3)) * 3.0
+        adj = formgen.random_adjmat(np.random.default_rng(n + 1), n,
+                                    fc=False).astype(float)
+        _, nullity = sdp.kernel_basis(pts)
+        t0 = time.perf_counter()
+        A_sdp = sdp.solve_sdp_gains(pts, adj, iters=iters)
+        t_sdp = time.perf_counter() - t0
+        A_admm = np.asarray(gl.solve_gains(jnp.asarray(pts), adj))
+        gap_sdp = sdp.spectral_gap(A_sdp, nullity)
+        gap_admm = sdp.spectral_gap(A_admm, nullity)
+        emit({"metric": f"gain_quality_n{n}_ratio",
+              "value": round(gap_admm / max(gap_sdp, 1e-12), 4),
+              "unit": "ratio", "n": n,
+              "gap_admm": round(gap_admm, 5), "gap_sdp": round(gap_sdp, 5),
+              "sdp_oracle_s": round(t_sdp, 2)})
+
+    if out:
+        path = Path(out)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "a") as fh:
+            for row in results:
+                fh.write(json.dumps(row) + "\n")
+        print(f"# appended {len(results)} rows to {path}")
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--full", action="store_true",
+                    help="the reference's full 15-point size sweep")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    import os
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    sweep(args.quick, args.full, args.out)
+
+
+if __name__ == "__main__":
+    main()
