@@ -1,0 +1,138 @@
+"""Own-geometry formation groups for the shipped library.
+
+The reference library ships five groups up to mitacl15 plus a 100-point
+MATLAB formation (`aclswarm/param/formations.yaml`, `matlab/mitacl100.m`).
+This module generates this framework's own additions — sparse-adjacency
+groups (the reference's swarm6 sparse-graph case has to be exercised by
+the *shipped* library, not only by tests reading the reference yaml) and
+a 100-agent scale group — and inserts them into `param/formations.yaml`.
+Geometry is constructed here (no reference coordinates); run
+
+    python -m aclswarm_tpu.harness.libgen      # add/refresh groups
+    python -m aclswarm_tpu.harness.precalc     # (re)fill gains
+
+Groups:
+- ``swarm6_sparse`` — hexagon + triangular prism on a 9-edge ring+chord
+  graph (2n-3 edges, verified 2D-rigid for both formations: the minimum a
+  globally-rigid 2D formation graph needs, `generate_random_formation
+  .py:61-73` context).
+- ``grid9`` — 3x3 grid + 9-ring on the grid-with-diagonals graph.
+- ``swarm100`` — concentric rings + 10x10 grid at n=100, complete graph,
+  gains solved on dispatch (groups with ``precalc_gains: false`` ship no
+  gains, like `mitacl100.m`).
+"""
+from __future__ import annotations
+
+import numpy as np
+import yaml
+
+from aclswarm_tpu.harness import formations as formlib
+from aclswarm_tpu.harness import formgen
+
+
+def _ring_adj(n: int, chords=()) -> np.ndarray:
+    a = np.zeros((n, n))
+    for i in range(n):
+        a[i, (i + 1) % n] = a[(i + 1) % n, i] = 1
+    for i, j in chords:
+        a[i, j] = a[j, i] = 1
+    return a
+
+
+def _pts(arr) -> list:
+    return [[round(float(x), 6) for x in row] for row in np.asarray(arr)]
+
+
+def _adj(arr) -> list:
+    return [[int(x) for x in row] for row in np.asarray(arr)]
+
+
+def build_groups() -> dict:
+    groups = {}
+
+    # --- swarm6_sparse ---
+    ang = np.linspace(0, 2 * np.pi, 6, endpoint=False)
+    hexagon = np.stack([2.5 * np.cos(ang), 2.5 * np.sin(ang),
+                        np.zeros(6)], 1)
+    prism = np.array([[0., 0, 0], [2.5, 0, 0], [1.25, 2.165, 0],
+                      [0, 0, 2], [2.5, 0, 2], [1.25, 2.165, 2]])
+    adj6 = _ring_adj(6, chords=[(0, 2), (1, 3), (2, 4)])
+    assert formgen.is_rigid_2d(hexagon, adj6)
+    assert formgen.is_rigid_2d(prism, adj6)
+    groups["swarm6_sparse"] = {
+        "agents": 6,
+        "adjmat": _adj(adj6),
+        "formations": [
+            {"name": "Hexagon", "points": _pts(hexagon)},
+            {"name": "Triangular Prism", "points": _pts(prism)},
+        ],
+    }
+
+    # --- grid9 ---
+    grid = np.array([[x, y, 0.] for y in range(3) for x in range(3)]) * 2.0
+    ang9 = np.linspace(0, 2 * np.pi, 9, endpoint=False)
+    ring9 = np.stack([3.5 * np.cos(ang9), 3.5 * np.sin(ang9),
+                      np.zeros(9)], 1)
+    adj9 = np.zeros((9, 9))
+    for y in range(3):
+        for x in range(3):
+            i = y * 3 + x
+            for dx, dy in ((1, 0), (0, 1), (1, 1), (-1, 1)):
+                xx, yy = x + dx, y + dy
+                if 0 <= xx < 3 and 0 <= yy < 3:
+                    j = yy * 3 + xx
+                    adj9[i, j] = adj9[j, i] = 1
+    assert formgen.is_rigid_2d(grid, adj9)
+    assert formgen.is_rigid_2d(ring9, adj9)
+    groups["grid9"] = {
+        "agents": 9,
+        "adjmat": _adj(adj9),
+        "formations": [
+            {"name": "Grid", "points": _pts(grid)},
+            {"name": "Ring", "points": _pts(ring9)},
+        ],
+    }
+
+    # --- swarm100 (scale group; gains solved on dispatch) ---
+    rings = []
+    for r, k in ((2.0, 12), (4.5, 20), (7.0, 28), (9.5, 40)):
+        a = np.linspace(0, 2 * np.pi, k, endpoint=False)
+        rings.append(np.stack([r * np.cos(a), r * np.sin(a),
+                               np.full(k, 2.0)], 1))
+    rings = np.concatenate(rings)               # 100 points
+    grid100 = np.array([[x, y, 2.0] for y in range(10)
+                        for x in range(10)], dtype=float) * 2.0
+    groups["swarm100"] = {
+        "agents": 100,
+        "adjmat": "fc",
+        "precalc_gains": False,
+        "formations": [
+            {"name": "Concentric Rings", "points": _pts(rings)},
+            {"name": "Grid 10x10", "points": _pts(grid100)},
+        ],
+    }
+    return groups
+
+
+def extend_library(path=None, verbose: bool = True) -> None:
+    """Insert/refresh the generated groups in the library yaml (gains are
+    filled separately by `harness.precalc`)."""
+    path = path or formlib.DEFAULT_LIBRARY
+    with open(path) as f:
+        lib = yaml.safe_load(f)
+    from aclswarm_tpu.harness.precalc import HEADER
+    for name, group in build_groups().items():
+        lib[name] = group
+        if verbose:
+            print(f"{name}: {group['agents']} agents, "
+                  f"{len(group['formations'])} formations")
+    with open(path, "w") as f:
+        f.write(HEADER)
+        yaml.safe_dump(lib, f, sort_keys=False, default_flow_style=None,
+                       width=10000)
+    if verbose:
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    extend_library()

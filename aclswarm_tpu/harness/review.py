@@ -1,0 +1,103 @@
+"""Recorded-rollout reviewer: the `review_bag.py` pattern, bag-free.
+
+The reference replays rosbagged hardware experiments through the same
+metric FSM the sim supervisor uses (`aclswarm/nodes/review_bag.py:29-47`,
+`launch/review.launch`), so hardware and sim runs are scored by one
+oracle. Here the "bag" is a compressed npz of the rollout observables
+(`StepMetrics` — the exact signals the supervisor consumes, plus
+everything needed to re-derive them), written by `record()` during a
+trial or rollout and replayed by `review()` through the `TrialFSM` with
+fresh thresholds. Use cases match the reference's:
+
+- re-score an old run after tuning supervisor thresholds (the reference's
+  reason for replaying bags instead of re-flying);
+- archive Monte-Carlo evidence next to the CSV so any row can be audited
+  tick-by-tick;
+- cross-check a live `TrialFSM` outcome against the batch `evaluate()`
+  path on identical inputs.
+
+Format: npz with ``q`` (T, n, 3), ``distcmd_norm`` (T, n), ``ca_active``
+(T, n), ``reassigned`` (T,), ``auctioned`` (T,), ``assign_valid`` (T,),
+``mode`` (T, n), ``v2f`` (T, n), scalar ``dt``, plus free-form metadata
+under ``meta_*`` keys.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from aclswarm_tpu.harness.supervisor import NAMES, TrialFSM
+
+_FIELDS = ("q", "distcmd_norm", "ca_active", "reassigned", "auctioned",
+           "assign_valid", "mode", "v2f")
+
+
+def record(path: str, metrics, dt: float = 0.01, **meta) -> str:
+    """Write a rollout's `StepMetrics` stack (leading time axis) to a
+    compressed npz "bag"."""
+    arrays = {f: np.asarray(getattr(metrics, f)) for f in _FIELDS}
+    arrays["dt"] = np.asarray(dt)
+    for k, v in meta.items():
+        arrays[f"meta_{k}"] = np.asarray(v)
+    np.savez_compressed(path, **arrays)
+    return path
+
+
+class Recording:
+    """A loaded bag; attribute access mirrors `StepMetrics`."""
+
+    def __init__(self, path: str):
+        data = np.load(path)
+        for f in _FIELDS:
+            setattr(self, f, data[f])
+        self.dt = float(data["dt"])
+        self.meta = {k[5:]: data[k] for k in data.files
+                     if k.startswith("meta_")}
+
+    @property
+    def n_ticks(self) -> int:
+        return self.q.shape[0]
+
+    @property
+    def n(self) -> int:
+        return self.q.shape[1]
+
+
+def review(path: str, n_formations: int = 1,
+           takeoff_alt: Optional[float] = None,
+           verbose: bool = False) -> TrialFSM:
+    """Replay a recorded rollout through the trial supervisor FSM — the
+    `review_bag.py` loop with the recording as the message stream. The
+    recording must start on the ground for the takeoff phase to evaluate
+    (recordings of airborne rollouts should instead use
+    `supervisor.evaluate`, the post-takeoff batch oracle). Returns the
+    finished (or exhausted) FSM.
+    """
+    rec = Recording(path)
+    if takeoff_alt is None:
+        from aclswarm_tpu.core.types import SafetyParams
+        takeoff_alt = float(SafetyParams().takeoff_alt)
+    fsm = TrialFSM(rec.n, n_formations, takeoff_alt=takeoff_alt, dt=rec.dt)
+    auction_ok = rec.auctioned & rec.assign_valid
+    # the reference reviewer asks a human "/in_formation"; the recording
+    # carries the machine signals, so events are re-derived exactly as the
+    # trial driver derives them: after each formation dispatch, the first
+    # valid auction counts as an accepted assignment even if unchanged
+    # (`auctioneer.cpp:310-316` formation_just_received semantics)
+    awaiting_first = False
+    for t in range(rec.n_ticks):
+        event = bool(rec.reassigned[t])
+        if awaiting_first and bool(auction_ok[t]):
+            event = True
+            awaiting_first = False
+        action = fsm.step(rec.q[t], rec.distcmd_norm[t], rec.ca_active[t],
+                          event)
+        if action == "dispatch":
+            awaiting_first = True
+        if fsm.done:
+            break
+    if verbose:
+        print(f"review: {NAMES[fsm.state]} after {t + 1}/{rec.n_ticks} "
+              f"ticks; conv times {[round(x, 2) for x in fsm.times]}")
+    return fsm

@@ -82,6 +82,9 @@ class TrialConfig:
     sim_min_dist: float = 2.0
     sim_formations: int = 2
     verbose: bool = True
+    # per-trial rollout recordings ("bags", `harness.review`): directory
+    # for trial_<k>.npz files, or None to skip
+    record_dir: Optional[str] = None
 
 
 _SIMFORM = re.compile(r"^simform(\d+)$")
@@ -155,6 +158,7 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
     formation_just_received = False
     chunk = cfg.chunk_ticks
     max_ticks = int(TRIAL_TIMEOUT / cfg.control_dt) + 10 * chunk
+    recorded: list = []
 
     for _ in range(max_ticks // chunk + 1):
         if fsm.done:
@@ -170,6 +174,8 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
             joy_active=jnp.zeros((chunk, n), bool))
         state, metrics = sim.rollout(state, cur_formation, cgains, sparams,
                                      cur_cfg, chunk, inputs)
+        if cfg.record_dir is not None:
+            recorded.append(metrics)
         q = np.asarray(metrics.q)
         dn = np.asarray(metrics.distcmd_norm)
         ca = np.asarray(metrics.ca_active)
@@ -206,6 +212,19 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
             formation_just_received = True
             pending_dispatch = None
 
+    if cfg.record_dir is not None and recorded:
+        import jax
+
+        from aclswarm_tpu.harness import review
+        from pathlib import Path
+        stacked = jax.tree.map(
+            lambda *xs: np.concatenate([np.asarray(x) for x in xs]),
+            *recorded)
+        outdir = Path(cfg.record_dir)
+        outdir.mkdir(parents=True, exist_ok=True)
+        review.record(str(outdir / f"trial_{trial_idx}.npz"), stacked,
+                      dt=cfg.control_dt, seed=seed,
+                      formation=cfg.formation)
     return fsm
 
 

@@ -1,0 +1,109 @@
+"""Recorded-rollout reviewer tests (the `review_bag.py` analogue)."""
+import numpy as np
+import pytest
+
+from aclswarm_tpu.harness import review
+from aclswarm_tpu.harness.supervisor import (COMPLETE, NAMES, TrialFSM,
+                                             evaluate)
+
+
+def _synthetic_metrics(T=2200, n=4, dt=0.01, takeoff_alt=1.0):
+    """A hand-built 'bag': ground start, takeoff ramp, one auction, quick
+    convergence — the happy path of a 1-formation trial."""
+    class M:
+        pass
+
+    m = M()
+    t = np.arange(T)
+    z = np.clip((t - 100) * 0.005, 0.0, takeoff_alt)     # ramp from tick 100
+    m.q = np.zeros((T, n, 3))
+    m.q[:, :, 0] = np.arange(n)[None, :] * 2.0
+    m.q[:, :, 2] = z[:, None]
+    m.distcmd_norm = np.full((T, n), 2.0)
+    m.distcmd_norm[t > 700] = 0.1                        # converges at 7 s
+    m.ca_active = np.zeros((T, n), bool)
+    m.reassigned = np.zeros(T, bool)
+    m.auctioned = np.zeros(T, bool)
+    m.assign_valid = np.ones(T, bool)
+    # periodic auto-auction once airborne (1.2 s period), so an accepted
+    # assignment lands shortly after the supervisor starts waiting for one
+    m.auctioned[400::120] = True
+    m.reassigned[400] = True
+    m.mode = np.full((T, n), 2, np.int32)
+    m.v2f = np.tile(np.arange(n, dtype=np.int32), (T, 1))
+    return m
+
+
+class TestRecordReplay:
+    def test_roundtrip_fields(self, tmp_path):
+        m = _synthetic_metrics()
+        path = str(tmp_path / "trial.npz")
+        review.record(path, m, dt=0.01, seed=7, formation="swarm4")
+        rec = review.Recording(path)
+        np.testing.assert_array_equal(rec.q, m.q)
+        np.testing.assert_array_equal(rec.distcmd_norm, m.distcmd_norm)
+        assert rec.dt == 0.01
+        assert int(rec.meta["seed"]) == 7
+        assert str(rec.meta["formation"]) == "swarm4"
+        assert rec.n == 4 and rec.n_ticks == m.q.shape[0]
+
+    def test_review_completes_happy_path(self, tmp_path):
+        m = _synthetic_metrics()
+        path = str(tmp_path / "trial.npz")
+        review.record(path, m, dt=0.01)
+        fsm = review.review(path, n_formations=1, takeoff_alt=1.0)
+        assert fsm.completed, NAMES[fsm.state]
+        # logging starts at FLYING entry (~7.9 s) and stops at
+        # IN_FORMATION exit; the synthetic signals converge ~2-4 s later
+        assert 0.0 < fsm.times[0] < 10.0
+
+    def test_review_matches_live_fsm(self, tmp_path):
+        """Replaying a recording yields the same outcome as stepping the
+        FSM live on the same signals — one oracle, two feeds."""
+        m = _synthetic_metrics()
+        path = str(tmp_path / "trial.npz")
+        review.record(path, m, dt=0.01)
+        replay = review.review(path, n_formations=1, takeoff_alt=1.0)
+
+        live = TrialFSM(4, 1, takeoff_alt=1.0, dt=0.01)
+        awaiting = False
+        for t in range(m.q.shape[0]):
+            event = bool(m.reassigned[t])
+            if awaiting and m.auctioned[t] and m.assign_valid[t]:
+                event, awaiting = True, False
+            action = live.step(m.q[t], m.distcmd_norm[t], m.ca_active[t],
+                               event)
+            if action == "dispatch":
+                awaiting = True
+            if live.done:
+                break
+        assert replay.state == live.state
+        assert replay.times == live.times
+        np.testing.assert_allclose(replay.csv_row(0), live.csv_row(0))
+
+    def test_trial_records_reviewable_bag(self, tmp_path):
+        """End-to-end: a trial with record_dir writes a bag whose replay
+        reproduces the trial's own outcome (the review.launch workflow)."""
+        from aclswarm_tpu.harness import trials
+        cfg = trials.TrialConfig(formation="swarm4", trials=1, seed=3,
+                                 out=str(tmp_path / "t.csv"), verbose=False,
+                                 record_dir=str(tmp_path / "bags"))
+        fsm_live = trials.run_trial(cfg, 0)
+        bag = tmp_path / "bags" / "trial_0.npz"
+        assert bag.exists()
+        rec = review.Recording(str(bag))
+        assert str(rec.meta["formation"]) == "swarm4"
+        fsm_replay = review.review(str(bag), n_formations=fsm_live.n_formations)
+        assert fsm_replay.completed == fsm_live.completed
+        # convergence times agree to the chunk latency (the live driver
+        # applies dispatches at chunk boundaries; replay sees the recorded
+        # signal stream, so event timing matches exactly)
+        assert np.allclose(fsm_replay.times, fsm_live.times)
+
+    def test_review_flags_no_takeoff(self, tmp_path):
+        m = _synthetic_metrics()
+        m.q[:, :, 2] = 0.0                   # never leaves the ground
+        path = str(tmp_path / "trial.npz")
+        review.record(path, m, dt=0.01)
+        fsm = review.review(path, n_formations=1, takeoff_alt=1.0)
+        assert not fsm.completed

@@ -16,7 +16,8 @@ import pytest
 from aclswarm_tpu import harness, sim
 from aclswarm_tpu.core import perm as permutil
 from aclswarm_tpu.core import geometry
-from aclswarm_tpu.core.types import ControlGains, SafetyParams
+from aclswarm_tpu.core.types import (ControlGains, SafetyParams,
+                                     make_formation)
 from aclswarm_tpu.harness import supervisor
 
 REF_FORMATIONS = "/root/reference/aclswarm/param/formations.yaml"
@@ -236,3 +237,55 @@ class TestSupervisor:
         assert d[0] < 0.1
         assert 4.0 < d[1] < 5.1
         assert d[1] > 40 * d[0]
+
+
+class TestGridlockFromDynamics:
+    """Gridlock produced by the *closed-loop dynamics*, not synthetic
+    ca_active series (round-1 review weak #6): with CBAA assignment on a
+    ring+chord graph, seed-7 initial conditions deadlock the swarm in
+    mutual collision avoidance, and the supervisor's oracle detects it
+    from the rollout's own signals (SURVEY.md hard part 4)."""
+
+    def _rollout(self, seed, assignment):
+        from aclswarm_tpu import gains as gainslib
+        rng = np.random.default_rng(seed)
+        n = 6
+        adj = np.zeros((n, n))
+        for i in range(n):
+            adj[i, (i + 1) % n] = adj[(i + 1) % n, i] = 1
+        adj[0, 3] = adj[3, 0] = 1
+        ang = np.linspace(0, 2 * np.pi, n, endpoint=False)
+        pts = np.stack([3 * np.cos(ang), 3 * np.sin(ang),
+                        np.full(n, 1.5)], 1)
+        G = np.asarray(gainslib.solve_gains(pts, adj))
+        formation = make_formation(pts, adj, G)
+        q0 = rng.normal(size=(n, 3)) * 2.0
+        q0[:, 2] = 1.5
+        cfg = sim.SimConfig(assignment=assignment, dynamics="firstorder")
+        state = sim.init_state(jnp.asarray(q0))
+        _, metrics = sim.rollout(state, formation, ControlGains(),
+                                 SafetyParams(), cfg, 3000)
+        return metrics
+
+    def test_cbaa_seed7_gridlocks_and_supervisor_detects(self):
+        m = self._rollout(7, "cbaa")
+        res = supervisor.evaluate(
+            np.asarray(m.distcmd_norm), np.asarray(m.ca_active),
+            np.asarray(m.q), np.asarray(m.reassigned),
+            np.asarray(m.assign_valid), 0.01)
+        assert res.gridlocked          # emerged from the dynamics
+        assert not res.converged
+        # every vehicle is avoidance-locked at the end
+        assert np.asarray(m.ca_active)[-100:].mean() > 0.95
+
+    def test_centralized_auction_escapes_same_seed(self):
+        """The centralized-vs-decentralized comparison the reference's
+        toggle exists for: exact reassignment breaks the deadlock the
+        consensus auction cannot."""
+        m = self._rollout(7, "auction")
+        res = supervisor.evaluate(
+            np.asarray(m.distcmd_norm), np.asarray(m.ca_active),
+            np.asarray(m.q), np.asarray(m.reassigned),
+            np.asarray(m.assign_valid), 0.01)
+        assert res.converged
+        assert not res.gridlock_terminated

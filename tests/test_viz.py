@@ -1,0 +1,54 @@
+"""Smoke tests for the offline visualization (`viz_commands.py` analogue)."""
+import numpy as np
+import pytest
+
+matplotlib = pytest.importorskip("matplotlib")
+
+from aclswarm_tpu import sim
+from aclswarm_tpu.core.types import ControlGains, SafetyParams, make_formation
+from aclswarm_tpu.harness import viz
+
+
+@pytest.fixture(scope="module")
+def short_rollout():
+    n = 4
+    pts = np.array([[0., 0, 1], [2, 0, 1], [2, 2, 1], [0, 2, 1]])
+    adj = np.ones((n, n)) - np.eye(n)
+    from aclswarm_tpu import gains as gainslib
+    G = np.asarray(gainslib.solve_gains(pts, adj))
+    formation = make_formation(pts, adj, G)
+    rng = np.random.default_rng(0)
+    q0 = rng.normal(size=(n, 3)); q0[:, 2] = 1.0
+    state = sim.init_state(q0)
+    cfg = sim.SimConfig(dynamics="firstorder")
+    _, metrics = sim.rollout(state, formation, ControlGains(),
+                             SafetyParams(), cfg, 600)
+    return metrics, formation
+
+
+def test_plot_rollout(short_rollout, tmp_path):
+    metrics, formation = short_rollout
+    out = viz.plot_rollout(metrics, formation, str(tmp_path / "r.png"))
+    assert (tmp_path / "r.png").stat().st_size > 10_000
+
+
+def test_plot_timeseries(short_rollout, tmp_path):
+    metrics, formation = short_rollout
+    viz.plot_timeseries(metrics, str(tmp_path / "t.png"))
+    assert (tmp_path / "t.png").stat().st_size > 10_000
+
+
+def test_aligned_formation_properties(short_rollout):
+    metrics, formation = short_rollout
+    q = np.asarray(metrics.q[-1])
+    v2f = np.asarray(metrics.v2f[-1])
+    pts = np.asarray(formation.points)
+    goal = viz.aligned_formation(q, pts, v2f)
+    # rigid alignment: the displayed goal preserves the formation's shape
+    # (pairwise distances) in vehicle order
+    want = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+    have = np.linalg.norm(goal[:, None] - goal[None, :], axis=-1)
+    np.testing.assert_allclose(have, want[np.ix_(v2f, v2f)], atol=1e-8)
+    # d=2 alignment matches the swarm's xy centroid
+    np.testing.assert_allclose(goal[:, :2].mean(0), q[:, :2].mean(0),
+                               atol=1e-8)
