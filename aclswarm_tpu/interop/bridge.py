@@ -1,0 +1,127 @@
+"""Planner bridge process: the `backend=tpu` daemon on the wire transport.
+
+The north-star deployment shape: one host process owns the device planner
+(`TpuPlanner`) and speaks the wire API over shm channels — the
+coordination-stack replacement the rest of a vehicle/SIL system talks to.
+Channels (one directed ring each, created by this process):
+
+    <ns>-formation   in   Formation        (operator dispatches)
+    <ns>-estimates   in   VehicleEstimates (state feed, one per tick)
+    <ns>-distcmd     out  DistCmd          (velocity goals per tick)
+    <ns>-assignment  out  Assignment       (on newly accepted assignments)
+
+Run:  python -m aclswarm_tpu.interop.bridge --n 6 --ns /asw [--ticks K]
+
+The loop is deliberately dumb: drain formation channel -> commit; read one
+estimates message -> tick -> write outputs. Pacing is driven by the
+estimate producer (the reference's coordination node is likewise driven
+by its 100 Hz timer against the latest state, `coordination_ros.cpp
+:370-378`). Exits after --ticks estimate messages (0 = run until the
+formation channel delivers a `Formation` named "__shutdown__").
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from aclswarm_tpu.interop import messages as m
+
+SHUTDOWN = "__shutdown__"
+
+
+def _send_reliable(channel, msg, grace_s: float = 1.0,
+                   poll_s: float = 0.001) -> bool:
+    """Send with bounded retry through backpressure; a drop after the
+    grace period is loud (the reference's 'queue size 1 but don't want to
+    lose any' intent, `coordination_ros.cpp:417-418`)."""
+    import time
+
+    deadline = time.time() + grace_s
+    while not channel.send(msg):
+        if time.time() > deadline:
+            print(f"bridge: DROPPED {type(msg).__name__} on "
+                  f"{channel.name} after {grace_s}s backpressure",
+                  flush=True)
+            return False
+        time.sleep(poll_s)
+    return True
+
+
+def run_bridge(n: int, ns: str = "/asw", ticks: int = 0,
+               assignment: str = "auction", assign_every: int = 120,
+               poll_s: float = 0.001, idle_timeout_s: float = 60.0,
+               verbose: bool = False) -> int:
+    """Serve the planner over shm channels; returns ticks served."""
+    import time
+
+    from aclswarm_tpu.interop.planner import TpuPlanner
+    from aclswarm_tpu.interop.transport import Channel
+
+    planner = TpuPlanner(n, assignment=assignment,
+                         assign_every=assign_every)
+    served = 0
+    with Channel(f"{ns}-formation", create=True) as ch_form, \
+            Channel(f"{ns}-estimates", create=True) as ch_est, \
+            Channel(f"{ns}-distcmd", create=True) as ch_cmd, \
+            Channel(f"{ns}-assignment", create=True) as ch_asn:
+        if verbose:
+            print(f"bridge up: ns={ns} n={n}", flush=True)
+        deadline = time.time() + idle_timeout_s
+        while True:
+            progressed = False
+            msg = ch_form.recv()
+            if isinstance(msg, m.Formation):
+                if msg.name == SHUTDOWN:
+                    break
+                planner.handle_formation(msg)
+                progressed = True
+                if verbose:
+                    print(f"committed formation {msg.name!r}", flush=True)
+            est = ch_est.recv()
+            if isinstance(est, m.VehicleEstimates):
+                out = planner.tick(est)
+                _send_reliable(ch_cmd, m.DistCmd(header=est.header,
+                                                 vel=out.distcmd))
+                if out.assignment is not None:
+                    # an Assignment is emitted once per acceptance and
+                    # never re-sent — a silent drop would leave consumers
+                    # on a stale permutation permanently, so block through
+                    # transient backpressure
+                    _send_reliable(ch_asn, m.Assignment(
+                        header=est.header,
+                        perm=out.assignment.astype(np.int32)),
+                        grace_s=5.0)
+                served += 1
+                progressed = True
+                if ticks and served >= ticks:
+                    break
+            if progressed:
+                deadline = time.time() + idle_timeout_s
+            elif time.time() > deadline:
+                break
+            else:
+                time.sleep(poll_s)
+    return served
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, required=True)
+    ap.add_argument("--ns", default="/asw")
+    ap.add_argument("--ticks", type=int, default=0)
+    ap.add_argument("--assignment", default="auction")
+    ap.add_argument("--assign-every", type=int, default=120)
+    ap.add_argument("--idle-timeout", type=float, default=60.0)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    served = run_bridge(args.n, args.ns, args.ticks, args.assignment,
+                        args.assign_every,
+                        idle_timeout_s=args.idle_timeout,
+                        verbose=args.verbose)
+    print(f"bridge served {served} ticks", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

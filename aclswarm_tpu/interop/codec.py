@@ -21,6 +21,8 @@ Payload layouts (all little-endian, no padding):
                     f32 price[n], i32 who[n]
     VehicleEst.  := Header, u32 n, (f64 stamp, f64 x, f64 y, f64 z)[n]
     SafetyStatus := Header, u8 active
+    DistCmd      := Header, u32 n, f64 vel[n*3]
+    Assignment   := Header, u32 n, i32 perm[n]
 
 The format exists so non-Python processes (the reference's C++ nodes, a
 ROS bridge) can exchange planner traffic with zero dependencies — it is
@@ -100,6 +102,16 @@ def _payload(msg) -> tuple[int, bytes]:
         return m.MSG_SAFETY_STATUS, (
             _pack_header(msg.header)
             + struct.pack("<B", int(msg.collision_avoidance_active)))
+    if isinstance(msg, m.DistCmd):
+        n = msg.vel.shape[0]
+        return m.MSG_DIST_CMD, b"".join([
+            _pack_header(msg.header), struct.pack("<I", n),
+            np.ascontiguousarray(msg.vel, "<f8").tobytes()])
+    if isinstance(msg, m.Assignment):
+        n = msg.perm.shape[0]
+        return m.MSG_ASSIGNMENT, b"".join([
+            _pack_header(msg.header), struct.pack("<I", n),
+            np.ascontiguousarray(msg.perm, "<i4").tobytes()])
     raise TypeError(f"not a wire message: {type(msg)!r}")
 
 
@@ -162,4 +174,14 @@ def decode(buf: bytes):
         (active,) = struct.unpack_from("<B", payload, off)
         return m.SafetyStatus(header=header,
                               collision_avoidance_active=bool(active))
+    if mtype == m.MSG_DIST_CMD:
+        (n,) = struct.unpack_from("<I", payload, off)
+        off += 4
+        vel = np.frombuffer(payload, "<f8", n * 3, off).reshape(n, 3).copy()
+        return m.DistCmd(header=header, vel=vel)
+    if mtype == m.MSG_ASSIGNMENT:
+        (n,) = struct.unpack_from("<I", payload, off)
+        off += 4
+        perm = np.frombuffer(payload, "<i4", n, off).copy()
+        return m.Assignment(header=header, perm=perm)
     raise ValueError(f"unknown message type {mtype}")

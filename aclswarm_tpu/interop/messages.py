@@ -36,6 +36,13 @@ MSG_FORMATION = 1
 MSG_CBAA = 2
 MSG_VEHICLE_ESTIMATES = 3
 MSG_SAFETY_STATUS = 4
+# planner output stream: the reference carries these as std/geometry
+# messages (`distcmd` = Vector3Stamped per vehicle,
+# `coordination_ros.cpp:80`; `assignment` = UInt8MultiArray,
+# `:293-297`); batched equivalents so the output side of the boundary is
+# wire-shaped too
+MSG_DIST_CMD = 5
+MSG_ASSIGNMENT = 6
 
 
 @dataclasses.dataclass
@@ -108,6 +115,32 @@ class SafetyStatus:
 
     header: Header
     collision_avoidance_active: bool
+
+
+@dataclasses.dataclass
+class DistCmd:
+    """Batched `distcmd`: the distributed controller's velocity goals for
+    every vehicle (the reference publishes one Vector3Stamped per vehicle,
+    `coordination_ros.cpp:80,370-378`)."""
+
+    header: Header
+    vel: np.ndarray                 # (n, 3) float64
+
+    def __post_init__(self):
+        self.vel = np.ascontiguousarray(self.vel, dtype=np.float64)
+
+
+@dataclasses.dataclass
+class Assignment:
+    """Batched `assignment` topic: the accepted permutation, vehicle ->
+    formation point (`UInt8MultiArray`, `coordination_ros.cpp:293-297`;
+    int32 here so n > 255 swarms fit)."""
+
+    header: Header
+    perm: np.ndarray                # (n,) int32 v2f
+
+    def __post_init__(self):
+        self.perm = np.ascontiguousarray(self.perm, dtype=np.int32)
 
 
 def formation_from_spec(spec, seq: int = 0, stamp: float = 0.0) -> Formation:

@@ -70,6 +70,18 @@ def load() -> Optional[C.CDLL]:
          [C.c_uint32, C.c_double, C.c_char_p, C.c_int, u8p, C.c_uint64])
     _sig(lib.asw_decode_status, C.c_int,
          [u8p, C.c_uint64, u32p, f64p, intp])
+    _sig(lib.asw_encode_distcmd, C.c_int64,
+         [C.c_uint32, C.c_double, C.c_char_p, C.c_uint32, f64p, u8p,
+          C.c_uint64])
+    _sig(lib.asw_distcmd_n, C.c_int, [u8p, C.c_uint64, u32p])
+    _sig(lib.asw_decode_distcmd, C.c_int,
+         [u8p, C.c_uint64, u32p, f64p, f64p])
+    _sig(lib.asw_encode_assignment, C.c_int64,
+         [C.c_uint32, C.c_double, C.c_char_p, C.c_uint32, i32p, u8p,
+          C.c_uint64])
+    _sig(lib.asw_assignment_n, C.c_int, [u8p, C.c_uint64, u32p])
+    _sig(lib.asw_decode_assignment, C.c_int,
+         [u8p, C.c_uint64, u32p, f64p, i32p])
     _sig(lib.asw_ring_open, C.c_void_p, [C.c_char_p, C.c_uint32, C.c_int])
     _sig(lib.asw_ring_close, None, [C.c_void_p, C.c_int])
     _sig(lib.asw_ring_write, C.c_int, [C.c_void_p, u8p, C.c_uint32])

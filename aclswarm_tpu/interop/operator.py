@@ -1,0 +1,105 @@
+"""Operator / base station over the wire boundary (L6, `operator.py`).
+
+The reference operator is a Python base-station node: it loads a formation
+group, manages the flight-mode service (START while flying cycles to the
+next formation, END lands, KILL cuts motors — `aclswarm/nodes/operator.py
+:118-136`), and publishes `Formation` messages with or without precomputed
+gains (`buildFormationMessage`, `:138-213`).
+
+This module is the same role, ROS-free: an `Operator` that cycles a
+library group and emits wire `Formation` messages into a transport
+channel (or any callable sink). Flight-mode broadcast in this framework
+is the engine's `ExternalInputs.cmd` (the sim side) or the embedding
+system's concern (hardware); the operator's job at this boundary is the
+formation dispatch stream. Entry point:
+
+    python -m aclswarm_tpu.interop.operator --group swarm6_3d \
+        --channel /asw-formation --dispatch 2
+
+publishes the group's formations (cycling on each --dispatch, period in
+seconds) to a planner/bridge process listening on the channel.
+"""
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from aclswarm_tpu.interop import messages as m
+
+
+class Operator:
+    """Formation dispatch with the reference's cycling semantics.
+
+    ``send`` is any sink accepting a wire message (e.g. a transport
+    `Channel.send`); ``send_gains`` mirrors the operator's option to ship
+    precomputed library gains or let vehicles solve on commit
+    (`operator.py:184-210`, README FAQ).
+    """
+
+    def __init__(self, group: str, library: Optional[str] = None,
+                 send_gains: bool = True):
+        from aclswarm_tpu.harness import formations as formlib
+        self.specs = formlib.load_group(library, group)
+        self.group = group
+        self.send_gains = send_gains
+        self.idx = -1            # START cycles to the next formation
+        self.seq = 0
+
+    @property
+    def n(self) -> int:
+        return self.specs[0].n
+
+    def next_formation(self, stamp: float = 0.0) -> m.Formation:
+        """The START-while-flying action: advance the cycle and build the
+        Formation message (`operator.py:128-134,138-153`)."""
+        self.idx = (self.idx + 1) % len(self.specs)
+        spec = self.specs[self.idx]
+        self.seq += 1
+        msg = m.formation_from_spec(spec, seq=self.seq, stamp=stamp)
+        if not self.send_gains:
+            msg.gains = None
+        return msg
+
+    def dispatch(self, send: Callable[[object], object],
+                 stamp: float = 0.0) -> m.Formation:
+        msg = self.next_formation(stamp)
+        send(msg)
+        return msg
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--group", default="swarm6_3d")
+    ap.add_argument("--library", default=None)
+    ap.add_argument("--channel", default="/asw-formation",
+                    help="shm channel to publish Formation messages on")
+    ap.add_argument("--create", action="store_true",
+                    help="create the channel (else open existing)")
+    ap.add_argument("--dispatch", type=float, default=0.0,
+                    help="seconds between dispatches; 0 = send one and exit")
+    ap.add_argument("--cycles", type=int, default=0,
+                    help="stop after this many dispatches (0 = forever)")
+    ap.add_argument("--no-gains", action="store_true",
+                    help="omit library gains (vehicles solve on commit)")
+    args = ap.parse_args(argv)
+
+    from aclswarm_tpu.interop.transport import Channel
+    op = Operator(args.group, args.library, send_gains=not args.no_gains)
+    with Channel(args.channel, create=args.create) as ch:
+        count = 0
+        while True:
+            msg = op.dispatch(ch.send, stamp=time.time())
+            count += 1
+            print(f"dispatched {op.group}/{msg.name} "
+                  f"(formation {op.idx + 1}/{len(op.specs)})", flush=True)
+            if args.dispatch <= 0 or (args.cycles and count >= args.cycles):
+                break
+            time.sleep(args.dispatch)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

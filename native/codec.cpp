@@ -138,7 +138,14 @@ Writer begin_frame(uint8_t* out, size_t cap) {
 extern "C" {
 
 // message type tags (aclswarm_tpu/interop/messages.py MSG_*)
-enum { ASW_FORMATION = 1, ASW_CBAA = 2, ASW_ESTIMATES = 3, ASW_STATUS = 4 };
+enum {
+  ASW_FORMATION = 1,
+  ASW_CBAA = 2,
+  ASW_ESTIMATES = 3,
+  ASW_STATUS = 4,
+  ASW_DIST_CMD = 5,
+  ASW_ASSIGNMENT = 6,
+};
 
 uint32_t asw_crc32(const uint8_t* p, uint64_t n) { return crc32_ieee(p, n); }
 
@@ -314,6 +321,73 @@ int asw_decode_status(const uint8_t* buf, uint64_t len, uint32_t* seq,
   get_header(r, seq, stamp, nullptr, 0);
   uint8_t a = r.scalar<uint8_t>();
   if (active) *active = a;
+  return r.ok ? 0 : -2;
+}
+
+// ---- DistCmd (batched distcmd velocity goals) ----
+int64_t asw_encode_distcmd(uint32_t seq, double stamp, const char* frame_id,
+                           uint32_t n, const double* vel /* n*3 */,
+                           uint8_t* out, uint64_t cap) {
+  Writer w = begin_frame(out, cap);
+  put_header(w, seq, stamp, frame_id);
+  w.scalar<uint32_t>(n);
+  w.bytes(vel, (size_t)n * 3 * 8);
+  return finish_frame(w, ASW_DIST_CMD);
+}
+
+int asw_distcmd_n(const uint8_t* buf, uint64_t len, uint32_t* n) {
+  uint64_t off, plen;
+  if (asw_parse_frame(buf, len, &off, &plen) != ASW_DIST_CMD) return -1;
+  Reader r{buf + off, plen};
+  get_header(r, nullptr, nullptr, nullptr, 0);
+  uint32_t nn = r.scalar<uint32_t>();
+  if (!r.ok) return -2;
+  if (n) *n = nn;
+  return 0;
+}
+
+int asw_decode_distcmd(const uint8_t* buf, uint64_t len, uint32_t* seq,
+                       double* stamp, double* vel) {
+  uint64_t off, plen;
+  if (asw_parse_frame(buf, len, &off, &plen) != ASW_DIST_CMD) return -1;
+  Reader r{buf + off, plen};
+  get_header(r, seq, stamp, nullptr, 0);
+  uint32_t n = r.scalar<uint32_t>();
+  r.bytes(vel, (size_t)n * 3 * 8);
+  return r.ok ? 0 : -2;
+}
+
+// ---- Assignment (accepted permutation) ----
+int64_t asw_encode_assignment(uint32_t seq, double stamp,
+                              const char* frame_id, uint32_t n,
+                              const int32_t* perm, uint8_t* out,
+                              uint64_t cap) {
+  Writer w = begin_frame(out, cap);
+  put_header(w, seq, stamp, frame_id);
+  w.scalar<uint32_t>(n);
+  w.bytes(perm, (size_t)n * 4);
+  return finish_frame(w, ASW_ASSIGNMENT);
+}
+
+int asw_assignment_n(const uint8_t* buf, uint64_t len, uint32_t* n) {
+  uint64_t off, plen;
+  if (asw_parse_frame(buf, len, &off, &plen) != ASW_ASSIGNMENT) return -1;
+  Reader r{buf + off, plen};
+  get_header(r, nullptr, nullptr, nullptr, 0);
+  uint32_t nn = r.scalar<uint32_t>();
+  if (!r.ok) return -2;
+  if (n) *n = nn;
+  return 0;
+}
+
+int asw_decode_assignment(const uint8_t* buf, uint64_t len, uint32_t* seq,
+                          double* stamp, int32_t* perm) {
+  uint64_t off, plen;
+  if (asw_parse_frame(buf, len, &off, &plen) != ASW_ASSIGNMENT) return -1;
+  Reader r{buf + off, plen};
+  get_header(r, seq, stamp, nullptr, 0);
+  uint32_t n = r.scalar<uint32_t>();
+  r.bytes(perm, (size_t)n * 4);
   return r.ok ? 0 : -2;
 }
 
