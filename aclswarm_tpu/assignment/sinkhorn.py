@@ -29,11 +29,26 @@ class SinkhornResult(NamedTuple):
 
 
 def sinkhorn_log(cost: jnp.ndarray, tau: float = 0.03,
-                 n_iters: int = 200) -> jnp.ndarray:
+                 n_iters: int = 200, impl: str = "xla") -> jnp.ndarray:
     """Log-domain Sinkhorn on a square cost matrix; returns log plan (n, n).
 
     Uniform marginals (every vehicle gets exactly one formation point).
+    ``impl``: 'xla' (the scan below — HBM-streaming, any backend/dtype) or
+    'pallas' (VMEM-resident TPU kernel, `aclswarm_tpu.ops.sinkhorn_pallas`
+    — the loop-invariant (n, n) matrix stays on-chip across all
+    iterations; f32).
     """
+    if impl == "pallas":
+        import jax as _jax
+
+        from aclswarm_tpu.ops import sinkhorn_log_pallas
+        # off-TPU the Mosaic compiler is unavailable; route through the
+        # Pallas interpreter (slow but correct) instead of crashing
+        return sinkhorn_log_pallas(
+            cost, tau=tau, n_iters=n_iters,
+            interpret=_jax.default_backend() != "tpu")
+    if impl != "xla":
+        raise ValueError(f"unknown sinkhorn impl {impl!r}")
     n = cost.shape[0]
     logK = -cost / tau
     log_mu = jnp.full((n,), -jnp.log(n), dtype=cost.dtype)
@@ -161,7 +176,9 @@ def two_opt_refine(cost: jnp.ndarray, v2f: jnp.ndarray,
     its best swap partner; mutually-best positive-gain pairs swap
     simultaneously. Each sweep is a few (n, n) vector ops. Greedy roundings
     of entropic plans land ~8% above the LAP optimum on hard instances;
-    ~20 sweeps repair that to ~1% for ~2 ms at n=1000."""
+    ~10-12 sweeps repair that to ~1.3% and converge (12 vs 20 sweeps is
+    quality-identical, measured over random n=1000 instances); each sweep
+    costs ~45 us at n=1000."""
     n = cost.shape[0]
     idx = jnp.arange(n)
 
@@ -181,7 +198,8 @@ def two_opt_refine(cost: jnp.ndarray, v2f: jnp.ndarray,
 def sinkhorn_assign(q: jnp.ndarray, p_aligned: jnp.ndarray,
                     tau: float = 0.03, n_iters: int = 200,
                     rounding: str = "dominant",
-                    refine_sweeps: int = 20) -> SinkhornResult:
+                    refine_sweeps: int = 12,
+                    impl: str = "xla") -> SinkhornResult:
     """Fast assignment: vehicle->point distances, Sinkhorn, rounding, repair.
 
     Cost uses the same distance the reference prices bids with
@@ -192,10 +210,12 @@ def sinkhorn_assign(q: jnp.ndarray, p_aligned: jnp.ndarray,
     parallel 2-opt repair against the true distance cost.
     """
     from aclswarm_tpu.core import geometry
-    cost_raw = geometry.cdist(q, p_aligned)
+    # the n=1000 fast path prices with the MXU distance (see cdist_fast:
+    # the broadcast cdist was the single largest cost of this pipeline)
+    cost_raw = geometry.cdist_fast(q, p_aligned)
     # normalize scale so tau is formation-size independent
     cost = cost_raw / (jnp.mean(cost_raw) + 1e-12)
-    plan_log = sinkhorn_log(cost, tau=tau, n_iters=n_iters)
+    plan_log = sinkhorn_log(cost, tau=tau, n_iters=n_iters, impl=impl)
     if rounding == "dominant":
         v2f = round_dominant(plan_log)
     elif rounding == "parallel":

@@ -1,0 +1,10 @@
+"""Hand-written TPU kernels (Pallas) for the hot ops.
+
+XLA's fusions cover almost everything in this framework; kernels live here
+only where keeping state resident in VMEM across a whole iteration loop
+beats anything the compiler will do — currently the Sinkhorn assignment
+iteration (`sinkhorn_pallas`).
+"""
+from aclswarm_tpu.ops.sinkhorn_pallas import sinkhorn_log_pallas
+
+__all__ = ["sinkhorn_log_pallas"]

@@ -1,0 +1,91 @@
+"""Pallas TPU kernel: VMEM-resident log-domain Sinkhorn iterations.
+
+The XLA version (`assignment.sinkhorn.sinkhorn_log`) scans ~200 coupled
+row/column logsumexp updates over a loop-invariant (n, n) kernel matrix;
+each scan step re-reads that matrix from HBM twice, so at n=1000 the loop
+moves ~1.6 GB of HBM traffic for 4 MB of actual data — the classic case
+for a hand-written kernel. This implementation loads ``logK`` into VMEM
+once (4 MB at n=1000 f32, well under the ~16 MB/core budget) and runs the
+entire `fori_loop` against it on the VPU; the only HBM traffic is one
+load and one store of the plan.
+
+Semantics match `sinkhorn_log` exactly (uniform marginals, same update
+order); padding to the 128-lane tile uses a large-negative sentinel and
+row/column validity masks so padded entries contribute zero mass. The
+kernel is f32 (TPU-native); callers wanting f64 CPU numerics use the XLA
+path — `assignment.sinkhorn.sinkhorn_log(..., impl=...)` routes.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG = -1e30  # "minus infinity" that survives f32 arithmetic without NaNs
+
+
+def _kernel(logK_ref, out_ref, *, n_iters: int, nvalid: int, log_mu: float):
+    logK = logK_ref[:]                                   # (N, N) in VMEM
+    N = logK.shape[0]
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (N, 1), 0)
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
+    valid_r = row_ids < nvalid
+    valid_c = col_ids < nvalid
+    neg = jnp.float32(NEG)
+    mu = jnp.float32(log_mu)
+
+    def lse_rows(M):                                     # (N, N) -> (N, 1)
+        m = jnp.max(M, axis=1, keepdims=True)
+        return m + jnp.log(jnp.sum(jnp.exp(M - m), axis=1, keepdims=True))
+
+    def lse_cols(M):                                     # (N, N) -> (1, N)
+        m = jnp.max(M, axis=0, keepdims=True)
+        return m + jnp.log(jnp.sum(jnp.exp(M - m), axis=0, keepdims=True))
+
+    def body(_, fg):
+        f, g = fg
+        f = mu - lse_rows(logK + g)
+        f = jnp.where(valid_r, f, neg)                   # padded rows: no mass
+        g = mu - lse_cols(logK + f)
+        g = jnp.where(valid_c, g, neg)
+        return f, g
+
+    f0 = jnp.zeros((N, 1), jnp.float32)
+    g0 = jnp.zeros((1, N), jnp.float32)
+    f, g = jax.lax.fori_loop(0, n_iters, body, (f0, g0))
+    out_ref[:] = logK + f + g
+
+
+def sinkhorn_log_pallas(cost: jnp.ndarray, tau: float = 0.03,
+                        n_iters: int = 200,
+                        interpret: bool = False) -> jnp.ndarray:
+    """Drop-in for `sinkhorn_log`: returns the (n, n) log transport plan.
+
+    ``interpret=True`` runs the Pallas interpreter (CPU test tier — the
+    same kernel code path, minus Mosaic compilation).
+    """
+    n = cost.shape[0]
+    N = max(128, ((n + 127) // 128) * 128)
+    # VMEM budget: input + output + one (N, N) temporary, ~3 * 4B * N^2 of
+    # the ~16 MB/core VMEM. Guard here so oversized calls fail with a clear
+    # message instead of an opaque Mosaic allocation error.
+    if 3 * 4 * N * N > 14 * 2**20:
+        raise ValueError(
+            f"n={n} (padded {N}) exceeds the VMEM-resident kernel's budget "
+            f"(~{3 * 4 * N * N / 2**20:.0f} MB needed); use impl='xla'")
+    logK = jnp.full((N, N), NEG, jnp.float32)
+    logK = logK.at[:n, :n].set((-cost / tau).astype(jnp.float32))
+
+    plan = pl.pallas_call(
+        partial(_kernel, n_iters=int(n_iters), nvalid=int(n),
+                log_mu=-math.log(n)),
+        out_shape=jax.ShapeDtypeStruct((N, N), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(logK)
+    return plan[:n, :n].astype(cost.dtype)

@@ -8,16 +8,20 @@ needs 2n sequential bid rounds. The target here is >= 100 Hz at n=1000
 (`vs_baseline` = value / 100 Hz).
 
 Methodology (pinned after round-1 variance, see VERDICT r1 weak #9):
-- Work is chained inside a single jit: `lax.scan` over K=50 *distinct*
+- Work is chained inside a single jit: `lax.scan` over K=400 *distinct*
   problem instances, so the device cannot dedupe repeated dispatches and
   each scan step is a true dependent computation. Reported value =
   wall-clock / K, median of 5 repeats (median kills one-off host jitter).
 - This is sustained throughput, not single-shot dispatch latency: this
-  environment adds a fixed ~100 ms per-executable-launch overhead through
-  the remote-TPU tunnel (measured: a no-op jit call is ~micro-seconds, any
-  kernel-sized program pays ~100 ms per launch regardless of how much work
-  is inside), which would swamp a single ~3.5 ms assignment. Amortizing
-  over a scanned chain measures the device, not the tunnel.
+  environment adds a fixed ~108 ms per-executable-launch overhead through
+  the remote-TPU tunnel (measured: a K=400 scan of trivial bodies costs
+  the same ~108 ms as one launch), which would swamp a single ~1.5 ms
+  assignment. K=400 bounds the floor's contribution to ~0.27 ms per
+  instance; the steady-state device time is what a pipelined consumer
+  would see.
+- Completion is detected by a host readback of a scalar digest, NOT
+  `block_until_ready` (unreliable through the tunnel — see
+  benchmarks/scale.py `_sync`).
 - Quality is guarded, not assumed: the same kernel config is checked
   against the exact host LAP (`assignment.lapjv`) and the line includes the
   measured suboptimality ratio (target <= 2%).
@@ -28,7 +32,7 @@ from pathlib import Path
 
 BASELINE_HZ = 100.0  # north-star target at n=1000 (BASELINE.md)
 N = 1000
-K = 50
+K = 400
 
 
 def main():
