@@ -207,7 +207,9 @@ def sinkhorn_assign(q: jnp.ndarray, p_aligned: jnp.ndarray,
     ``rounding``: 'dominant' (parallel, == sequential greedy; the n=1000
     fast path), 'parallel' (column-claimant, fastest, loosest), or 'greedy'
     (strict sequential global-argmax). ``refine_sweeps`` > 0 applies
-    parallel 2-opt repair against the true distance cost.
+    parallel 2-opt repair against the (MXU-expansion) distance cost —
+    near-zero distances carry ~sqrt(eps)*scale error, immaterial for swap
+    gains.
     """
     from aclswarm_tpu.core import geometry
     # the n=1000 fast path prices with the MXU distance (see cdist_fast:

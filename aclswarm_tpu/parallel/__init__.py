@@ -2,8 +2,9 @@
 from aclswarm_tpu.parallel.mesh import (AGENT_AXIS, formation_sharding,
                                         make_mesh, replicated, row_sharding,
                                         shard_problem, sim_state_sharding)
+from aclswarm_tpu.parallel import multihost
 from aclswarm_tpu.parallel.rollout import sharded_rollout_fn, sharded_step_fn
 
 __all__ = ["AGENT_AXIS", "make_mesh", "row_sharding", "replicated",
            "sim_state_sharding", "formation_sharding", "shard_problem",
-           "sharded_step_fn", "sharded_rollout_fn"]
+           "sharded_step_fn", "sharded_rollout_fn", "multihost"]

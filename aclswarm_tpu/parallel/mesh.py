@@ -55,16 +55,26 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def sim_state_sharding(mesh: Mesh) -> sim.SimState:
-    """Sharding pytree for `sim.SimState`: per-agent leaves row-sharded."""
+def sim_state_sharding(mesh: Mesh, localization: bool = False
+                       ) -> sim.SimState:
+    """Sharding pytree for `sim.SimState`: per-agent leaves row-sharded.
+
+    ``localization=True`` matches states built with
+    ``init_state(..., localization=True)``: the (n, n, 3) estimate tables
+    shard on the *owning-agent* axis (each shard holds its agents' whole
+    belief vectors — the layout of the reference's per-vehicle tracker
+    processes), so the flood's min-age merge gathers neighbor rows over
+    ICI exactly like the bid consensus."""
     row = row_sharding(mesh)
     rep = replicated(mesh)
+    loc = sim.EstimateTable(est=row, age=row) if localization else None
     return sim.SimState(
         swarm=SwarmState(q=row, vel=row),
         goal=control.TrajGoal(pos=row, vel=row, yaw=row, dyaw=row),
         v2f=row, tick=rep,
         flight=sim.FlightState(mode=row, ticks_in_mode=row,
-                               initial_alt=row, takeoff_alt=row))
+                               initial_alt=row, takeoff_alt=row),
+        loc=loc)
 
 
 def formation_sharding(mesh: Mesh) -> Formation:
@@ -79,7 +89,7 @@ def formation_sharding(mesh: Mesh) -> Formation:
 
 def shard_problem(state: sim.SimState, formation, mesh: Mesh):
     """Place a sim state + formation onto the mesh with the standard layout."""
-    st_sh = sim_state_sharding(mesh)
+    st_sh = sim_state_sharding(mesh, localization=state.loc is not None)
     f_sh = formation_sharding(mesh)
     return (jax.device_put(state, st_sh), jax.device_put(formation, f_sh),
             st_sh, f_sh)

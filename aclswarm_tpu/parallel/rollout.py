@@ -17,9 +17,22 @@ from aclswarm_tpu import sim
 from aclswarm_tpu.parallel import mesh as meshlib
 
 
-def sharded_step_fn(mesh, formation_sharded, gains, sparams, cfg):
+def _loc_in_sharding(cfg, localization):
+    """The sharding spec's loc entry must match the *state's* pytree (an
+    EstimateTable leaf when built with init_state(localization=True), None
+    otherwise) — a mismatch fails at the jit boundary with an opaque
+    pytree-structure error. Default: derived from cfg (the common case
+    where state and cfg agree); pass ``localization`` explicitly for a
+    truth-mode rollout of a state that still carries tables."""
+    return (cfg.localization == "flooded") if localization is None \
+        else localization
+
+
+def sharded_step_fn(mesh, formation_sharded, gains, sparams, cfg,
+                    localization: bool | None = None):
     """Build a jitted, mesh-sharded single-tick function state -> state."""
-    st_sh = meshlib.sim_state_sharding(mesh)
+    st_sh = meshlib.sim_state_sharding(
+        mesh, localization=_loc_in_sharding(cfg, localization))
 
     @partial(jax.jit, in_shardings=(st_sh,),
              out_shardings=(st_sh, meshlib.replicated(mesh)))
@@ -30,9 +43,10 @@ def sharded_step_fn(mesh, formation_sharded, gains, sparams, cfg):
 
 
 def sharded_rollout_fn(mesh, formation_sharded, gains, sparams, cfg,
-                       n_ticks: int):
+                       n_ticks: int, localization: bool | None = None):
     """Build a jitted, mesh-sharded rollout (lax.scan of the sharded step)."""
-    st_sh = meshlib.sim_state_sharding(mesh)
+    st_sh = meshlib.sim_state_sharding(
+        mesh, localization=_loc_in_sharding(cfg, localization))
 
     @partial(jax.jit, in_shardings=(st_sh,), static_argnums=())
     def roll(state):

@@ -92,7 +92,7 @@ def sinkhorn_throughput(n: int, K: int, reps: int, n_iters: int = 50,
 
 
 def bench_all(n: int, quick: bool = False, sharded: bool = False,
-              out: str | None = None):
+              out: str | None = None, gains1000: bool = False):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -139,6 +139,19 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
     # inside d_avoid_thresh (see control.collision_avoidance)
     ca_tag = f"_k{k_ca}" if k_ca is not None else ""
     emit(f"control_tick_n{n}{ca_tag}_hz", 1.0 / dt, "Hz", baseline=100.0)
+
+    # --- streaming re-assignment (north star config 5): the full engine
+    # tick with a fresh Sinkhorn assignment EVERY tick — the gridlock-
+    # recovery mode where the swarm continuously re-auctions ---
+    stream_cfg = sim.SimConfig(assignment="sinkhorn", assign_every=1,
+                               dynamics="firstorder",
+                               colavoid_neighbors=k_ca)
+    ticks_s = 20 if quick else 100
+    stream = jax.jit(lambda s: sim.rollout(
+        s, f, ControlGains(), sp, stream_cfg, ticks_s)[0])
+    dt = _median_time(stream, st, ticks_s, reps)
+    emit(f"streaming_reassign_n{n}{ca_tag}_hz", 1.0 / dt, "Hz",
+         baseline=100.0)
 
     # --- sinkhorn assignment at scale (chained over distinct instances;
     # K = 400 bounds the ~108 ms fixed launch floor to ~0.27 ms/instance) ---
@@ -210,6 +223,19 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
         emit(f"admm_gain_design_n{n_g}{tag}_ms", dt * 1000, "ms",
              chain_k=G)
 
+    # --- gain design at n=1000 (north star config 4, the honest number):
+    # a (3992, 3992)-matrix ADMM solve; runs per formation *dispatch*
+    # (1.2 s auto-auction cadence), not per control tick, so seconds-scale
+    # is usable — but nowhere near 100 Hz, reported as-is. Off by default
+    # (~2 min compile + ~4 s/solve); enable with --gains1000. ---
+    if gains1000:
+        pts1k = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32) * 30)
+        adj1k = np.ones((n, n)) - np.eye(n)
+        g1k = jax.jit(lambda p: gl.solve_gains(
+            p, adj1k, max_nonedges=n - 4).sum())
+        dt = _median_time(g1k, pts1k, 1, max(2, reps - 2))
+        emit(f"admm_gain_design_n{n}_s", dt, "s")
+
     if out:
         path = Path(out)
         path.parent.mkdir(parents=True, exist_ok=True)
@@ -226,6 +252,8 @@ def main():
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--sharded", action="store_true")
     ap.add_argument("--out", default=None)
+    ap.add_argument("--gains1000", action="store_true",
+                    help="include the n=1000 gain-design solve (slow compile)")
     args = ap.parse_args()
     # the axon TPU plugin ignores JAX_PLATFORMS=cpu; apply it through
     # jax.config so virtual-mesh runs actually land on CPU
@@ -233,7 +261,8 @@ def main():
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")
-    bench_all(args.n, args.quick, args.sharded, args.out)
+    bench_all(args.n, args.quick, args.sharded, args.out,
+              gains1000=args.gains1000)
 
 
 if __name__ == "__main__":
