@@ -60,7 +60,7 @@ class TrialConfig:
     out: str = "trials.csv"         # CSV results path (append, reference-style)
     # engine knobs (SimConfig mirror)
     assignment: str = "auction"     # auction | sinkhorn | cbaa
-    dynamics: str = "tracking"      # tracking | firstorder
+    dynamics: str = "tracking"      # tracking | firstorder | doubleint
     localization: str = "truth"     # truth | flooded (L3 estimate tables)
     tau: float = 0.15
     control_dt: float = 0.01

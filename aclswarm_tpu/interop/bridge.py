@@ -26,6 +26,9 @@ import argparse
 import numpy as np
 
 from aclswarm_tpu.interop import messages as m
+from aclswarm_tpu.utils.log import get_logger
+
+log = get_logger("interop.bridge")
 
 SHUTDOWN = "__shutdown__"
 
@@ -40,9 +43,8 @@ def _send_reliable(channel, msg, grace_s: float = 1.0,
     deadline = time.time() + grace_s
     while not channel.send(msg):
         if time.time() > deadline:
-            print(f"bridge: DROPPED {type(msg).__name__} on "
-                  f"{channel.name} after {grace_s}s backpressure",
-                  flush=True)
+            log.warning("DROPPED %s on %s after %ss backpressure",
+                        type(msg).__name__, channel.name, grace_s)
             return False
         time.sleep(poll_s)
     return True
@@ -66,7 +68,7 @@ def run_bridge(n: int, ns: str = "/asw", ticks: int = 0,
             Channel(f"{ns}-distcmd", create=True) as ch_cmd, \
             Channel(f"{ns}-assignment", create=True) as ch_asn:
         if verbose:
-            print(f"bridge up: ns={ns} n={n}", flush=True)
+            log.info("bridge up: ns=%s n=%d", ns, n)
         deadline = time.time() + idle_timeout_s
         while True:
             progressed = False
@@ -77,7 +79,7 @@ def run_bridge(n: int, ns: str = "/asw", ticks: int = 0,
                 planner.handle_formation(msg)
                 progressed = True
                 if verbose:
-                    print(f"committed formation {msg.name!r}", flush=True)
+                    log.info("committed formation %r", msg.name)
             est = ch_est.recv()
             if isinstance(est, m.VehicleEstimates):
                 out = planner.tick(est)

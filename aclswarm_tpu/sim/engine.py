@@ -21,8 +21,12 @@ Dynamics models:
   (the snap outer loop is a tight tracker; goals are already accel- and
   velocity-limited by `make_safe_traj`, so motion stays physical);
 - ``firstorder``: velocity relaxes toward the goal velocity with time
-  constant ``tau`` — a lag model of the autopilot+vehicle, the analogue of
-  the double-integrator MATLAB sim (`aclswarm/matlab/FormCtrlDynam.m`).
+  constant ``tau`` — a lag model of the autopilot+vehicle;
+- ``doubleint``: a true double integrator under a PD position+velocity
+  tracking law — the `aclswarm/matlab/SysDynam.m` / `FormCtrlDynam.m`
+  closed-loop model (acceleration-level control, second-order response,
+  overshoot and all), the closest analogue of the snap-stack outer loop
+  on vehicle dynamics.
 """
 from __future__ import annotations
 
@@ -59,6 +63,10 @@ class SimConfig:
     assignment: str = struct.field(pytree_node=False, default="auction")
     dynamics: str = struct.field(pytree_node=False, default="tracking")
     tau: float = struct.field(pytree_node=False, default=0.15)
+    # doubleint PD tracking gains (SysDynam.m-style outer loop): acc =
+    # kp_track (goal_pos - q) + kd_track (goal_vel - vel)
+    kp_track: float = struct.field(pytree_node=False, default=8.0)
+    kd_track: float = struct.field(pytree_node=False, default=4.0)
     use_colavoid: bool = struct.field(pytree_node=False, default=True)
     # run the per-vehicle flight-mode FSM (takeoff/land/kill lifecycle,
     # `aclswarm_tpu.sim.vehicle`); off = the historical airborne-start mode
@@ -245,6 +253,14 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
     elif cfg.dynamics == "firstorder":
         a = jnp.clip(cfg.control_dt / cfg.tau, 0.0, 1.0)
         vel = swarm.vel + a * (goal.vel - swarm.vel)
+        swarm = SwarmState(q=swarm.q + vel * cfg.control_dt, vel=vel)
+    elif cfg.dynamics == "doubleint":
+        # second-order vehicle under a PD tracking law (`SysDynam.m`'s
+        # closed loop); semi-implicit Euler keeps the integration stable
+        # at the 100 Hz tick
+        acc = cfg.kp_track * (goal.pos - swarm.q) \
+            + cfg.kd_track * (goal.vel - swarm.vel)
+        vel = swarm.vel + acc * cfg.control_dt
         swarm = SwarmState(q=swarm.q + vel * cfg.control_dt, vel=vel)
     else:
         raise ValueError(f"unknown dynamics model {cfg.dynamics!r}")

@@ -1,0 +1,7 @@
+"""Shared utilities: timing/profiling (§5.1) and logging (§5.5)."""
+from aclswarm_tpu.utils.log import get_logger
+from aclswarm_tpu.utils.timing import (Stopwatch, median_time,
+                                       readback_sync, trace)
+
+__all__ = ["get_logger", "Stopwatch", "median_time", "readback_sync",
+           "trace"]

@@ -1,0 +1,70 @@
+"""Logging (SURVEY.md §5.5): leveled, env-configurable, ANSI-highlighted.
+
+The reference logs through ROS_INFO/WARN/ERROR with hand-colored
+highlights (`coordination_ros.cpp:122-123`) and a `verbose` flag for the
+auction trace (`auctioneer.cpp:111-116`). Equivalent here: stdlib logging
+with a framework root logger, per-module children, an env knob
+(``ACLSWARM_LOG=debug`` or ``ACLSWARM_LOG=aclswarm_tpu.interop=debug``),
+and the same visual conventions on a tty.
+
+Usage::
+
+    from aclswarm_tpu.utils.log import get_logger
+    log = get_logger(__name__)
+    log.info("committed formation %s", name)
+    log.debug("auction trace ...")       # the reference's `verbose` flag
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+ROOT = "aclswarm_tpu"
+_COLORS = {
+    logging.WARNING: "\x1b[33m",
+    logging.ERROR: "\x1b[31m",
+    logging.CRITICAL: "\x1b[41m",
+}
+_RESET = "\x1b[0m"
+_configured = False
+
+
+class _TtyFormatter(logging.Formatter):
+    def format(self, record):
+        msg = super().format(record)
+        color = _COLORS.get(record.levelno)
+        if color and sys.stderr.isatty():
+            return f"{color}{msg}{_RESET}"
+        return msg
+
+
+def _configure() -> None:
+    global _configured
+    if _configured:
+        return
+    _configured = True
+    root = logging.getLogger(ROOT)
+    if not root.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(_TtyFormatter(
+            "[%(levelname).1s %(asctime)s %(name)s] %(message)s",
+            datefmt="%H:%M:%S"))
+        root.addHandler(handler)
+    root.setLevel(logging.INFO)
+    # ACLSWARM_LOG=debug  or  ACLSWARM_LOG=<logger>=<level>,<logger>=...
+    spec = os.environ.get("ACLSWARM_LOG", "")
+    for part in filter(None, (s.strip() for s in spec.split(","))):
+        if "=" in part:
+            name, _, level = part.partition("=")
+            logging.getLogger(name).setLevel(level.upper())
+        else:
+            root.setLevel(part.upper())
+
+
+def get_logger(name: str = ROOT) -> logging.Logger:
+    """A child of the framework root logger (configured on first use)."""
+    _configure()
+    if not name.startswith(ROOT):
+        name = f"{ROOT}.{name}"
+    return logging.getLogger(name)

@@ -1,0 +1,93 @@
+"""Timing + profiling utilities (SURVEY.md §5.1).
+
+The reference's tracing story is wall-clock log lines around the gain
+solve (`coordination_ros.cpp:113-118`) and MATLAB tic/toc; the survey
+calls JAX-profiler integration "a strict upgrade" — this module is that
+upgrade, plus the benchmark timer with the two environment-specific
+pitfalls baked in (see the project memory / bench.py methodology):
+
+- `readback_sync`: the only reliable completion barrier through the
+  remote-device tunnel (`jax.block_until_ready` may return at
+  dispatch-acknowledge);
+- `median_time`: chained-work timing with readback sync — the single
+  home the benchmark suites import;
+- `trace`: context manager around `jax.profiler` for per-kernel
+  timelines viewable in TensorBoard/Perfetto;
+- `Stopwatch`: the reference's log-line pattern (wall-clock of a named
+  phase), for host-side code.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+
+import numpy as np
+
+
+def readback_sync(x) -> float:
+    """Block until ``x`` is computed by fetching one scalar to the host.
+
+    A device->host transfer cannot complete before the producing
+    executable does, unlike `block_until_ready` on tunnel-attached
+    devices (measured: early returns yielding ~1000x-off timings).
+    """
+    import jax
+    return float(np.asarray(jax.tree.leaves(x)[0]).ravel()[0])
+
+
+def median_time(fn, arg, per: int = 1, reps: int = 5) -> float:
+    """Median wall seconds of ``fn(arg)`` divided by ``per``, after one
+    warmup call; ``fn`` should return a small digest (see
+    `readback_sync`). For device work, chain ``per`` distinct instances
+    inside ``fn`` (one `lax.scan`) so fixed launch overhead amortizes."""
+    readback_sync(fn(arg))
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        readback_sync(fn(arg))
+        times.append((time.perf_counter() - t0) / per)
+    return float(np.median(times))
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """JAX profiler trace around a block::
+
+        with timing.trace("/tmp/prof"):
+            rollout(...)  # then: tensorboard --logdir /tmp/prof
+
+    Captures per-kernel device timelines (fusion boundaries, HBM stalls,
+    collective overlap) — the debugging view the reference never had.
+    """
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class Stopwatch:
+    """Named wall-clock phases, the `coordination_ros.cpp:113-118` log
+    pattern::
+
+        sw = Stopwatch()
+        with sw.phase("gains"):
+            solve(...)
+        sw.report(logger.info)
+    """
+
+    def __init__(self):
+        self.phases: list[tuple[str, float]] = []
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phases.append((name, time.perf_counter() - t0))
+
+    def report(self, sink=print) -> None:
+        for name, secs in self.phases:
+            sink(f"{name}: {secs * 1e3:.2f} ms")

@@ -27,32 +27,15 @@ import numpy as np
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
-def _sync(x) -> float:
-    """Host readback as the completion barrier.
-
-    Through this environment's remote-TPU tunnel, `jax.block_until_ready`
-    is NOT a reliable completion barrier — it intermittently returns
-    once the dispatch is acknowledged, yielding per-solve "timings" of
-    ~0.01 ms for multi-ms programs (measured; this also explains the
-    round-1 bench variance, 46 kHz vs 19.5 kHz for the same kernel). A
-    device->host value transfer cannot be acknowledged early, so every
-    timed function must reduce to a small array and the timer fetches it.
-    """
-    import jax
-    return float(np.asarray(jax.tree.leaves(x)[0]).ravel()[0])
+from aclswarm_tpu.utils.timing import median_time as _median_time_impl
+from aclswarm_tpu.utils.timing import readback_sync as _sync  # noqa: F401
+# (single home: aclswarm_tpu/utils/timing.py — readback sync because
+# block_until_ready is unreliable through the device tunnel, chained
+# instances because of the ~108 ms fixed launch floor)
 
 
 def _median_time(fn, arg, per: int, reps: int) -> float:
-    """Median wall time of fn(arg)/per over reps, readback-synced. ``fn``
-    must return a scalar-ish digest (see `_sync`) so the readback cost is
-    a few bytes, not the result tensor."""
-    _sync(fn(arg))
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        _sync(fn(arg))
-        times.append((time.perf_counter() - t0) / per)
-    return float(np.median(times))
+    return _median_time_impl(fn, arg, per=per, reps=reps)
 
 
 def sinkhorn_throughput(n: int, K: int, reps: int, n_iters: int = 50,

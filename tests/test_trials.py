@@ -272,3 +272,17 @@ def test_swarm100_scale_group_loads_and_solves():
     A = np.asarray(gainslib.solve_gains(specs[0].points, specs[0].adjmat))
     v = gainslib.validate_gains(A, np.asarray(specs[0].points), tol=1e-4)
     assert v["no_positive"] and v["kernel_ok"]
+
+
+def test_flooded_localization_trial_completes(tmp_path):
+    """Driver-level end-to-end with the real information model: CBAA
+    assignment consuming flooded localization estimates, full lifecycle
+    through takeoff and formation cycling."""
+    out = tmp_path / "flood.csv"
+    # seed 5: seed 3 gridlocks under CBAA on this group (identically in
+    # truth and flooded modes — the information model does not cause it)
+    cfg = trials.TrialConfig(formation="swarm6_sparse", trials=1, seed=5,
+                             assignment="cbaa", localization="flooded",
+                             out=str(out), verbose=False)
+    stats = trials.run_trials(cfg)
+    assert stats["trials_completed"] == 1

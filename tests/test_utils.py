@@ -1,0 +1,69 @@
+"""Utility tests: timing/profiling (§5.1) and logging (§5.5)."""
+import logging
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aclswarm_tpu.utils import (Stopwatch, get_logger, median_time,
+                                readback_sync)
+
+
+class TestTiming:
+    def test_readback_sync_returns_scalar(self):
+        assert readback_sync(jnp.arange(5.0)) == 0.0
+        assert readback_sync((jnp.full((2, 2), 3.0), jnp.zeros(1))) == 3.0
+
+    def test_median_time_measures(self):
+        def fn(x):
+            time.sleep(0.01)
+            return x
+        dt = median_time(fn, jnp.zeros(1), per=1, reps=3)
+        assert 0.005 < dt < 0.5
+
+    def test_median_time_divides_by_per(self):
+        def fn(x):
+            time.sleep(0.02)
+            return x
+        dt = median_time(fn, jnp.zeros(1), per=10, reps=2)
+        assert dt < 0.01
+
+    def test_stopwatch_phases(self):
+        sw = Stopwatch()
+        with sw.phase("a"):
+            time.sleep(0.005)
+        with sw.phase("b"):
+            pass
+        names = [n for n, _ in sw.phases]
+        assert names == ["a", "b"]
+        assert sw.phases[0][1] >= 0.005
+        lines = []
+        sw.report(lines.append)
+        assert len(lines) == 2 and lines[0].startswith("a:")
+
+
+class TestLogging:
+    def test_logger_hierarchy(self):
+        log = get_logger("interop.bridge")
+        assert log.name == "aclswarm_tpu.interop.bridge"
+        root = logging.getLogger("aclswarm_tpu")
+        assert root.handlers  # configured once
+
+    def test_env_level_spec(self, monkeypatch):
+        import aclswarm_tpu.utils.log as loglib
+        monkeypatch.setattr(loglib, "_configured", False)
+        monkeypatch.setenv("ACLSWARM_LOG",
+                           "debug,aclswarm_tpu.sim=warning")
+        loglib._configure()
+        assert logging.getLogger("aclswarm_tpu").level == logging.DEBUG
+        assert logging.getLogger("aclswarm_tpu.sim").level == logging.WARNING
+        # restore defaults for other tests
+        logging.getLogger("aclswarm_tpu").setLevel(logging.INFO)
+        logging.getLogger("aclswarm_tpu.sim").setLevel(logging.NOTSET)
+
+    def test_messages_flow(self, caplog):
+        log = get_logger("test.flow")
+        with caplog.at_level(logging.INFO, logger="aclswarm_tpu"):
+            log.info("hello %d", 7)
+        assert any("hello 7" in r.message for r in caplog.records)
