@@ -70,16 +70,25 @@ def run_bridge(n: int, ns: str = "/asw", ticks: int = 0,
         if verbose:
             log.info("bridge up: ns=%s n=%d", ns, n)
         deadline = time.time() + idle_timeout_s
+        shutdown = False
         while True:
             progressed = False
-            msg = ch_form.recv()
-            if isinstance(msg, m.Formation):
+            # drain the formation channel: a burst of operator dispatches
+            # commits only the newest (each commit may trigger a full gain
+            # solve, so solving superseded formations is pure waste)
+            latest = None
+            while isinstance(msg := ch_form.recv(), m.Formation):
                 if msg.name == SHUTDOWN:
+                    shutdown = True
                     break
-                planner.handle_formation(msg)
+                latest = msg
                 progressed = True
+            if latest is not None:
+                planner.handle_formation(latest)
                 if verbose:
-                    log.info("committed formation %r", msg.name)
+                    log.info("committed formation %r", latest.name)
+            if shutdown:
+                break
             est = ch_est.recv()
             if isinstance(est, m.VehicleEstimates):
                 out = planner.tick(est)
@@ -91,8 +100,7 @@ def run_bridge(n: int, ns: str = "/asw", ticks: int = 0,
                     # on a stale permutation permanently, so block through
                     # transient backpressure
                     _send_reliable(ch_asn, m.Assignment(
-                        header=est.header,
-                        perm=out.assignment.astype(np.int32)),
+                        header=est.header, perm=out.assignment),
                         grace_s=5.0)
                 served += 1
                 progressed = True

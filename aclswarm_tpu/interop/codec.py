@@ -54,6 +54,10 @@ def _pack_str(s: str) -> bytes:
 def _unpack_str(buf: memoryview, off: int) -> tuple[str, int]:
     (ln,) = struct.unpack_from("<H", buf, off)
     off += 2
+    if off + ln > len(buf):
+        # bounds-check like the native Reader::str — a malformed length
+        # must raise, not silently truncate and misparse later fields
+        raise ValueError("string length exceeds payload")
     return bytes(buf[off:off + ln]).decode("utf-8"), off + ln
 
 

@@ -39,13 +39,14 @@ class PlannerOutput:
 
     ``distcmd`` is the batched `distcmd` topic (Vector3Stamped velocity
     goal per vehicle, `coordination_ros.cpp:80,370-378`); ``assignment``
-    is the `assignment` topic payload (UInt8MultiArray permutation,
-    `coordination_ros.cpp:293-297`), present only on ticks where a new
-    assignment was accepted.
+    is the `assignment` topic payload (the reference ships a
+    UInt8MultiArray permutation, `coordination_ros.cpp:293-297`; here it
+    is int32 because the wire Assignment message was widened for
+    n > 255), present only on ticks where a new assignment was accepted.
     """
 
     distcmd: np.ndarray                       # (n, 3) float
-    assignment: Optional[np.ndarray] = None   # (n,) uint8 v2f, when accepted
+    assignment: Optional[np.ndarray] = None   # (n,) int32 v2f, when accepted
     auction_valid: bool = True                # detect-and-skip flag
     safety: Optional[m.SafetyStatus] = None   # reserved (safety is L2)
 
@@ -148,5 +149,5 @@ class TpuPlanner:
         self.v2f = new_v2f
         return PlannerOutput(
             distcmd=np.asarray(u),
-            assignment=(np.asarray(new_v2f, np.uint8) if changed else None),
+            assignment=(np.asarray(new_v2f, np.int32) if changed else None),
             auction_valid=bool(valid))

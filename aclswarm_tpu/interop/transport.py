@@ -88,9 +88,13 @@ class Channel:
     def queued_bytes(self) -> int:
         return int(self._lib.asw_ring_used(self._h))
 
-    def close(self):
+    def close(self, unlink: bool | None = None):
+        """Unmap the ring; the owner also unlinks the shm object unless
+        ``unlink=False`` (used by tests to simulate a crashed owner — a
+        later ``create`` reclaims such stale objects)."""
         if self._h:
-            self._lib.asw_ring_close(self._h, 1 if self._owner else 0)
+            do_unlink = self._owner if unlink is None else unlink
+            self._lib.asw_ring_close(self._h, 1 if do_unlink else 0)
             self._h = None
 
     def __enter__(self):

@@ -22,11 +22,13 @@
 // release/acquire fences order payload writes against cursor publication.
 
 #include <atomic>
+#include <cerrno>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 
 #include <fcntl.h>
+#include <sys/file.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
@@ -50,6 +52,7 @@ struct Ring {
   uint8_t* data;
   size_t map_len;
   bool owner;
+  int lock_fd;  // owner keeps the shm fd open, flock-ed (liveness token)
   char name[256];
 };
 
@@ -63,7 +66,27 @@ void* asw_ring_open(const char* name, uint32_t capacity, int create) {
   capacity = (capacity + 3u) & ~3u;  // see alignment invariant below
   int flags = create ? (O_CREAT | O_EXCL | O_RDWR) : O_RDWR;
   int fd = shm_open(name, flags, 0600);
+  if (fd < 0 && create && errno == EEXIST) {
+    // An object with this name exists. The owner holds an flock on its
+    // shm fd for its whole lifetime, so: lock acquired => owner crashed
+    // without unlinking => reclaim; lock busy => live owner => fail
+    // loudly (the O_EXCL guarantee, kept for the running case).
+    int old_fd = shm_open(name, O_RDWR, 0600);
+    if (old_fd < 0) return nullptr;
+    if (flock(old_fd, LOCK_EX | LOCK_NB) != 0) {
+      close(old_fd);  // someone alive owns it
+      return nullptr;
+    }
+    close(old_fd);  // releases the probe lock
+    shm_unlink(name);
+    fd = shm_open(name, flags, 0600);
+  }
   if (fd < 0) return nullptr;
+  if (create && flock(fd, LOCK_EX | LOCK_NB) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
   size_t len = kCtrl + capacity;
   if (create && ftruncate(fd, (off_t)len) != 0) {
     close(fd);
@@ -79,13 +102,21 @@ void* asw_ring_open(const char* name, uint32_t capacity, int create) {
     len = (size_t)st.st_size;
   }
   void* mem = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
-  close(fd);
-  if (mem == MAP_FAILED) return nullptr;
+  if (mem == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
   Ring* r = new Ring;
   r->ctrl = (Ctrl*)mem;
   r->data = (uint8_t*)mem + kCtrl;
   r->map_len = len;
   r->owner = create != 0;
+  if (create) {
+    r->lock_fd = fd;  // keep open: holding the flock marks us alive
+  } else {
+    r->lock_fd = -1;
+    close(fd);
+  }
   std::snprintf(r->name, sizeof(r->name), "%s", name);
   if (create) {
     r->ctrl->capacity = capacity;
@@ -106,6 +137,7 @@ void asw_ring_close(void* h, int unlink_shm) {
   if (!r) return;
   munmap((void*)r->ctrl, r->map_len);
   if (unlink_shm) shm_unlink(r->name);
+  if (r->lock_fd >= 0) close(r->lock_fd);  // releases the liveness flock
   delete r;
 }
 

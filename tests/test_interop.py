@@ -87,6 +87,24 @@ class TestCodec:
         with pytest.raises(ValueError):
             codec.decode(buf[:len(buf) - 3])
 
+    def test_overlong_string_length_raises(self):
+        """A CRC-consistent frame whose declared string length overruns
+        the payload must raise (native Reader::str bounds-checks the same
+        way) — silent truncation would misparse every later field."""
+        import struct as _s
+        import zlib as _z
+        msg = _formation_msg(gains=False)
+        msg.header.frame_id = ""
+        buf = bytearray(codec.encode(msg))
+        hdr = codec._HDR.size
+        # name length lives right after the 14-byte header (seq+stamp+len0)
+        name_off = hdr + 14
+        _s.pack_into("<H", buf, name_off, 0xFFFF)
+        payload = bytes(buf[hdr:])
+        _s.pack_into("<I", buf, 12, _z.crc32(payload) & 0xFFFFFFFF)
+        with pytest.raises(ValueError, match="string length"):
+            codec.decode(bytes(buf))
+
 
 needs_native = pytest.mark.skipif(not nat.build(),
                                   reason="native library not buildable")
@@ -222,6 +240,34 @@ class TestShmRing:
                 sent += 1
             assert sent == 5000
 
+    def test_stale_shm_reclaimed_on_create(self):
+        """A ring left behind by a crashed owner must not block restarts:
+        create-over-stale unlinks and recreates instead of raising until
+        /dev/shm is cleaned by hand."""
+        from aclswarm_tpu.interop.transport import Channel
+        name = f"aswtest-{uuid.uuid4().hex[:12]}"
+        ch1 = Channel(name, create=True)
+        ch1.send(_cbaa_msg())
+        # simulate a crash: drop the mapping without shm_unlink
+        ch1.close(unlink=False)
+        with Channel(name, create=True) as ch2:
+            # fresh ring: the stale message is gone, and traffic flows
+            assert ch2.recv() is None
+            assert ch2.send(_status_msg())
+            assert isinstance(ch2.recv(), m.SafetyStatus)
+
+    def test_live_ring_not_hijacked_by_second_creator(self):
+        """Reclaim must only fire for crashed owners: while the first
+        creator is alive (its flock held), a second create fails loudly
+        instead of unlinking the live ring out from under it."""
+        from aclswarm_tpu.interop.transport import Channel
+        name = f"aswtest-{uuid.uuid4().hex[:12]}"
+        with Channel(name, create=True) as ch1:
+            with pytest.raises(OSError):
+                Channel(name, create=True)
+            assert ch1.send(_status_msg())   # ring untouched
+            assert isinstance(ch1.recv(), m.SafetyStatus)
+
     def test_backpressure_not_silent_drop(self):
         with self._channel(capacity=256) as ch:
             msg = _cbaa_msg(20)
@@ -349,6 +395,27 @@ class TestPlanner:
             planner.handle_formation(
                 m.Formation(header=m.Header(), name="x", points=pts,
                             adjmat=adj))
+
+    def test_large_swarm_assignment_is_exact_int32(self):
+        """n > 255 must publish an int32 permutation — a uint8 payload
+        would silently wrap indices >= 256 into a corrupt non-permutation
+        (the wire Assignment message is int32 for exactly this reason)."""
+        from aclswarm_tpu.interop import TpuPlanner
+        n = 300
+        rng = np.random.default_rng(0)
+        pts = rng.normal(size=(n, 3)) * 20.0
+        adj = np.ones((n, n), np.uint8) - np.eye(n, dtype=np.uint8)
+        # zero gains: skips the (expensive) ADMM solve; the auction and
+        # the publish path — what this test pins — don't depend on them
+        G = np.zeros((3 * n, 3 * n), np.float32)
+        planner = TpuPlanner(n)
+        planner.handle_formation(
+            m.Formation(header=m.Header(), name="big", points=pts,
+                        adjmat=adj, gains=G))
+        out = planner.tick(rng.normal(size=(n, 3)) * 20.0)
+        assert out.assignment is not None
+        assert out.assignment.dtype == np.int32
+        assert sorted(out.assignment.tolist()) == list(range(n))
 
 
 class TestPlannerFirstAcceptSemantics:
