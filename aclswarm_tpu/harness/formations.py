@@ -83,6 +83,37 @@ def load_group(path: str | Path | None = None, group: str = "swarm6_3d"
     return out
 
 
+def min_planar_separation(points: np.ndarray) -> float:
+    """Smallest pairwise **xy** distance between formation points.
+
+    Collision avoidance treats vehicles as infinite vertical cylinders
+    (planar sectors, `safety.cpp:427-441`), so a commanded formation is
+    only *reachable* if every pair of points keeps planar distance above
+    ``r_keep_out`` — two points sharing an xy column put their vehicles in
+    permanent mutual avoidance regardless of altitude, a gridlock no
+    reassignment can escape. Every reference demo formation satisfies
+    min_xy >= d_avoid_thresh = 1.5; the simformN generator enforces the
+    same invariant by construction (`generate_random_formation.py:26-58`,
+    cylinder rejection sampling).
+    """
+    p = np.asarray(points, dtype=np.float64)
+    if p.shape[0] < 2:
+        return np.inf
+    dxy = np.linalg.norm(p[:, None, :2] - p[None, :, :2], axis=-1)
+    return float(dxy[~np.eye(p.shape[0], dtype=bool)].min())
+
+
+def check_feasible(spec: "FormationSpec", r_keep_out: float = 1.2) -> None:
+    """Raise if the formation is unreachable under planar avoidance."""
+    sep = min_planar_separation(spec.points)
+    if sep <= r_keep_out:
+        raise ValueError(
+            f"formation {spec.name!r} has min planar point separation "
+            f"{sep:.3f} m <= r_keep_out {r_keep_out} m: vehicles on those "
+            f"points sit in permanent mutual collision avoidance (planar "
+            f"cylinder model), which gridlocks every trial")
+
+
 def load_formation(name: str, path: str | Path | None = None,
                    group: str = "swarm6_3d") -> FormationSpec:
     """Load a single formation by name from a group."""

@@ -54,11 +54,23 @@ def build_groups() -> dict:
     ang = np.linspace(0, 2 * np.pi, 6, endpoint=False)
     hexagon = np.stack([2.5 * np.cos(ang), 2.5 * np.sin(ang),
                         np.zeros(6)], 1)
-    prism = np.array([[0., 0, 0], [2.5, 0, 0], [1.25, 2.165, 0],
-                      [0, 0, 2], [2.5, 0, 2], [1.25, 2.165, 2]])
-    adj6 = _ring_adj(6, chords=[(0, 2), (1, 3), (2, 4)])
+    # prism as a ridge "tent" over a staggered 3x2 footprint (the
+    # reference's own prism shape, `formations.yaml` swarm6_3d): a
+    # vertical prism would stack each top vertex on a bottom one (planar
+    # separation 0 < r_keep_out), which the planar-cylinder avoidance can
+    # never reach — the failure mode behind round 2's stacked-Octahedron
+    # gridlock. The ridge is offset in y so no xy triple is collinear:
+    # collinear triples admit no PSD stress with a clean affine kernel,
+    # and the ADMM gain design's eigenstructure validation rejects them.
+    prism = np.array([[0.0, 0, 0], [2.5, -0.8, 1.6], [5.0, 0, 0],
+                      [0.0, 2.5, 0], [2.5, 3.3, 1.6], [5.0, 2.5, 0]])
+    # chord set chosen so BOTH formations pass 2n-3 = 9-edge rigidity AND
+    # the gain eigenstructure validation (searched exhaustively)
+    adj6 = _ring_adj(6, chords=[(0, 2), (0, 3), (1, 4)])
     assert formgen.is_rigid_2d(hexagon, adj6)
     assert formgen.is_rigid_2d(prism, adj6)
+    for f in (hexagon, prism):
+        assert formlib.min_planar_separation(f) > 1.2, f
     groups["swarm6_sparse"] = {
         "agents": 6,
         "adjmat": _adj(adj6),
@@ -94,8 +106,11 @@ def build_groups() -> dict:
     }
 
     # --- swarm100 (scale group; gains solved on dispatch) ---
+    # ring chords must clear the avoidance keep-out: 2 r sin(pi/k) > 1.5
+    # for every (radius, count) pair (the round-2 radii packed the inner
+    # ring at 1.035 m chord spacing — below r_keep_out)
     rings = []
-    for r, k in ((2.0, 12), (4.5, 20), (7.0, 28), (9.5, 40)):
+    for r, k in ((3.0, 12), (5.5, 20), (8.0, 28), (10.5, 40)):
         a = np.linspace(0, 2 * np.pi, k, endpoint=False)
         rings.append(np.stack([r * np.cos(a), r * np.sin(a),
                                np.full(k, 2.0)], 1))

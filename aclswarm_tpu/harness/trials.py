@@ -60,7 +60,10 @@ class TrialConfig:
     out: str = "trials.csv"         # CSV results path (append, reference-style)
     # engine knobs (SimConfig mirror)
     assignment: str = "auction"     # auction | sinkhorn | cbaa
-    dynamics: str = "tracking"      # tracking | firstorder | doubleint
+    # doubleint (the honest second-order default: `SysDynam.m`'s closed
+    # loop, golden-pinned in tests/test_dynamics_golden.py) | tracking |
+    # firstorder
+    dynamics: str = "doubleint"
     localization: str = "truth"     # truth | flooded (L3 estimate tables)
     tau: float = 0.15
     control_dt: float = 0.01
@@ -129,6 +132,11 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
     sparams = SafetyParams(
         bounds_min=jnp.asarray([-cfg.room_x, -cfg.room_y, 0.0]),
         bounds_max=jnp.asarray([cfg.room_x, cfg.room_y, cfg.room_z]))
+
+    # fail fast on formations that planar avoidance can never reach
+    # (regression guard for the stacked-column Octahedron gridlock)
+    for spec in specs:
+        formlib.check_feasible(spec, float(sparams.r_keep_out))
 
     engine_kw = dict(control_dt=cfg.control_dt, assign_every=cfg.assign_every,
                      dynamics=cfg.dynamics, tau=cfg.tau,

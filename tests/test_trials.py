@@ -274,6 +274,50 @@ def test_swarm100_scale_group_loads_and_solves():
     assert v["no_positive"] and v["kernel_ok"]
 
 
+def test_flagship_swarm6_3d_trial_completes(tmp_path):
+    """The flagship demo group (BASELINE.md config #1) completes under the
+    honest second-order dynamics. Regression for round 2's headline
+    failure: the shipped Octahedron stacked two vertices on one xy column
+    (planar separation 0 < r_keep_out), putting the two vehicles assigned
+    there in permanent mutual avoidance — a gridlock no reassignment can
+    escape, terminating 100% of trials."""
+    out = tmp_path / "sw6.csv"
+    cfg = trials.TrialConfig(formation="swarm6_3d", trials=2, seed=1,
+                             dynamics="doubleint", out=str(out),
+                             verbose=False)
+    stats = trials.run_trials(cfg)
+    assert stats["completion_pct"] == 100.0
+    data = np.loadtxt(out, delimiter=",", ndmin=2)
+    assert data.shape == (2, 1 + 6 + 3 * 2)
+
+
+def test_shipped_library_formations_are_feasible():
+    """Every shipped formation keeps min planar point separation above
+    r_keep_out — the reachability precondition of the planar-cylinder
+    avoidance model (all reference demo formations satisfy >= 1.5)."""
+    import yaml
+    from aclswarm_tpu.core.types import SafetyParams
+    from aclswarm_tpu.harness import formations as formlib
+    r = float(SafetyParams().r_keep_out)
+    lib = yaml.safe_load(open(formlib.DEFAULT_LIBRARY))
+    groups = [k for k, v in lib.items() if isinstance(v, dict)]
+    assert groups
+    for g in groups:
+        for spec in formlib.load_group(None, g):
+            sep = formlib.min_planar_separation(spec.points)
+            assert sep > r, (g, spec.name, sep)
+
+
+def test_infeasible_formation_rejected():
+    """The driver refuses a formation planar avoidance can never reach."""
+    from aclswarm_tpu.harness import formations as formlib
+    stacked = formlib.FormationSpec(
+        name="stack", points=np.array([[0.0, 0, 0], [0, 0, 2], [3, 0, 0]]),
+        adjmat=np.ones((3, 3)) - np.eye(3), gains=None)
+    with pytest.raises(ValueError, match="permanent mutual collision"):
+        formlib.check_feasible(stacked, 1.2)
+
+
 def test_flooded_localization_trial_completes(tmp_path):
     """Driver-level end-to-end with the real information model: CBAA
     assignment consuming flooded localization estimates, full lifecycle
