@@ -6,9 +6,11 @@ coordination-stack replacement the rest of a vehicle/SIL system talks to.
 Channels (one directed ring each, created by this process):
 
     <ns>-formation   in   Formation        (operator dispatches)
+    <ns>-flightmode  in   FlightMode       (operator GO/LAND/KILL broadcast)
     <ns>-estimates   in   VehicleEstimates (state feed, one per tick)
     <ns>-distcmd     out  DistCmd          (velocity goals per tick)
     <ns>-assignment  out  Assignment       (on newly accepted assignments)
+    <ns>-safety      out  SafetyStatusArray (ca-active flags per tick)
 
 Run:  python -m aclswarm_tpu.interop.bridge --n 6 --ns /asw [--ticks K]
 
@@ -64,9 +66,11 @@ def run_bridge(n: int, ns: str = "/asw", ticks: int = 0,
                          assign_every=assign_every)
     served = 0
     with Channel(f"{ns}-formation", create=True) as ch_form, \
+            Channel(f"{ns}-flightmode", create=True) as ch_mode, \
             Channel(f"{ns}-estimates", create=True) as ch_est, \
             Channel(f"{ns}-distcmd", create=True) as ch_cmd, \
-            Channel(f"{ns}-assignment", create=True) as ch_asn:
+            Channel(f"{ns}-assignment", create=True) as ch_asn, \
+            Channel(f"{ns}-safety", create=True) as ch_safe:
         if verbose:
             log.info("bridge up: ns=%s n=%d", ns, n)
         deadline = time.time() + idle_timeout_s
@@ -89,11 +93,25 @@ def run_bridge(n: int, ns: str = "/asw", ticks: int = 0,
                     log.info("committed formation %r", latest.name)
             if shutdown:
                 break
+            # drain flight-mode broadcasts BEFORE the tick so a KILL cuts
+            # the distcmd output on this very tick (`safety.cpp:116-120`)
+            while isinstance(fm := ch_mode.recv(), m.FlightMode):
+                planner.handle_flightmode(fm)
+                progressed = True
+                if verbose:
+                    log.info("flight mode %d (killed=%s)", fm.mode,
+                             planner.killed)
             est = ch_est.recv()
             if isinstance(est, m.VehicleEstimates):
                 out = planner.tick(est)
                 _send_reliable(ch_cmd, m.DistCmd(header=est.header,
                                                  vel=out.distcmd))
+                if out.safety is not None:
+                    # per-tick health stream; a dropped frame is stale the
+                    # next tick, so plain best-effort send (queue-size-1
+                    # semantics like the reference's SafetyStatus topic)
+                    ch_safe.send(m.SafetyStatusArray(header=est.header,
+                                                     active=out.safety))
                 if out.assignment is not None:
                     # an Assignment is emitted once per acceptance and
                     # never re-sent — a silent drop would leave consumers

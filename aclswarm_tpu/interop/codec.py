@@ -23,6 +23,8 @@ Payload layouts (all little-endian, no padding):
     SafetyStatus := Header, u8 active
     DistCmd      := Header, u32 n, f64 vel[n*3]
     Assignment   := Header, u32 n, i32 perm[n]
+    FlightMode   := Header, u8 mode
+    SafetyArray  := Header, u32 n, u8 active[n]
 
 The format exists so non-Python processes (the reference's C++ nodes, a
 ROS bridge) can exchange planner traffic with zero dependencies — it is
@@ -116,6 +118,14 @@ def _payload(msg) -> tuple[int, bytes]:
         return m.MSG_ASSIGNMENT, b"".join([
             _pack_header(msg.header), struct.pack("<I", n),
             np.ascontiguousarray(msg.perm, "<i4").tobytes()])
+    if isinstance(msg, m.FlightMode):
+        return m.MSG_FLIGHT_MODE, (
+            _pack_header(msg.header) + struct.pack("<B", int(msg.mode)))
+    if isinstance(msg, m.SafetyStatusArray):
+        n = msg.active.shape[0]
+        return m.MSG_SAFETY_ARRAY, b"".join([
+            _pack_header(msg.header), struct.pack("<I", n),
+            np.ascontiguousarray(msg.active, np.uint8).tobytes()])
     raise TypeError(f"not a wire message: {type(msg)!r}")
 
 
@@ -188,4 +198,12 @@ def decode(buf: bytes):
         off += 4
         perm = np.frombuffer(payload, "<i4", n, off).copy()
         return m.Assignment(header=header, perm=perm)
+    if mtype == m.MSG_FLIGHT_MODE:
+        (mode,) = struct.unpack_from("<B", payload, off)
+        return m.FlightMode(header=header, mode=int(mode))
+    if mtype == m.MSG_SAFETY_ARRAY:
+        (n,) = struct.unpack_from("<I", payload, off)
+        off += 4
+        active = np.frombuffer(payload, np.uint8, n, off).copy()
+        return m.SafetyStatusArray(header=header, active=active)
     raise ValueError(f"unknown message type {mtype}")

@@ -43,6 +43,18 @@ MSG_SAFETY_STATUS = 4
 # wire-shaped too
 MSG_DIST_CMD = 5
 MSG_ASSIGNMENT = 6
+# operator flight-mode broadcast (`snapstack_msgs/QuadFlightMode` carried
+# on `/globalflightmode`, published by `operator.py:111-115`, consumed by
+# every safety node `safety.cpp:101-121`) and the batched SafetyStatus
+# stream (per-vehicle `SafetyStatus.msg` flags, one frame per tick)
+MSG_FLIGHT_MODE = 7
+MSG_SAFETY_ARRAY = 8
+
+# QuadFlightMode.mode values, aligned with the sim FSM's CMD_* codes
+# (`aclswarm_tpu/sim/vehicle.py`: CMD_GO=1, CMD_LAND=2, CMD_KILL=3)
+MODE_GO = 1
+MODE_LAND = 2
+MODE_KILL = 3
 
 
 @dataclasses.dataclass
@@ -141,6 +153,32 @@ class Assignment:
 
     def __post_init__(self):
         self.perm = np.ascontiguousarray(self.perm, dtype=np.int32)
+
+
+@dataclasses.dataclass
+class FlightMode:
+    """`snapstack_msgs/QuadFlightMode` equivalent: the operator's global
+    flight-mode broadcast (GO / LAND / KILL, `operator.py:111-115`). KILL
+    is the e-stop: every consumer must cut its command output on the tick
+    it arrives (`safety.cpp:116-120`)."""
+
+    header: Header
+    mode: int                       # MODE_GO | MODE_LAND | MODE_KILL
+
+
+@dataclasses.dataclass
+class SafetyStatusArray:
+    """Batched per-vehicle `SafetyStatus` flags for one tick (the
+    reference publishes one `SafetyStatus.msg` per vehicle per safety
+    tick, `safety.cpp:277-279`; batched like `DistCmd`). This is the live
+    gridlock signal trial supervision consumes over the wire."""
+
+    header: Header
+    active: np.ndarray              # (n,) uint8/bool ca-active flags
+
+    def __post_init__(self):
+        self.active = np.ascontiguousarray(
+            np.asarray(self.active).astype(np.uint8))
 
 
 def formation_from_spec(spec, seq: int = 0, stamp: float = 0.0) -> Formation:

@@ -35,9 +35,20 @@ def load() -> Optional[C.CDLL]:
         return None
     try:
         lib = C.CDLL(str(_LIB_PATH))
+        return _register(lib)
     except OSError:
         _load_failed = True
         return None
+    except AttributeError:
+        # a stale prebuilt library missing newer symbols: treat as
+        # unavailable (callers degrade to the byte-identical Python
+        # codec; `build()` clears the flag after a `make -C native`)
+        _load_failed = True
+        return None
+
+
+def _register(lib: C.CDLL) -> C.CDLL:
+    global _lib
     u8p = C.POINTER(C.c_uint8)
     u32p = C.POINTER(C.c_uint32)
     u64p = C.POINTER(C.c_uint64)
@@ -82,6 +93,16 @@ def load() -> Optional[C.CDLL]:
     _sig(lib.asw_assignment_n, C.c_int, [u8p, C.c_uint64, u32p])
     _sig(lib.asw_decode_assignment, C.c_int,
          [u8p, C.c_uint64, u32p, f64p, i32p])
+    _sig(lib.asw_encode_flightmode, C.c_int64,
+         [C.c_uint32, C.c_double, C.c_char_p, C.c_int, u8p, C.c_uint64])
+    _sig(lib.asw_decode_flightmode, C.c_int,
+         [u8p, C.c_uint64, u32p, f64p, intp])
+    _sig(lib.asw_encode_safety_array, C.c_int64,
+         [C.c_uint32, C.c_double, C.c_char_p, C.c_uint32, u8p, u8p,
+          C.c_uint64])
+    _sig(lib.asw_safety_array_n, C.c_int, [u8p, C.c_uint64, u32p])
+    _sig(lib.asw_decode_safety_array, C.c_int,
+         [u8p, C.c_uint64, u32p, f64p, u8p])
     _sig(lib.asw_ring_open, C.c_void_p, [C.c_char_p, C.c_uint32, C.c_int])
     _sig(lib.asw_ring_close, None, [C.c_void_p, C.c_int])
     _sig(lib.asw_ring_write, C.c_int, [C.c_void_p, u8p, C.c_uint32])

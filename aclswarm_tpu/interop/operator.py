@@ -6,15 +6,15 @@ next formation, END lands, KILL cuts motors — `aclswarm/nodes/operator.py
 :118-136`), and publishes `Formation` messages with or without precomputed
 gains (`buildFormationMessage`, `:138-213`).
 
-This module is the same role, ROS-free: an `Operator` that cycles a
-library group and emits wire `Formation` messages into a transport
-channel (or any callable sink). Flight-mode broadcast in this framework
-is the engine's `ExternalInputs.cmd` (the sim side) or the embedding
-system's concern (hardware); the operator's job at this boundary is the
-formation dispatch stream. Entry point:
+This module is the same role, ROS-free: an `Operator` that implements the
+full flight-mode service (`srvCB`, `operator.py:117-135`) — START takes
+off or cycles formations, END lands, KILL e-stops — broadcasting wire
+`FlightMode` messages and emitting `Formation` dispatches into transport
+channels (or any callable sinks). Entry point:
 
     python -m aclswarm_tpu.interop.operator --group swarm6_3d \
-        --channel /asw-formation --dispatch 2
+        --channel /asw-formation --mode-channel /asw-flightmode \
+        --dispatch 2
 
 publishes the group's formations (cycling on each --dispatch, period in
 seconds) to a planner/bridge process listening on the channel.
@@ -47,6 +47,7 @@ class Operator:
         self.send_gains = send_gains
         self.idx = -1            # START cycles to the next formation
         self.seq = 0
+        self.flying = False      # NOT_FLYING/FLYING (`operator.py:83`)
 
     @property
     def n(self) -> int:
@@ -69,6 +70,43 @@ class Operator:
         send(msg)
         return msg
 
+    # -- flight-mode service (`operator.py:111-135` srvCB) ---------------
+    def _broadcast(self, send_mode, mode: int, stamp: float) -> None:
+        self.seq += 1
+        send_mode(m.FlightMode(header=m.Header(seq=self.seq, stamp=stamp),
+                               mode=mode))
+
+    def start(self, send_mode: Callable[[object], object],
+              send_form: Optional[Callable[[object], object]] = None,
+              stamp: float = 0.0) -> Optional[m.Formation]:
+        """START: first call takes the fleet off (GO broadcast); while
+        flying it cycles to the next formation instead
+        (`operator.py:126-134`). Returns the Formation when one was
+        dispatched."""
+        if not self.flying:
+            self.flying = True
+            self._broadcast(send_mode, m.MODE_GO, stamp)
+            return None
+        if send_form is None:
+            raise ValueError("START while flying dispatches a formation; "
+                             "pass send_form")
+        return self.dispatch(send_form, stamp)
+
+    def end(self, send_mode: Callable[[object], object],
+            stamp: float = 0.0) -> None:
+        """END: land the fleet — only meaningful in flight
+        (`operator.py:122-124`)."""
+        if self.flying:
+            self.flying = False
+            self._broadcast(send_mode, m.MODE_LAND, stamp)
+
+    def kill(self, send_mode: Callable[[object], object],
+             stamp: float = 0.0) -> None:
+        """KILL: the e-stop broadcast, always honored
+        (`operator.py:118-121`)."""
+        self.flying = False
+        self._broadcast(send_mode, m.MODE_KILL, stamp)
+
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
@@ -76,8 +114,18 @@ def main(argv=None):
     ap.add_argument("--library", default=None)
     ap.add_argument("--channel", default="/asw-formation",
                     help="shm channel to publish Formation messages on")
+    ap.add_argument("--mode-channel", default=None,
+                    help="shm channel for FlightMode broadcasts (the "
+                         "/globalflightmode edge); required for "
+                         "start/end/kill actions")
     ap.add_argument("--create", action="store_true",
-                    help="create the channel (else open existing)")
+                    help="create the channel(s) (else open existing)")
+    ap.add_argument("--action", default="dispatch",
+                    choices=("dispatch", "start", "end", "kill"),
+                    help="dispatch = publish formations (cycling); start = "
+                         "the flight-mode service's START (GO broadcast, "
+                         "then formation cycling); end = LAND broadcast; "
+                         "kill = KILL broadcast (e-stop)")
     ap.add_argument("--dispatch", type=float, default=0.0,
                     help="seconds between dispatches; 0 = send one and exit")
     ap.add_argument("--cycles", type=int, default=0,
@@ -85,19 +133,46 @@ def main(argv=None):
     ap.add_argument("--no-gains", action="store_true",
                     help="omit library gains (vehicles solve on commit)")
     args = ap.parse_args(argv)
+    if args.action != "dispatch" and args.mode_channel is None:
+        ap.error(f"--action {args.action} needs --mode-channel")
 
     from aclswarm_tpu.interop.transport import Channel
     op = Operator(args.group, args.library, send_gains=not args.no_gains)
-    with Channel(args.channel, create=args.create) as ch:
-        count = 0
-        while True:
-            msg = op.dispatch(ch.send, stamp=time.time())
-            count += 1
-            print(f"dispatched {op.group}/{msg.name} "
-                  f"(formation {op.idx + 1}/{len(op.specs)})", flush=True)
-            if args.dispatch <= 0 or (args.cycles and count >= args.cycles):
-                break
-            time.sleep(args.dispatch)
+    mode_ch = (Channel(args.mode_channel, create=args.create)
+               if args.mode_channel else None)
+    try:
+        if args.action == "kill":
+            op.kill(mode_ch.send, stamp=time.time())
+            print("broadcast KILL", flush=True)
+            return 0
+        if args.action == "end":
+            op.flying = True   # END is only meaningful in flight
+            op.end(mode_ch.send, stamp=time.time())
+            print("broadcast LAND", flush=True)
+            return 0
+        with Channel(args.channel, create=args.create) as ch:
+            if args.action == "start":
+                # first START takes the fleet off; subsequent iterations
+                # below cycle formations (`operator.py:126-134`)
+                op.start(mode_ch.send, ch.send, stamp=time.time())
+                print("broadcast GO (takeoff)", flush=True)
+                if args.dispatch <= 0:
+                    return 0
+                time.sleep(args.dispatch)
+            count = 0
+            while True:
+                msg = op.dispatch(ch.send, stamp=time.time())
+                count += 1
+                print(f"dispatched {op.group}/{msg.name} "
+                      f"(formation {op.idx + 1}/{len(op.specs)})",
+                      flush=True)
+                if args.dispatch <= 0 or (args.cycles
+                                          and count >= args.cycles):
+                    break
+                time.sleep(args.dispatch)
+    finally:
+        if mode_ch is not None:
+            mode_ch.close()
     return 0
 
 

@@ -28,7 +28,7 @@ import numpy as np
 from aclswarm_tpu import control
 from aclswarm_tpu.core import perm as permutil
 from aclswarm_tpu.core.types import (ControlGains, Formation as DevFormation,
-                                     SwarmState, make_formation)
+                                     SafetyParams, SwarmState, make_formation)
 from aclswarm_tpu.interop import messages as m
 from aclswarm_tpu.sim import engine
 
@@ -48,19 +48,28 @@ class PlannerOutput:
     distcmd: np.ndarray                       # (n, 3) float
     assignment: Optional[np.ndarray] = None   # (n,) int32 v2f, when accepted
     auction_valid: bool = True                # detect-and-skip flag
-    safety: Optional[m.SafetyStatus] = None   # reserved (safety is L2)
+    # per-vehicle collision-avoidance-active flags for this tick — the
+    # batched `SafetyStatus` stream (`safety.cpp:277-279`), the live
+    # gridlock signal trial supervision consumes over the wire
+    safety: Optional[np.ndarray] = None       # (n,) bool ca-active
 
 
 @partial(jax.jit, static_argnames=("cfg",))
 def _tick(swarm: SwarmState, formation: DevFormation, v2f: jnp.ndarray,
-          cgains: ControlGains, do_assign: jnp.ndarray, cfg):
+          cgains: ControlGains, sparams: SafetyParams,
+          do_assign: jnp.ndarray, cfg):
     new_v2f, valid = jax.lax.cond(
         do_assign,
         lambda s, f, p: engine._assign(s, f, p, cfg),
         lambda s, f, p: (p, jnp.asarray(True)),
         swarm, formation, v2f)
     u = control.compute(swarm, formation, new_v2f, cgains)
-    return u, new_v2f, valid
+    # safety stage over the raw distcmd: saturate then the VO check — the
+    # per-vehicle safety node's ca-active signal (`safety.cpp:503`),
+    # computed here so the wire carries `SafetyStatus` per tick
+    usat = control.saturate_velocity(u, sparams)
+    _, ca = control.collision_avoidance(swarm.q, usat, sparams)
+    return u, new_v2f, valid, ca
 
 
 class TpuPlanner:
@@ -86,15 +95,31 @@ class TpuPlanner:
 
     def __init__(self, n: int, assignment: str = "auction",
                  assign_every: int = 120,
-                 cgains: Optional[ControlGains] = None):
+                 cgains: Optional[ControlGains] = None,
+                 sparams: Optional[SafetyParams] = None):
         self.n = n
         self.cfg = engine.SimConfig(assignment=assignment,
                                     assign_every=assign_every)
         self.cgains = cgains or ControlGains()
+        self.sparams = sparams or SafetyParams()
         self.formation: Optional[DevFormation] = None
         self.v2f = permutil.identity(n)
         self._ticks_since_commit = 0
         self._await_first_accept = True
+        self.killed = False
+
+    # -- flight-mode boundary (`safety.cpp:101-121`) ----------------------
+    def handle_flightmode(self, msg: m.FlightMode) -> None:
+        """Apply an operator GO/LAND/KILL broadcast. KILL is the e-stop:
+        from the tick it is processed, `tick` emits zero distcmd and runs
+        no auctions until a GO re-arms (`safety.cpp:116-120` drops the
+        fleet to NOT_FLYING; coordination output is gated on flying,
+        `engine.step` flying mask). LAND is a vehicle-side ramp — the
+        planner keeps serving commands while the fleet descends."""
+        if msg.mode == m.MODE_KILL:
+            self.killed = True
+        elif msg.mode == m.MODE_GO:
+            self.killed = False
 
     # -- operator boundary ------------------------------------------------
     def handle_formation(self, msg: m.Formation) -> None:
@@ -125,10 +150,12 @@ class TpuPlanner:
         (or a plain (n, 3) position array); ``vel`` the vehicles' own
         velocities (zeros when not provided — the damping term then drops,
         as when the reference's twist feed is absent)."""
-        if self.formation is None:
-            # no formation committed: zero command, hold assignment
-            # (`coordination_ros.cpp:102-106` zeros the cmd on commit gaps)
-            return PlannerOutput(distcmd=np.zeros((self.n, 3)))
+        if self.formation is None or self.killed:
+            # no formation committed (`coordination_ros.cpp:102-106` zeros
+            # the cmd on commit gaps) or e-stopped: zero command, hold
+            # assignment, no auction
+            return PlannerOutput(distcmd=np.zeros((self.n, 3)),
+                                 safety=np.zeros((self.n,), bool))
         q = (estimates.positions if isinstance(estimates, m.VehicleEstimates)
              else np.asarray(estimates))
         if q.shape != (self.n, 3):
@@ -137,9 +164,9 @@ class TpuPlanner:
             else jnp.asarray(vel)
         swarm = SwarmState(q=jnp.asarray(q), vel=v)
         do_assign = (self._ticks_since_commit % self.cfg.assign_every) == 0
-        u, new_v2f, valid = _tick(swarm, self.formation, self.v2f,
-                                  self.cgains, jnp.asarray(do_assign),
-                                  self.cfg)
+        u, new_v2f, valid, ca = _tick(swarm, self.formation, self.v2f,
+                                      self.cgains, self.sparams,
+                                      jnp.asarray(do_assign), self.cfg)
         self._ticks_since_commit += 1
         accepted = do_assign and bool(valid)
         changed = accepted and (bool(jnp.any(new_v2f != self.v2f))
@@ -150,4 +177,5 @@ class TpuPlanner:
         return PlannerOutput(
             distcmd=np.asarray(u),
             assignment=(np.asarray(new_v2f, np.int32) if changed else None),
-            auction_valid=bool(valid))
+            auction_valid=bool(valid),
+            safety=np.asarray(ca))

@@ -145,6 +145,8 @@ enum {
   ASW_STATUS = 4,
   ASW_DIST_CMD = 5,
   ASW_ASSIGNMENT = 6,
+  ASW_FLIGHT_MODE = 7,
+  ASW_SAFETY_ARRAY = 8,
 };
 
 uint32_t asw_crc32(const uint8_t* p, uint64_t n) { return crc32_ieee(p, n); }
@@ -388,6 +390,61 @@ int asw_decode_assignment(const uint8_t* buf, uint64_t len, uint32_t* seq,
   get_header(r, seq, stamp, nullptr, 0);
   uint32_t n = r.scalar<uint32_t>();
   r.bytes(perm, (size_t)n * 4);
+  return r.ok ? 0 : -2;
+}
+
+// ---- FlightMode (operator GO/LAND/KILL broadcast) ----
+int64_t asw_encode_flightmode(uint32_t seq, double stamp,
+                              const char* frame_id, int mode, uint8_t* out,
+                              uint64_t cap) {
+  Writer w = begin_frame(out, cap);
+  put_header(w, seq, stamp, frame_id);
+  w.scalar<uint8_t>((uint8_t)mode);
+  return finish_frame(w, ASW_FLIGHT_MODE);
+}
+
+int asw_decode_flightmode(const uint8_t* buf, uint64_t len, uint32_t* seq,
+                          double* stamp, int* mode) {
+  uint64_t off, plen;
+  if (asw_parse_frame(buf, len, &off, &plen) != ASW_FLIGHT_MODE) return -1;
+  Reader r{buf + off, plen};
+  get_header(r, seq, stamp, nullptr, 0);
+  uint8_t m = r.scalar<uint8_t>();
+  if (mode) *mode = m;
+  return r.ok ? 0 : -2;
+}
+
+// ---- SafetyStatusArray (batched per-vehicle ca-active flags) ----
+int64_t asw_encode_safety_array(uint32_t seq, double stamp,
+                                const char* frame_id, uint32_t n,
+                                const uint8_t* active, uint8_t* out,
+                                uint64_t cap) {
+  Writer w = begin_frame(out, cap);
+  put_header(w, seq, stamp, frame_id);
+  w.scalar<uint32_t>(n);
+  w.bytes(active, n);
+  return finish_frame(w, ASW_SAFETY_ARRAY);
+}
+
+int asw_safety_array_n(const uint8_t* buf, uint64_t len, uint32_t* n) {
+  uint64_t off, plen;
+  if (asw_parse_frame(buf, len, &off, &plen) != ASW_SAFETY_ARRAY) return -1;
+  Reader r{buf + off, plen};
+  get_header(r, nullptr, nullptr, nullptr, 0);
+  uint32_t nn = r.scalar<uint32_t>();
+  if (!r.ok) return -2;
+  if (n) *n = nn;
+  return 0;
+}
+
+int asw_decode_safety_array(const uint8_t* buf, uint64_t len, uint32_t* seq,
+                            double* stamp, uint8_t* active) {
+  uint64_t off, plen;
+  if (asw_parse_frame(buf, len, &off, &plen) != ASW_SAFETY_ARRAY) return -1;
+  Reader r{buf + off, plen};
+  get_header(r, seq, stamp, nullptr, 0);
+  uint32_t n = r.scalar<uint32_t>();
+  r.bytes(active, n);
   return r.ok ? 0 : -2;
 }
 

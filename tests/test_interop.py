@@ -475,8 +475,18 @@ def _assignment_msg(n=5):
                         perm=RNG.permutation(n).astype(np.int32))
 
 
+def _flightmode_msg(mode=m.MODE_KILL):
+    return m.FlightMode(header=m.Header(seq=7, stamp=3.5), mode=mode)
+
+
+def _safety_array_msg(n=5):
+    return m.SafetyStatusArray(header=m.Header(seq=8, stamp=4.5),
+                               active=RNG.integers(0, 2, n, dtype=np.uint8))
+
+
 class TestOutputMessages:
-    @pytest.mark.parametrize("msg_fn", [_distcmd_msg, _assignment_msg])
+    @pytest.mark.parametrize("msg_fn", [_distcmd_msg, _assignment_msg,
+                                        _flightmode_msg, _safety_array_msg])
     def test_roundtrip(self, msg_fn):
         msg = msg_fn()
         out = codec.decode(codec.encode(msg))
@@ -515,6 +525,39 @@ class TestOutputMessages:
             perm.ctypes.data_as(C.POINTER(C.c_int32))) == 0
         np.testing.assert_array_equal(perm, asn.perm)
 
+    @needs_native
+    def test_flightmode_safety_native_parity(self):
+        import ctypes as C
+        lib = nat.load()
+        fm = _flightmode_msg(m.MODE_LAND)
+        py = codec.encode(fm)
+        out = (C.c_uint8 * (len(py) + 64))()
+        nb = lib.asw_encode_flightmode(
+            fm.header.seq, fm.header.stamp, fm.header.frame_id.encode(),
+            fm.mode, out, len(out))
+        assert nb == len(py) and bytes(out[:nb]) == py
+        buf = (C.c_uint8 * len(py)).from_buffer_copy(py)
+        mode = C.c_int()
+        assert lib.asw_decode_flightmode(buf, len(py), None, None,
+                                         C.byref(mode)) == 0
+        assert mode.value == m.MODE_LAND
+
+        sa = _safety_array_msg()
+        py = codec.encode(sa)
+        nb = lib.asw_encode_safety_array(
+            sa.header.seq, sa.header.stamp, sa.header.frame_id.encode(),
+            len(sa.active),
+            sa.active.ctypes.data_as(C.POINTER(C.c_uint8)), out, len(out))
+        assert nb == len(py) and bytes(out[:nb]) == py
+        buf = (C.c_uint8 * len(py)).from_buffer_copy(py)
+        nn = C.c_uint32()
+        assert lib.asw_safety_array_n(buf, len(py), C.byref(nn)) == 0
+        active = np.zeros(nn.value, np.uint8)
+        assert lib.asw_decode_safety_array(
+            buf, len(py), None, None,
+            active.ctypes.data_as(C.POINTER(C.c_uint8))) == 0
+        np.testing.assert_array_equal(active, sa.active)
+
 
 class TestOperator:
     def test_cycles_group_like_reference(self):
@@ -531,6 +574,172 @@ class TestOperator:
         op2 = Operator("swarm4", send_gains=False)
         msg = op2.next_formation()
         assert msg.gains is None
+
+
+@needs_native
+class TestBridgeLifecycle:
+    def test_takeoff_fly_land_kill_over_wire(self):
+        """The whole flight lifecycle wire-only: an operator broadcasts
+        GO/LAND/KILL `FlightMode` messages and dispatches a `Formation`;
+        a bridge process owns the planner; this process plays the
+        vehicles' L2/L1 stack (flight FSM + safe-traj + tracking) fed
+        exclusively by decoded wire traffic. Verifies the round-2 gaps:
+        the flight-mode channel exists, `SafetyStatusArray` streams per
+        tick, and KILL cuts distcmd to zero on the very next tick
+        (`safety.cpp:116-120`, `operator.py:117-135`)."""
+        import pathlib
+        import time
+
+        import jax.numpy as jnp
+
+        from aclswarm_tpu.control import safety as safetylib
+        from aclswarm_tpu.core.types import SafetyParams
+        from aclswarm_tpu.interop.operator import Operator
+        from aclswarm_tpu.interop.transport import Channel
+        from aclswarm_tpu.sim import vehicle as veh
+
+        ns = f"/aswtest-{uuid.uuid4().hex[:8]}"
+        repo = str(pathlib.Path(__file__).resolve().parents[1])
+        n = 4
+        dt = 0.01
+        child = subprocess.Popen(
+            [sys.executable, "-m", "aclswarm_tpu.interop.bridge",
+             "--n", str(n), "--ns", ns, "--assign-every", "50",
+             "--idle-timeout", "180"], cwd=repo)
+        chans = {}
+        try:
+            deadline = time.time() + 60
+            for name in ("formation", "flightmode", "estimates", "distcmd",
+                         "assignment", "safety"):
+                while True:
+                    try:
+                        chans[name] = Channel(f"{ns}-{name}")
+                        break
+                    except OSError:
+                        if time.time() > deadline:
+                            raise
+                        time.sleep(0.05)
+
+            # vehicle-side broadcast ring (operator -> vehicles), the
+            # /globalflightmode edge the fleet consumes
+            veh_mode = Channel(f"{ns}-flightmode-veh", create=True)
+            chans["flightmode-veh"] = veh_mode
+
+            # fast ramps so the lifecycle fits a test budget
+            sparams = SafetyParams(
+                bounds_min=jnp.asarray([-50.0, -50.0, 0.0]),
+                bounds_max=jnp.asarray([50.0, 50.0, 30.0]),
+                spinup_time=0.1, takeoff_inc=0.02,
+                landing_fast_dec=0.02, landing_slow_dec=0.01)
+            rng = np.random.default_rng(7)
+            q = np.zeros((n, 3))
+            q[:, :2] = rng.normal(size=(n, 2)) * 2.0
+            q = jnp.asarray(q)
+            fs = veh.init_flight(n, q.dtype, flying=False)
+            goal = safetylib.TrajGoal.hover_at(q)
+            tick = 0
+
+            def vehicle_tick():
+                """One wire-fed vehicle tick; returns (distcmd, safety)."""
+                nonlocal q, fs, goal, tick
+                cmd = veh.CMD_NONE
+                while isinstance(fm := veh_mode.recv(), m.FlightMode):
+                    cmd = int(fm.mode)   # MODE_* == CMD_* by construction
+                fs = veh.apply_command(fs, jnp.asarray(cmd, jnp.int32))
+                assert chans["estimates"].send(m.VehicleEstimates(
+                    header=m.Header(seq=tick, stamp=tick * dt),
+                    positions=np.asarray(q), stamps=np.full(n, tick * dt)))
+                cmdmsg = None
+                t0 = time.time()
+                while cmdmsg is None and time.time() - t0 < 60:
+                    cmdmsg = chans["distcmd"].recv()
+                    if cmdmsg is None:
+                        time.sleep(0.0005)
+                assert cmdmsg is not None, f"no distcmd at tick {tick}"
+                safe = chans["safety"].recv()
+                u = jnp.where((fs.mode == veh.FLYING)[:, None],
+                              jnp.asarray(cmdmsg.vel), 0.0)
+                u = safetylib.saturate_velocity(u, sparams)
+                sg = safetylib.make_safe_traj(dt, u, jnp.zeros((n,)), goal,
+                                              sparams)
+                fs, goal = veh.flight_step(fs, goal, sg, q, sparams, dt)
+                q = goal.pos
+                tick += 1
+                return cmdmsg, safe
+
+            op = Operator("swarm4")
+
+            # -- phase 1: START on the ground => GO broadcast, takeoff --
+            assert op.start(veh_mode.send) is None and op.flying
+            # bridge hears the same broadcast on its own ring
+            assert chans["flightmode"].send(
+                m.FlightMode(header=m.Header(), mode=m.MODE_GO))
+            for _ in range(1500):
+                cmdmsg, _ = vehicle_tick()
+                assert np.all(cmdmsg.vel == 0)   # no formation committed
+                if bool(jnp.all(fs.mode == veh.FLYING)):
+                    break
+            assert bool(jnp.all(fs.mode == veh.FLYING)), np.asarray(fs.mode)
+            np.testing.assert_allclose(np.asarray(q)[:, 2], 1.0, atol=0.11)
+
+            # -- phase 2: START in flight => formation dispatch, fly --
+            fmsg = op.start(veh_mode.send, chans["formation"].send)
+            assert isinstance(fmsg, m.Formation)
+            got_asn = got_safety = False
+            moved = 0.0
+            for _ in range(300):
+                cmdmsg, safe = vehicle_tick()
+                if chans["assignment"].recv() is not None:
+                    got_asn = True
+                if safe is not None:
+                    got_safety = True
+                    assert safe.active.shape == (n,)
+                moved = max(moved, float(np.abs(cmdmsg.vel).max()))
+            assert got_asn and got_safety and moved > 0
+
+            # -- phase 3: END => LAND broadcast, descend to ground --
+            op.end(veh_mode.send)
+            assert not op.flying
+            for _ in range(2000):
+                vehicle_tick()
+                if bool(jnp.all(fs.mode == veh.NOT_FLYING)):
+                    break
+            assert bool(jnp.all(fs.mode == veh.NOT_FLYING))
+            assert float(jnp.max(q[:, 2])) < 0.05
+
+            # -- phase 4: GO again, then KILL mid-flight --
+            assert op.start(veh_mode.send) is None
+            chans["flightmode"].send(
+                m.FlightMode(header=m.Header(), mode=m.MODE_GO))
+            for _ in range(1500):
+                vehicle_tick()
+                if bool(jnp.all(fs.mode == veh.FLYING)):
+                    break
+            # formation is still committed: commands flow again
+            cmdmsg, _ = vehicle_tick()
+            op.kill(veh_mode.send)
+            chans["flightmode"].send(
+                m.FlightMode(header=m.Header(), mode=m.MODE_KILL))
+            # the bridge drains flight modes before the tick: the very
+            # next distcmd frame must be all-zero (e-stop semantics)
+            cmdmsg, _ = vehicle_tick()
+            assert np.all(cmdmsg.vel == 0.0), cmdmsg.vel
+            assert bool(jnp.all(fs.mode == veh.NOT_FLYING))
+
+            # shut the bridge down cleanly over the wire
+            pts = np.asarray(fmsg.points)
+            chans["formation"].send(m.Formation(
+                header=m.Header(), name="__shutdown__", points=pts,
+                adjmat=np.asarray(fmsg.adjmat)))
+        finally:
+            child.terminate()
+            try:
+                child.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                child.kill()
+                child.wait(timeout=30)
+            for ch in chans.values():
+                ch.close()
 
 
 @needs_native
