@@ -1,0 +1,108 @@
+"""Monte-Carlo trial evidence for every runnable north-star config.
+
+The reference's unit of result is a *trial*: `trials.sh -m K` followed by
+the `analyze_simtrials.m:38-59` reduction into completion %, convergence
+times, avoidance time, and assignment counts. This driver produces that
+table for the framework's north-star configs (BASELINE.md) and commits it
+as artifacts:
+
+    benchmarks/results/trials_<config>.csv     one reference-schema row
+                                               per completed trial
+    benchmarks/results/trials_summary.json     the analyze() reduction per
+                                               config + environment info
+
+Run (on the bench TPU; CPU works but slower):
+
+    python benchmarks/trials_suite.py [--quick] [--only CONFIG]
+
+All configs run `dynamics=doubleint` (the honest second-order model,
+golden-pinned in tests/test_dynamics_golden.py).
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from aclswarm_tpu.harness import trials as triallib
+
+RESULTS = Path(__file__).resolve().parent / "results"
+
+# (name, TrialConfig overrides, trials, quick-trials)
+CONFIGS = [
+    # flagship demo group (BASELINE.md config 1)
+    ("swarm6_3d", dict(formation="swarm6_3d"), 20, 2),
+    # random noncomplete graphs, solve-gains-on-dispatch (config 2 shape)
+    ("simform10", dict(formation="simform10"), 20, 2),
+    ("simform20", dict(formation="simform20"), 10, 1),
+    # decentralized CBAA + flooded localization (the real information
+    # model) on the shipped sparse group
+    ("swarm6_sparse_cbaa_flooded",
+     dict(formation="swarm6_sparse", assignment="cbaa",
+          localization="flooded"), 10, 1),
+    # scale group: 100 agents, gains solved on dispatch (config 3)
+    ("swarm100", dict(formation="swarm100", assignment="sinkhorn",
+                      colavoid_neighbors=16), 5, 1),
+]
+
+
+def run_config(name: str, overrides: dict, m: int, seed: int = 1) -> dict:
+    out = RESULTS / f"trials_{name}.csv"
+    out.unlink(missing_ok=True)
+    cfg = triallib.TrialConfig(trials=m, seed=seed, out=str(out),
+                               verbose=True, **overrides)
+    t0 = time.time()
+    stats = triallib.run_trials(cfg)
+    stats["wall_s"] = round(time.time() - t0, 1)
+    stats["config"] = {k: v for k, v in dataclasses.asdict(cfg).items()
+                       if k not in ("out", "verbose")}
+    return stats
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="1-2 trials per config (smoke)")
+    ap.add_argument("--only", default=None, help="run a single config")
+    ap.add_argument("--seed", type=int, default=1)
+    args = ap.parse_args(argv)
+
+    import jax
+    RESULTS.mkdir(exist_ok=True)
+    summary = {
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "configs": {},
+    }
+    for name, overrides, m, mq in CONFIGS:
+        if args.only and name != args.only:
+            continue
+        n_trials = mq if args.quick else m
+        print(f"=== {name} (m={n_trials}) ===", flush=True)
+        stats = run_config(name, overrides, n_trials, args.seed)
+        summary["configs"][name] = stats
+        print(json.dumps({k: v for k, v in stats.items()
+                          if k != "config"}), flush=True)
+
+    path = RESULTS / "trials_summary.json"
+    existing = {}
+    if path.exists():
+        existing = json.loads(path.read_text())
+        existing.get("configs", {}).update(summary["configs"])
+        summary["configs"] = existing.get("configs", summary["configs"])
+    path.write_text(json.dumps(summary, indent=1))
+    print(f"wrote {path}")
+    bad = [k for k, v in summary["configs"].items()
+           if v["completion_pct"] < 100.0]
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
