@@ -65,6 +65,7 @@ class TrialConfig:
     # firstorder
     dynamics: str = "doubleint"
     localization: str = "truth"     # truth | flooded (L3 estimate tables)
+    flood_block: Optional[int] = None  # flood-merge blocking (scale knob)
     tau: float = 0.15
     control_dt: float = 0.01
     assign_every: int = 120
@@ -141,6 +142,7 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
     engine_kw = dict(control_dt=cfg.control_dt, assign_every=cfg.assign_every,
                      dynamics=cfg.dynamics, tau=cfg.tau,
                      localization=cfg.localization,
+                     flood_block=cfg.flood_block,
                      colavoid_neighbors=cfg.colavoid_neighbors,
                      flight_fsm=True)
     hover_cfg = sim.SimConfig(assignment="none", **engine_kw)

@@ -86,6 +86,10 @@ class SimConfig:
     # flood decimation in control ticks: tracking_dt=0.02 / control_dt=0.01
     # (`localization_ros.cpp:34`)
     flood_every: int = struct.field(pytree_node=False, default=2)
+    # flood-merge target blocking (None = dense (n, n, n) broadcast; an
+    # integer B caps merge memory at O(n^2 B) — required at n ~ 1000,
+    # bit-identical results; see `localization.flood`)
+    flood_block: int | None = struct.field(pytree_node=False, default=None)
 
 
 @struct.dataclass
@@ -193,7 +197,8 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
             raise ValueError("cfg.localization='flooded' needs "
                              "init_state(..., localization=True)")
         loc = loclib.tick(loc, swarm.q, formation.adjmat, v2f,
-                          (state.tick % cfg.flood_every) == 0)
+                          (state.tick % cfg.flood_every) == 0,
+                          target_block=cfg.flood_block)
         est = loc.est
     elif cfg.localization == "truth":
         est = None

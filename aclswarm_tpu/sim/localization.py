@@ -33,10 +33,14 @@ Divergences (documented):
   add jitter from TCPROS delivery that a bulk-synchronous step doesn't
   model.
 
-Memory note: the merge materializes an ``(n, n, n)`` age broadcast — fine
-at trial scale (n=100 -> 4 MB); the n=1000 scale path runs the engine's
-``localization='truth'`` mode (the reference's centralized comparison mode
-has ground truth too, `aclswarm/nodes/operator.py:221-246`).
+Memory note: the dense merge materializes an ``(n, n, n)`` age broadcast
+— fine at trial scale (n=100 -> 4 MB), 4 GB at n=1000. ``target_block``
+scans the target axis in blocks of B exactly like the CBAA kernel's
+``task_block`` (`assignment/cbaa.py:_consensus_round`), keeping peak
+memory at O(n^2 B) with bit-identical results (the merge is independent
+per target) — the faithful information model runs at the n=1000 north
+star. The reference's per-vehicle tracker is O(n) per vehicle for the
+same reason (`vehicle_tracker.cpp:31-45` merges element-wise).
 """
 from __future__ import annotations
 
@@ -86,7 +90,8 @@ def observe_self(table: EstimateTable, q_true: jnp.ndarray) -> EstimateTable:
                          age=table.age.at[rows, rows].set(0))
 
 
-def flood(table: EstimateTable, comm: jnp.ndarray) -> EstimateTable:
+def flood(table: EstimateTable, comm: jnp.ndarray,
+          target_block: int | None = None) -> EstimateTable:
     """One synchronous flood round: every vehicle broadcasts its table to
     its comm-graph neighbors, receivers merge with newest-stamp-wins
     (`vehicle_tracker.cpp:31-45`: an incoming estimate replaces the stored
@@ -98,11 +103,31 @@ def flood(table: EstimateTable, comm: jnp.ndarray) -> EstimateTable:
     among equally-fresh senders the lowest id wins (argmin's first-hit),
     which in the reference is message-arrival order — load-bearing nowhere,
     since equal age means equal source stamp means identical payload.
+
+    ``target_block=None`` materializes the full (n, n, n) broadcast —
+    simplest and fastest for moderate n. An integer B instead scans the
+    target axis in blocks of B (`lax.map`), peak memory O(n^2 B), with
+    bit-identical results — the merge is independent per target j. Same
+    scheme as the CBAA kernel's ``task_block``.
     """
     age, est = table.age, table.est
-    cand = jnp.where(comm[:, :, None], age[None, :, :], MAX_AGE)  # (n,n,n)
-    best = jnp.min(cand, axis=1)            # (n, n) freshest neighbor age
-    src = jnp.argmin(cand, axis=1)          # (n, n) who provides it
+    n = age.shape[0]
+
+    def block_merge(age_b):
+        """(n, B) age block -> (best age, source) over the sender axis."""
+        cand = jnp.where(comm[:, :, None], age_b[None, :, :], MAX_AGE)
+        return jnp.min(cand, axis=1), jnp.argmin(cand, axis=1)
+
+    if target_block is None:
+        best, src = block_merge(age)        # (n, n) freshest neighbor age
+    else:
+        B = int(target_block)
+        pad = (-n) % B
+        age_p = jnp.pad(age, ((0, 0), (0, pad)), constant_values=MAX_AGE)
+        blocks = age_p.reshape(n, -1, B).transpose(1, 0, 2)   # (nb, n, B)
+        best_b, src_b = lax.map(block_merge, blocks)          # (nb, n, B)
+        best = best_b.transpose(1, 0, 2).reshape(n, -1)[:, :n]
+        src = src_b.transpose(1, 0, 2).reshape(n, -1)[:, :n]
     take = best < age                       # strictly newer wins
     est_new = jnp.take_along_axis(
         est, src[:, :, None].astype(jnp.int32), axis=0)  # est[src[v,j], j]
@@ -113,14 +138,16 @@ def flood(table: EstimateTable, comm: jnp.ndarray) -> EstimateTable:
 
 
 def tick(table: EstimateTable, q_true: jnp.ndarray, adjmat: jnp.ndarray,
-         v2f: jnp.ndarray, do_flood: jnp.ndarray) -> EstimateTable:
+         v2f: jnp.ndarray, do_flood: jnp.ndarray,
+         target_block: int | None = None) -> EstimateTable:
     """One control tick of the localization layer: ages advance, own state
     refreshes (the autopilot feed outruns the flood), and on decimated
     ticks (50 Hz, `localization_ros.cpp:34`) the flood round runs."""
     table = EstimateTable(est=table.est, age=table.age + 1)
     table = observe_self(table, q_true)
     comm = comm_mask(adjmat, v2f)
-    return lax.cond(do_flood, lambda t: flood(t, comm), lambda t: t, table)
+    return lax.cond(do_flood, lambda t: flood(t, comm, target_block),
+                    lambda t: t, table)
 
 
 def relative_views(table: EstimateTable) -> jnp.ndarray:

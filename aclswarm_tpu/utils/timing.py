@@ -35,18 +35,29 @@ def readback_sync(x) -> float:
     return float(np.asarray(jax.tree.leaves(x)[0]).ravel()[0])
 
 
-def median_time(fn, arg, per: int = 1, reps: int = 5) -> float:
-    """Median wall seconds of ``fn(arg)`` divided by ``per``, after one
+def timing_stats(fn, arg, per: int = 1, reps: int = 5) -> dict:
+    """Wall-second statistics of ``fn(arg)`` divided by ``per``, after one
     warmup call; ``fn`` should return a small digest (see
     `readback_sync`). For device work, chain ``per`` distinct instances
-    inside ``fn`` (one `lax.scan`) so fixed launch overhead amortizes."""
+    inside ``fn`` (one `lax.scan`) so fixed launch overhead amortizes.
+
+    Returns median plus the rep spread (min/max) so artifacts carry a
+    jitter column — a single median hides tunnel hiccups and thermal
+    variance (the round-1 unexplained-variance lesson)."""
     readback_sync(fn(arg))
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
         readback_sync(fn(arg))
         times.append((time.perf_counter() - t0) / per)
-    return float(np.median(times))
+    return {"median_s": float(np.median(times)),
+            "min_s": float(np.min(times)), "max_s": float(np.max(times)),
+            "reps": reps}
+
+
+def median_time(fn, arg, per: int = 1, reps: int = 5) -> float:
+    """Median-only convenience wrapper over `timing_stats`."""
+    return timing_stats(fn, arg, per=per, reps=reps)["median_s"]
 
 
 @contextlib.contextmanager

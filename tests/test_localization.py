@@ -81,6 +81,29 @@ class TestFlood:
         t3 = loc.flood(loc.EstimateTable(est=est, age=age), comm)
         assert float(t3.est[0, 2, 0]) == -1.0
 
+    def test_blocked_merge_bit_identical_to_dense(self):
+        """`target_block` is a pure memory shape change: blocked and dense
+        floods must produce bit-identical tables for every block size,
+        including non-divisors (the n=1000 scale mode's correctness
+        contract; same scheme as CBAA's task_block)."""
+        n = 17
+        rng = np.random.default_rng(3)
+        adj = (rng.random((n, n)) < 0.3).astype(float)
+        adj = np.triu(adj, 1)
+        adj = adj + adj.T
+        v2f = jnp.asarray(rng.permutation(n).astype(np.int32))
+        comm = loc.comm_mask(jnp.asarray(adj), v2f)
+        t = loc.EstimateTable(
+            est=jnp.asarray(rng.normal(size=(n, n, 3))),
+            age=jnp.asarray(rng.integers(0, 50, (n, n)), jnp.int32))
+        dense = loc.flood(t, comm)
+        for B in (1, 4, 5, 16, 17, 32):
+            blocked = loc.flood(t, comm, target_block=B)
+            np.testing.assert_array_equal(np.asarray(dense.est),
+                                          np.asarray(blocked.est), err_msg=str(B))
+            np.testing.assert_array_equal(np.asarray(dense.age),
+                                          np.asarray(blocked.age), err_msg=str(B))
+
     def test_comm_graph_follows_assignment(self):
         """v hears w iff their formation points are adjacent
         (`localization_ros.cpp:152-185`)."""
