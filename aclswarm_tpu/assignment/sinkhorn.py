@@ -199,7 +199,8 @@ def sinkhorn_assign(q: jnp.ndarray, p_aligned: jnp.ndarray,
                     tau: float = 0.03, n_iters: int = 200,
                     rounding: str = "dominant",
                     refine_sweeps: int = 12,
-                    impl: str = "xla") -> SinkhornResult:
+                    impl: str = "xla",
+                    stage_shardings=None) -> SinkhornResult:
     """Fast assignment: vehicle->point distances, Sinkhorn, rounding, repair.
 
     Cost uses the same distance the reference prices bids with
@@ -210,6 +211,19 @@ def sinkhorn_assign(q: jnp.ndarray, p_aligned: jnp.ndarray,
     parallel 2-opt repair against the (MXU-expansion) distance cost —
     near-zero distances carry ~sqrt(eps)*scale error, immaterial for swap
     gains.
+
+    ``stage_shardings`` (optional, for mesh execution): a pair of
+    `NamedSharding`s ``(iter_sharding, round_sharding)``. The Sinkhorn
+    iterations are FLOP-bound row/col reductions that shard cleanly (one
+    small all-reduce per half-iteration), but the rounding/repair stages
+    are *sequential conflict-resolution loops* — 15-30 data-dependent
+    rounds of global argmax + scattered strikes whose per-round
+    cross-shard reductions and loop synchronization dwarf their tiny
+    FLOPs. Staging pins the (n, n) plan/cost to ``round_sharding``
+    (typically replicated: one gather, then every device rounds locally
+    and identically) instead of letting GSPMD thread the iteration
+    sharding through the loops. See benchmarks/collective_audit.py and
+    docs/SCALING.md for the measured inventory.
     """
     from aclswarm_tpu.core import geometry
     # the n=1000 fast path prices with the MXU distance (see cdist_fast:
@@ -217,7 +231,14 @@ def sinkhorn_assign(q: jnp.ndarray, p_aligned: jnp.ndarray,
     cost_raw = geometry.cdist_fast(q, p_aligned)
     # normalize scale so tau is formation-size independent
     cost = cost_raw / (jnp.mean(cost_raw) + 1e-12)
+    if stage_shardings is not None:
+        cost = lax.with_sharding_constraint(cost, stage_shardings[0])
     plan_log = sinkhorn_log(cost, tau=tau, n_iters=n_iters, impl=impl)
+    if stage_shardings is not None:
+        plan_log = lax.with_sharding_constraint(plan_log,
+                                                stage_shardings[1])
+        cost_raw = lax.with_sharding_constraint(cost_raw,
+                                                stage_shardings[1])
     if rounding == "dominant":
         v2f = round_dominant(plan_log)
     elif rounding == "parallel":

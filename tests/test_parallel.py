@@ -140,6 +140,16 @@ class TestShardedAssignment:
                 jax.device_put(q, row)))
         np.testing.assert_array_equal(out, ref)
 
+        # staged shardings (docs/SCALING.md: iterations sharded, the
+        # sequential rounding loops replicated) are a pure layout change —
+        # identical decisions again
+        staged = np.asarray(jax.jit(
+            lambda q: sinkhorn.sinkhorn_assign(
+                q, p, stage_shardings=(row, rep)).row_to_col,
+            in_shardings=(row,), out_shardings=rep)(
+                jax.device_put(q, row)))
+        np.testing.assert_array_equal(staged, ref)
+
 
 class TestShardedFloodedLocalization:
     def test_sharded_flooded_matches_single_device(self):
