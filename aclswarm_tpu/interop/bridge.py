@@ -134,6 +134,15 @@ def run_bridge(n: int, ns: str = "/asw", ticks: int = 0,
 
 
 def main(argv=None):
+    # honor JAX_PLATFORMS=cpu through jax.config: the axon TPU plugin
+    # ignores the env var alone, so without this a bridge spawned by the
+    # CPU test suite silently grabs the (possibly busy) tunnel chip and
+    # its ticks stall behind whatever else holds the device — the round-2
+    # bridge-test flake
+    import os
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--n", type=int, required=True)
     ap.add_argument("--ns", default="/asw")

@@ -35,6 +35,11 @@ def pytest_configure(config):
         "markers",
         "f32: device-precision tier — runs the core kernels at float32 "
         "(on TPU when ACLSWARM_TEST_TPU=1) with justified tolerances")
+    config.addinivalue_line(
+        "markers",
+        "slow: > ~30 s (full trials, cross-process bridge loops). Quick "
+        "tier: pytest -m 'not slow' (< ~2 min); run the full suite "
+        "before committing substantial changes")
 
 
 @pytest.fixture

@@ -12,6 +12,8 @@ does it (per-vehicle loops, linearized-angle sector union), on random inputs:
 """
 import math
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -232,6 +234,7 @@ class TestColAvoid:
             np.linalg.norm(np.asarray(out)[0, :2]), 0.5, atol=1e-9)
         assert abs(float(out[0, 1])) > 0.1  # rotated off the -x axis
 
+    @pytest.mark.slow
     def test_topk_pruning_exact_when_sparse(self):
         # with <= k vehicles inside the threshold per agent, the pruned
         # O(n*k^2) path must match the dense O(n^3) path exactly

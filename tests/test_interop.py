@@ -18,6 +18,18 @@ from aclswarm_tpu.interop import native as nat
 RNG = np.random.default_rng(0)
 
 
+def _load_factor() -> float:
+    """Deadline multiplier for the cross-process tests: under parallel
+    suite load (two pytest halves + a bridge child per test) wall-clock
+    deadlines tuned for an idle box flake (round-2 weak #5). Scales with
+    the 1-min load average, capped so a pathological box still fails."""
+    import os
+    try:
+        return min(4.0, max(1.0, os.getloadavg()[0] / os.cpu_count()))
+    except OSError:
+        return 1.0
+
+
 def _formation_msg(n=6, gains=True, name="ring6"):
     g = None
     if gains:
@@ -602,13 +614,14 @@ class TestBridgeLifecycle:
         repo = str(pathlib.Path(__file__).resolve().parents[1])
         n = 4
         dt = 0.01
+        lf = _load_factor()
         child = subprocess.Popen(
             [sys.executable, "-m", "aclswarm_tpu.interop.bridge",
              "--n", str(n), "--ns", ns, "--assign-every", "50",
-             "--idle-timeout", "180"], cwd=repo)
+             "--idle-timeout", str(180 * lf)], cwd=repo)
         chans = {}
         try:
-            deadline = time.time() + 60
+            deadline = time.time() + 60 * lf
             for name in ("formation", "flightmode", "estimates", "distcmd",
                          "assignment", "safety"):
                 while True:
@@ -651,7 +664,7 @@ class TestBridgeLifecycle:
                     positions=np.asarray(q), stamps=np.full(n, tick * dt)))
                 cmdmsg = None
                 t0 = time.time()
-                while cmdmsg is None and time.time() - t0 < 60:
+                while cmdmsg is None and time.time() - t0 < 60 * lf:
                     cmdmsg = chans["distcmd"].recv()
                     if cmdmsg is None:
                         time.sleep(0.0005)
@@ -759,14 +772,15 @@ class TestBridgeEndToEnd:
         ns = f"/aswtest-{uuid.uuid4().hex[:8]}"
         repo = str(pathlib.Path(__file__).resolve().parents[1])
         n, ticks = 4, 600
+        lf = _load_factor()
         child = subprocess.Popen(
             [sys.executable, "-m", "aclswarm_tpu.interop.bridge",
              "--n", str(n), "--ns", ns, "--ticks", str(ticks),
-             "--assign-every", "50", "--idle-timeout", "120"],
+             "--assign-every", "50", "--idle-timeout", str(120 * lf)],
             cwd=repo)
         try:
             # the bridge creates the rings; wait for them
-            deadline = time.time() + 60
+            deadline = time.time() + 60 * lf
             chans = {}
             for name in ("formation", "estimates", "distcmd", "assignment"):
                 while True:
@@ -795,7 +809,7 @@ class TestBridgeEndToEnd:
                     stamps=np.full(n, k * dt)))
                 cmd = None
                 t0 = time.time()
-                while cmd is None and time.time() - t0 < 60:
+                while cmd is None and time.time() - t0 < 60 * lf:
                     cmd = chans["distcmd"].recv()
                     if cmd is None:
                         time.sleep(0.001)

@@ -34,6 +34,7 @@ def _rollout_records(seed, n=7, fc=False, ticks=600, assign_every=30):
     return replay.record_auctions(m, q0, np.arange(n), f)
 
 
+@pytest.mark.slow
 def test_replay_hundred_recorded_auctions():
     """>= 100 auctions recorded from random rollouts (sparse and complete
     graphs): the device kernel and the sequential oracle agree on every

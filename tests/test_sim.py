@@ -138,8 +138,21 @@ class TestFormationLoader:
                                    np.ones((6, 6)) - np.eye(6))
 
     def test_scale_applied_to_points_only(self):
+        """Loader multiplies points by the formation's scale and leaves the
+        gains untouched (`operator.py:155-157`); pinned against the raw
+        yaml so the check survives geometry redesigns."""
+        import yaml
+
+        from aclswarm_tpu.harness.formations import DEFAULT_LIBRARY
         fm = harness.load_formation("Octahedron", group="swarm6_3d")
-        np.testing.assert_allclose(fm.points[0], [1.5, 0.0, 0.0])
+        lib = yaml.safe_load(open(DEFAULT_LIBRARY))
+        raw = [f for f in lib["swarm6_3d"]["formations"]
+               if f["name"] == "Octahedron"][0]
+        scale = float(raw["scale"])
+        assert scale != 1.0   # the check must exercise a real scale
+        np.testing.assert_allclose(fm.points,
+                                   scale * np.asarray(raw["points"]))
+        np.testing.assert_allclose(fm.gains, np.asarray(raw["gains"]))
 
     @needs_reference
     def test_reference_library_group_fc_override(self):
@@ -317,6 +330,7 @@ class TestDoubleIntegratorDynamics:
         # velocities die down at the fixed point (second-order settle)
         assert np.abs(np.asarray(state.swarm.vel)).max() < 0.1
 
+    @pytest.mark.slow
     def test_velocity_is_continuous(self):
         """A double integrator cannot jump velocity: per-tick delta is
         bounded by acc*dt (unlike 'tracking', which teleports to goals)."""
