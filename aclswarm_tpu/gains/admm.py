@@ -227,13 +227,38 @@ def _subproblem(Q: jnp.ndarray, i_idx: jnp.ndarray, j_idx: jnp.ndarray,
         """
         norm = jnp.linalg.norm(W) + jnp.asarray(1e-30, dtype)
         Z = W / norm
+        prec = params.newton_precision
 
-        def body(Z, _):
-            return 1.5 * Z - 0.5 * jnp.matmul(
-                jnp.matmul(Z, Z, precision="highest"), Z,
-                precision="highest"), None
+        if params.newton_tol > 0.0:
+            # adaptive: stop once the iterate stalls —
+            # ||Z_{k+1} - Z_k||_F / ||Z_k||_F < tol. The bulk spectrum
+            # converges quadratically to +-1 and stops moving; only
+            # near-zero eigenvalues (~1e-6 ||W||, documented fractional-
+            # sign territory above) keep drifting, and their Frobenius
+            # contribution is below any practical tol. The test is one
+            # elementwise reduction per iteration and typically halves
+            # the fixed 40-iteration budget.
+            def cond(carry):
+                Z, it, done = carry
+                return (~done) & (it < params.newton_iters)
 
-        Z, _ = lax.scan(body, Z, None, length=params.newton_iters)
+            def abody(carry):
+                Z, it, _ = carry
+                Z2 = jnp.matmul(Z, Z, precision=prec)
+                Znew = 1.5 * Z - 0.5 * jnp.matmul(Z2, Z, precision=prec)
+                num = jnp.sqrt(jnp.sum((Znew - Z) ** 2))
+                den = jnp.sqrt(jnp.sum(Z ** 2)) + jnp.asarray(1e-30, dtype)
+                return Znew, it + 1, num / den < params.newton_tol
+
+            Z, _, _ = lax.while_loop(
+                cond, abody, (Z, jnp.asarray(0), jnp.asarray(False)))
+        else:
+            def body(Z, _):
+                return 1.5 * Z - 0.5 * jnp.matmul(
+                    jnp.matmul(Z, Z, precision=prec), Z,
+                    precision=prec), None
+
+            Z, _ = lax.scan(body, Z, None, length=params.newton_iters)
         return (W + jnp.matmul(Z, W, precision="highest")) / 2.0
 
     psd_part = psd_eigh if method == "eigh" else psd_newton

@@ -50,6 +50,25 @@ class AdmmParams:
     #   'auto'   newton at f32 device precision, eigh at f64.
     psd_method: str = "auto"
     newton_iters: int = 40
+    # Newton-Schulz refinements (the n=1000 dispatch-cadence win, round-3):
+    # - newton_tol > 0 stops the sign iteration once the iterate stalls
+    #   (rel Frobenius update < tol). Measured at n=1000 fc/f32: NS
+    #   converges in 15-16 of the 40-iteration budget and the remaining
+    #   iterations are bit-stationary no-ops — adaptive output is
+    #   BIT-IDENTICAL to the fixed budget while 2.2x faster (2.70 s ->
+    #   1.23 s full solve).
+    # - newton_precision sets the matmul precision of the sign iteration
+    #   only ("highest" = 6-pass bf16; "high" = 3-pass, ~2x MXU
+    #   throughput; measured bit-identical output at n=1000 f32 — the
+    #   iteration converges to the same f32 fixed point). The final
+    #   (W + sign(W) W)/2 combine always runs at "highest". Together:
+    #   2.70 s -> 0.77 s, under the 1.2 s dispatch cadence
+    #   (benchmarks/results/scale_tpu.json; eigenstructure validated at
+    #   n=1000 in the artifact run). CPU ignores the precision knob and
+    #   f64 golden parity uses the eigh path, so defaults are safe
+    #   everywhere.
+    newton_tol: float = 1e-4
+    newton_precision: str = "high"
 
 
 def _vec(X: np.ndarray) -> np.ndarray:

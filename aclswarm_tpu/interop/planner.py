@@ -66,9 +66,12 @@ def _tick(swarm: SwarmState, formation: DevFormation, v2f: jnp.ndarray,
     u = control.compute(swarm, formation, new_v2f, cgains)
     # safety stage over the raw distcmd: saturate then the VO check — the
     # per-vehicle safety node's ca-active signal (`safety.cpp:503`),
-    # computed here so the wire carries `SafetyStatus` per tick
+    # computed here so the wire carries `SafetyStatus` per tick. The
+    # k-neighbor pruning knob matters: dense avoidance is O(n^3) memory
+    # and cannot run at the n=1000 deployment scale
     usat = control.saturate_velocity(u, sparams)
-    _, ca = control.collision_avoidance(swarm.q, usat, sparams)
+    _, ca = control.collision_avoidance(
+        swarm.q, usat, sparams, max_neighbors=cfg.colavoid_neighbors)
     return u, new_v2f, valid, ca
 
 
@@ -96,10 +99,17 @@ class TpuPlanner:
     def __init__(self, n: int, assignment: str = "auction",
                  assign_every: int = 120,
                  cgains: Optional[ControlGains] = None,
-                 sparams: Optional[SafetyParams] = None):
+                 sparams: Optional[SafetyParams] = None,
+                 colavoid_neighbors: Optional[int] = "auto"):
         self.n = n
+        if colavoid_neighbors == "auto":
+            # dense VO is exact but O(n^3); above small-swarm scale prune
+            # to the 16 nearest (exact whenever <= 16 vehicles are inside
+            # d_avoid_thresh — see control.collision_avoidance)
+            colavoid_neighbors = 16 if n > 64 else None
         self.cfg = engine.SimConfig(assignment=assignment,
-                                    assign_every=assign_every)
+                                    assign_every=assign_every,
+                                    colavoid_neighbors=colavoid_neighbors)
         self.cgains = cgains or ControlGains()
         self.sparams = sparams or SafetyParams()
         self.formation: Optional[DevFormation] = None

@@ -47,6 +47,9 @@ def main():
         "unit": "Hz",
         "vs_baseline": round(sk["hz"] / BASELINE_HZ, 2),
         "subopt_vs_lap": round(sk["subopt"], 4),
+        # min/max Hz over the 5 timing reps (round-2 next-step #9: spread
+        # makes regressions visible beyond the single median)
+        "hz_spread": sk["hz_spread"],
     }))
 
 
