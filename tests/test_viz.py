@@ -52,3 +52,65 @@ def test_aligned_formation_properties(short_rollout):
     # d=2 alignment matches the swarm's xy centroid
     np.testing.assert_allclose(goal[:, :2].mean(0), q[:, :2].mean(0),
                                atol=1e-8)
+
+
+class TestLivePlot:
+    def _feed(self, lp, n=4, ticks=120):
+        import numpy as np
+
+        from aclswarm_tpu.interop import messages as m
+        rng = np.random.default_rng(0)
+        q = rng.normal(size=(n, 3))
+        for k in range(ticks):
+            t = k * 0.01
+            lp.ingest(m.DistCmd(header=m.Header(seq=k, stamp=t),
+                                vel=rng.normal(size=(n, 3))))
+            lp.ingest(m.SafetyStatusArray(
+                header=m.Header(seq=k, stamp=t),
+                active=(rng.random(n) < 0.2).astype(np.uint8)))
+            lp.ingest(m.VehicleEstimates(
+                header=m.Header(seq=k, stamp=t), positions=q + 0.01 * k,
+                stamps=np.full(n, t)))
+
+    def test_ingest_and_render(self, tmp_path):
+        """The rqt_multiplot-equivalent consumer: wire messages in, a
+        multiplot frame out (`cfg/multiplot_xyvel.xml` analogue)."""
+        from aclswarm_tpu.harness.liveplot import LivePlot
+        lp = LivePlot(n=4, window_s=0.5)
+        self._feed(lp)
+        out = tmp_path / "live.png"
+        lp.render(str(out))
+        assert out.exists() and out.stat().st_size > 5000
+        # rolling window: only the trailing 0.5 s stays buffered
+        ts, vel = lp._window(lp._cmd)
+        assert ts[0] >= ts[-1] - 0.5 and vel.shape[1:] == (4, 3)
+
+    def test_observe_over_wire(self, tmp_path):
+        """End-to-end over injected channels (the shm deployment shape is
+        the same recv loop)."""
+        import numpy as np
+
+        from aclswarm_tpu.harness import liveplot
+        from aclswarm_tpu.interop import messages as m
+
+        class FakeChannel:
+            def __init__(self, msgs):
+                self.msgs = list(msgs)
+
+            def recv(self):
+                return self.msgs.pop(0) if self.msgs else None
+
+        n = 3
+        rng = np.random.default_rng(1)
+        cmds = [m.DistCmd(header=m.Header(seq=k, stamp=k * 0.01),
+                          vel=rng.normal(size=(n, 3))) for k in range(50)]
+        safety = [m.SafetyStatusArray(header=m.Header(seq=k, stamp=k * 0.01),
+                                      active=np.zeros(n, np.uint8))
+                  for k in range(50)]
+        out = tmp_path / "obs.png"
+        frames = liveplot.observe(
+            "/unused", n, str(out), interval_s=0.1, duration_s=0.4,
+            channels={"distcmd": FakeChannel(cmds),
+                      "safety": FakeChannel(safety),
+                      "estimates": FakeChannel([])})
+        assert frames >= 2 and out.exists()
