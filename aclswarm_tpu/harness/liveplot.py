@@ -10,9 +10,10 @@ equivalent is a *wire-attached* consumer: this module opens the
 peer of the same rings the vehicles consume is not possible on SPSC
 rings — so the bridge is pointed at a dedicated namespace, or this
 plotter IS the consumer in an observation deployment), maintains rolling
-time buffers, and renders the multiplot panels on an interval: live to a
-window when a display exists, else to a continuously-rewritten PNG (the
-headless "glance at the dashboard" mode).
+time buffers, and re-renders the multiplot panels (per-vehicle vx/vy,
+ca-active raster, xy estimate traces) to an atomically-rewritten PNG on
+an interval — point any image viewer that auto-reloads at the file and
+it behaves like the rqt window.
 
 Run (observing a bridge at /asw, writing /tmp/live.png every 2 s):
 
@@ -82,11 +83,10 @@ class LivePlot:
     # -- rendering --------------------------------------------------------
     def render(self, out: str) -> None:
         """One multiplot frame: per-vehicle vx/vy (`multiplot_xyvel.xml`),
-        |distcmd|, ca-active raster, and xy estimate traces
+        ca-active raster, and xy estimate traces
         (`multiplot_vehicletracker`)."""
-        import matplotlib
-        matplotlib.use("Agg", force=False)
-        import matplotlib.pyplot as plt
+        from aclswarm_tpu.harness.viz import _mpl
+        plt = _mpl()
 
         fig, axes = plt.subplots(2, 2, figsize=(11, 7))
         (ax_vx, ax_vy), (ax_ca, ax_xy) = axes
@@ -121,12 +121,12 @@ class LivePlot:
         ax_xy.grid(True, alpha=0.3)
 
         fig.tight_layout()
-        # atomic-ish rewrite so a viewer polling the file never sees a
+        # atomic rewrite so a viewer polling the file never sees a
         # half-written image
+        import os
         tmp = out + ".tmp.png"
         fig.savefig(tmp, dpi=110)
         plt.close(fig)
-        import os
         os.replace(tmp, out)
 
 
