@@ -1,0 +1,20 @@
+"""Shared sizing helpers for the VMEM-resident kernels.
+
+Every kernel in this package pins whole matrices in VMEM; the padding rule
+(lane alignment) and the fits-in-VMEM gate live here once so a new kernel
+cannot forget the budget check (the v5e has ~16 MB of VMEM per core; we
+budget 14 MB to leave headroom for Mosaic's own temporaries).
+"""
+from __future__ import annotations
+
+VMEM_BUDGET_BYTES = 14 * 2**20
+
+
+def pad128(n: int) -> int:
+    """Pad a dimension up to the 128-lane tile."""
+    return max(128, ((n + 127) // 128) * 128)
+
+
+def fits_vmem(total_bytes: int) -> bool:
+    """Would a kernel holding ``total_bytes`` of VMEM-resident state fit?"""
+    return total_bytes <= VMEM_BUDGET_BYTES

@@ -96,8 +96,26 @@ def observe_self(table: EstimateTable, q_true: jnp.ndarray) -> EstimateTable:
                          age=table.age.at[rows, rows].set(0))
 
 
+def _merge_impl(n: int) -> str:
+    """Single-TPU f32-scale runs use the VMEM-resident Pallas merge
+    (`ops.flood_pallas`, bit-parity tested, ~1.75x the blocked XLA form
+    at n=1000); everything else keeps the XLA paths. Multi-device
+    backends stay on XLA under 'auto': a pallas_call would pin the whole
+    (n, n) table to one device's VMEM, defeating agent-axis sharding
+    (same rationale as `sinkhorn_assign`'s stage_shardings guard)."""
+    import jax
+
+    from aclswarm_tpu.ops.flood_pallas import flood_merge_bytes
+    from aclswarm_tpu.ops._vmem import fits_vmem
+    if (jax.default_backend() == "tpu" and len(jax.devices()) == 1
+            and 128 <= n < (1 << 16) and fits_vmem(flood_merge_bytes(n))):
+        return "pallas"
+    return "xla"
+
+
 def flood(table: EstimateTable, comm: jnp.ndarray,
-          target_block: int | None = None) -> EstimateTable:
+          target_block: int | None = None,
+          merge_impl: str = "auto") -> EstimateTable:
     """One synchronous flood round: every vehicle broadcasts its table to
     its comm-graph neighbors, receivers merge with newest-stamp-wins
     (`vehicle_tracker.cpp:31-45`: an incoming estimate replaces the stored
@@ -130,6 +148,8 @@ def flood(table: EstimateTable, comm: jnp.ndarray,
     # packed[w, j] = clamp(age[w, j]) << 16 | w   (min => freshest, then
     # lowest sender id — exactly the argmin-first-hit tie rule)
     packed = (jnp.minimum(age, AGE_CAP) << 16) | ids[:, None]
+    if merge_impl == "auto":
+        merge_impl = _merge_impl(n)
 
     def block_merge(packed_b):
         """(n, B) packed block -> (n, B) best packed over senders."""
@@ -137,7 +157,10 @@ def flood(table: EstimateTable, comm: jnp.ndarray,
                          _PACK_SENTINEL)
         return jnp.min(cand, axis=1)
 
-    if target_block is None:
+    if merge_impl == "pallas":
+        from aclswarm_tpu.ops.flood_pallas import flood_merge_pallas
+        best_packed = flood_merge_pallas(packed, comm)
+    elif target_block is None:
         best_packed = block_merge(packed)
     else:
         B = int(target_block)

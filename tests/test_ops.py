@@ -53,6 +53,50 @@ class TestSinkhornPallas:
                                           np.asarray(b.row_to_col))
 
 
+class TestRoundingPallas:
+    @pytest.mark.parametrize("n", [5, 17, 64, 130])
+    def test_bit_identical_to_xla(self, n):
+        """The gather-free VMEM rounding kernel reproduces
+        `round_dominant` exactly — same first-hit argmax tie rule, same
+        commit/strike order, bit-identical permutation."""
+        from aclswarm_tpu.ops import round_dominant_pallas
+        rng = np.random.default_rng(n)
+        plan = jnp.asarray(rng.normal(size=(n, n)).astype(np.float32) * 3)
+        ref = np.asarray(sinkhorn.round_dominant(plan))
+        out = np.asarray(round_dominant_pallas(plan,
+                                               interpret=not ON_TPU))
+        np.testing.assert_array_equal(ref, out)
+        assert sorted(out.tolist()) == list(range(n))
+
+    def test_duplicate_scores_tie_rule(self):
+        """Ties (equal plan entries) must resolve like jnp.argmax's first
+        hit in both row and column searches."""
+        from aclswarm_tpu.ops import round_dominant_pallas
+        plan = jnp.asarray(np.zeros((8, 8), np.float32))
+        ref = np.asarray(sinkhorn.round_dominant(plan))
+        out = np.asarray(round_dominant_pallas(plan,
+                                               interpret=not ON_TPU))
+        np.testing.assert_array_equal(ref, out)
+
+
+class TestFloodMergePallas:
+    @pytest.mark.parametrize("n", [7, 64, 130])
+    def test_bit_identical_to_dense(self, n):
+        """The VMEM flood-merge kernel == the dense masked min (the
+        localization scale path routes through it on TPU)."""
+        from aclswarm_tpu.ops.flood_pallas import (SENTINEL,
+                                                   flood_merge_pallas)
+        rng = np.random.default_rng(n)
+        packed = jnp.asarray(rng.integers(0, 2**30, (n, n)), jnp.int32)
+        comm = jnp.asarray(rng.random((n, n)) < 0.3)
+        ref = np.where(np.asarray(comm)[:, :, None],
+                       np.asarray(packed)[None, :, :],
+                       SENTINEL).min(axis=1)
+        out = np.asarray(flood_merge_pallas(packed, comm,
+                                            interpret=not ON_TPU))
+        np.testing.assert_array_equal(ref, out)
+
+
 @pytest.mark.f32
 class TestSinkhornPallasDevice:
     def test_compiled_matches_xla(self, f32_mode):
