@@ -210,12 +210,9 @@ def _resolve_impl(impl: str, dtype, n: int) -> str:
     tested), 'xla' everywhere else."""
     if impl != "auto":
         return impl
-    import jax
-
-    from aclswarm_tpu.ops._vmem import fits_vmem, pad128
-    N = pad128(n)
+    from aclswarm_tpu.ops._vmem import fits_vmem, square_f32_bytes
     if (jax.default_backend() == "tpu" and dtype == jnp.float32
-            and fits_vmem(3 * 4 * N * N)):
+            and fits_vmem(square_f32_bytes(n, 3))):
         return "pallas"
     return "xla"
 
@@ -277,13 +274,11 @@ def sinkhorn_assign(q: jnp.ndarray, p_aligned: jnp.ndarray,
         if impl == "pallas":
             # VMEM-resident rounding (bit-identical, ~1.3x the XLA
             # stage; with the Pallas iterations the n=1000 pipeline goes
-            # 688 -> 983 Hz end to end)
-            import jax as _jax
-
+            # 688 -> 965 Hz end to end)
             from aclswarm_tpu.ops.rounding_pallas import \
                 round_dominant_pallas
             v2f = round_dominant_pallas(
-                plan_log, interpret=_jax.default_backend() != "tpu")
+                plan_log, interpret=jax.default_backend() != "tpu")
         else:
             v2f = round_dominant(plan_log)
     elif rounding == "parallel":

@@ -68,10 +68,20 @@ def comm_mask(adjmat: jnp.ndarray, v2f: jnp.ndarray,
 
     ``self_loop=True`` adds the diagonal (CBAA's consensus max includes the
     agent's own table; the flood excludes it — own state comes from the
-    autopilot)."""
-    comm = adjmat[jnp.ix_(v2f, v2f)] > 0
+    autopilot).
+
+    Computed as the one-hot conjugation P (adj > 0) P^T instead of the
+    textbook double gather ``adjmat[ix_(v2f, v2f)]``: a (n, n) pointwise
+    gather serializes on the TPU (~11 ms at n=1000, measured — it was the
+    single largest cost of the flooded tick), while two {0,1} matmuls
+    ride the MXU (~0.1 ms) and the sums are exact in f32 up to n ~ 2^24.
+    Boolean-identical results."""
+    n = v2f.shape[0]
+    P = (v2f[:, None] == jnp.arange(n)[None, :]).astype(jnp.float32)
+    A = (adjmat > 0).astype(jnp.float32)
+    comm = jnp.matmul(jnp.matmul(P, A), P.T) > 0.5
     if self_loop:
-        comm = comm | jnp.eye(v2f.shape[0], dtype=bool)
+        comm = comm | jnp.eye(n, dtype=bool)
     return comm
 
 

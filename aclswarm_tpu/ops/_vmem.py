@@ -18,3 +18,13 @@ def pad128(n: int) -> int:
 def fits_vmem(total_bytes: int) -> bool:
     """Would a kernel holding ``total_bytes`` of VMEM-resident state fit?"""
     return total_bytes <= VMEM_BUDGET_BYTES
+
+
+def square_f32_bytes(n: int, n_buffers: int) -> int:
+    """VMEM bytes of ``n_buffers`` padded (N, N) f32/i32 matrices — the
+    footprint shape of the Sinkhorn and rounding kernels (input + output
+    + one temporary = 3). The single home: the kernels and the 'auto'
+    routing must agree, or routing sends oversized problems to a kernel
+    whose own guard then raises instead of falling back to XLA."""
+    N = pad128(n)
+    return n_buffers * 4 * N * N

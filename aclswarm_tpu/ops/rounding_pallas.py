@@ -72,10 +72,10 @@ def round_dominant_pallas(plan_log: jnp.ndarray,
     """Drop-in for `sinkhorn.round_dominant` (f32): (n, n) log plan ->
     (n,) permutation. ``interpret=True`` runs the Pallas interpreter
     (CPU test tier)."""
-    from aclswarm_tpu.ops._vmem import fits_vmem, pad128
+    from aclswarm_tpu.ops._vmem import fits_vmem, pad128, square_f32_bytes
     n = plan_log.shape[0]
     N = pad128(n)
-    if not fits_vmem(3 * 4 * N * N):
+    if not fits_vmem(square_f32_bytes(n, 3)):
         raise ValueError(
             f"n={n} (padded {N}) exceeds the VMEM-resident kernel budget; "
             "use the XLA rounding path")

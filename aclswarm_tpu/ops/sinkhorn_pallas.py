@@ -68,13 +68,13 @@ def sinkhorn_log_pallas(cost: jnp.ndarray, tau: float = 0.03,
     ``interpret=True`` runs the Pallas interpreter (CPU test tier — the
     same kernel code path, minus Mosaic compilation).
     """
-    from aclswarm_tpu.ops._vmem import fits_vmem, pad128
+    from aclswarm_tpu.ops._vmem import fits_vmem, pad128, square_f32_bytes
     n = cost.shape[0]
     N = pad128(n)
-    # VMEM budget: input + output + one (N, N) temporary, ~3 * 4B * N^2 of
-    # the ~16 MB/core VMEM. Guard here so oversized calls fail with a clear
+    # VMEM budget: input + output + one (N, N) temporary (square_f32_bytes
+    # with 3 buffers). Guard here so oversized calls fail with a clear
     # message instead of an opaque Mosaic allocation error.
-    if not fits_vmem(3 * 4 * N * N):
+    if not fits_vmem(square_f32_bytes(n, 3)):
         raise ValueError(
             f"n={n} (padded {N}) exceeds the VMEM-resident kernel's budget "
             f"(~{3 * 4 * N * N / 2**20:.0f} MB needed); use impl='xla'")
