@@ -9,9 +9,11 @@ graph neighbors' bids for the current iteration arrived
 queues/mutexes entirely and runs the *synchronous matrix form* (the same one
 the MATLAB ground truth uses, `aclswarm/matlab/CBAA/CBAA_aclswarm.m`):
 all n price/who tables live in one ``(n, n)`` array, a bid round is a masked
-max-consensus over the neighbor axis, and the whole auction is a
-``lax.scan`` over ``n * diameter`` rounds (diameter hardcoded 2, matching
-`auctioneer.cpp:50-51`).
+max-consensus over the neighbor axis, and the whole auction iterates up to
+``n * diameter`` rounds (diameter hardcoded 2, matching
+`auctioneer.cpp:50-51`), exiting early at the tables' fixed point — a
+bit-identical shortcut only the bulk-synchronous form can take (see
+`cbaa_assign`).
 
 Semantics preserved from the reference:
 - initial greedy bid on the nearest aligned formation point with price
@@ -60,6 +62,7 @@ class CBAAResult(NamedTuple):
     valid: jnp.ndarray  # () bool: consensus reached a true permutation
     price: jnp.ndarray  # (n, n) final per-agent price tables
     who: jnp.ndarray    # (n, n) final per-agent winner tables
+    rounds: jnp.ndarray  # () int32: bid rounds actually executed
 
 
 def bid_prices(q_veh: jnp.ndarray, paligned: jnp.ndarray) -> jnp.ndarray:
@@ -158,7 +161,8 @@ def cbaa_assign(q_veh: jnp.ndarray,
                 adjmat: jnp.ndarray,
                 v2f_prev: jnp.ndarray,
                 n_iters: Optional[int] = None,
-                task_block: Optional[int] = None) -> CBAAResult:
+                task_block: Optional[int] = None,
+                early_exit: bool = True) -> CBAAResult:
     """Run a full synchronous CBAA auction on device.
 
     Args:
@@ -172,6 +176,15 @@ def cbaa_assign(q_veh: jnp.ndarray,
       task_block: None = dense (n, n, n) consensus broadcast; an int B
         bounds peak memory to O(n^2 B) for large-n faithful-mode runs
         (see `_consensus_round`).
+      early_exit: stop as soon as a bid round leaves every price/who table
+        unchanged. The round map is a deterministic pure function of the
+        tables, so a fixed point persists for every remaining round — the
+        result (tables included) is bit-identical to running the full
+        ``n_iters`` budget; only the latency changes. The reference cannot
+        exit early because no vehicle sees the global tables
+        (`hasReachedConsensus` counts iterations, `auctioneer.cpp:441-444`);
+        the bulk-synchronous form holds all n tables and can. Set False to
+        reproduce the reference's fixed 2n-round latency (timing parity).
 
     Returns a `CBAAResult`; `valid` mirrors the reference's detect-and-skip
     recovery for non-permutation outcomes (`auctioneer.cpp:283-292`).
@@ -191,17 +204,37 @@ def cbaa_assign(q_veh: jnp.ndarray,
     who0 = jnp.full((n, n), -1, dtype=jnp.int32)
     price0, who0 = _select_task(myprice, price0, who0, vehids)
 
-    def round_fn(carry, _):
-        price, who = carry
-        price, who, outbid = _consensus_round(price, who, comm_mask, vehids,
+    def one_round(price, who):
+        newp, neww, outbid = _consensus_round(price, who, comm_mask, vehids,
                                               task_block=task_block)
         # outbid agents rebid on the updated table (auctioneer.cpp:224)
-        newp, neww = _select_task(myprice, price, who, vehids)
-        price = jnp.where(outbid[:, None], newp, price)
-        who = jnp.where(outbid[:, None], neww, who)
-        return (price, who), None
+        rebp, rebw = _select_task(myprice, newp, neww, vehids)
+        newp = jnp.where(outbid[:, None], rebp, newp)
+        neww = jnp.where(outbid[:, None], rebw, neww)
+        return newp, neww
 
-    (price, who), _ = lax.scan(round_fn, (price0, who0), None, length=n_iters)
+    if early_exit:
+        def cond(carry):
+            _, _, it, fixed = carry
+            return (~fixed) & (it < n_iters)
+
+        def body(carry):
+            price, who, it, _ = carry
+            newp, neww = one_round(price, who)
+            fixed = jnp.all(newp == price) & jnp.all(neww == who)
+            return newp, neww, it + 1, fixed
+
+        price, who, rounds, _ = lax.while_loop(
+            cond, body,
+            (price0, who0, jnp.asarray(0, jnp.int32), jnp.asarray(False)))
+    else:
+        def round_fn(carry, _):
+            price, who = carry
+            return one_round(price, who), None
+
+        (price, who), _ = lax.scan(round_fn, (price0, who0), None,
+                                   length=n_iters)
+        rounds = jnp.asarray(n_iters, jnp.int32)
 
     # consensus result: every agent's `who` row is its belief of P^T
     f2v = who[0].astype(jnp.int32)
@@ -209,11 +242,12 @@ def cbaa_assign(q_veh: jnp.ndarray,
     valid = agree & permutil.is_valid(f2v)
     safe_f2v = jnp.where(valid, f2v, jnp.arange(n, dtype=jnp.int32))
     v2f = permutil.invert(safe_f2v)
-    return CBAAResult(v2f=v2f, f2v=f2v, valid=valid, price=price, who=who)
+    return CBAAResult(v2f=v2f, f2v=f2v, valid=valid, price=price, who=who,
+                      rounds=rounds)
 
 
 def cbaa_from_state(q_veh, formation_points, adjmat, v2f_prev, n_iters=None,
-                    est=None, task_block=None):
+                    est=None, task_block=None, early_exit=True):
     """Convenience wrapper: local alignment + auction, the full `start()` ->
     consensus pipeline of `auctioneer.cpp:78-120` for the whole swarm.
 
@@ -225,4 +259,4 @@ def cbaa_from_state(q_veh, formation_points, adjmat, v2f_prev, n_iters=None,
     paligned = geometry.align_formation_local(
         q_veh, formation_points, adjmat, v2f_prev, est=est)
     return cbaa_assign(q_veh, paligned, adjmat, v2f_prev, n_iters=n_iters,
-                       task_block=task_block)
+                       task_block=task_block, early_exit=early_exit)

@@ -274,7 +274,7 @@ def sinkhorn_assign(q: jnp.ndarray, p_aligned: jnp.ndarray,
         if impl == "pallas":
             # VMEM-resident rounding (bit-identical, ~1.3x the XLA
             # stage; with the Pallas iterations the n=1000 pipeline goes
-            # 688 -> 965 Hz end to end)
+            # 688 -> ~990 Hz end to end — scale_tpu.json has the number)
             from aclswarm_tpu.ops.rounding_pallas import \
                 round_dominant_pallas
             v2f = round_dominant_pallas(

@@ -104,13 +104,19 @@ def _formations_for_trial(cfg: TrialConfig, seed: int
     return formlib.load_group(cfg.library, cfg.formation)
 
 
-def _gains_for(spec: FormationSpec) -> np.ndarray:
+def _gains_for(spec: FormationSpec,
+               max_nonedges: Optional[int] = None) -> np.ndarray:
     """Library gains if shipped, else the on-dispatch device ADMM solve
-    (`coordination_ros.cpp:112-119`)."""
+    (`coordination_ros.cpp:112-119`). ``max_nonedges`` pins the padded
+    constraint bucket so Monte-Carlo trials over random graphs (whose
+    non-edge count varies per seed) reuse one compiled solver — for
+    `simformN` groups the generator removes at most n-4 edges
+    (`generate_random_formation.py:61-73`), so n-4 is a static bound."""
     if spec.gains is not None:
         return np.asarray(spec.gains)
     from aclswarm_tpu import gains as gainslib
-    return np.asarray(gainslib.solve_gains(spec.points, spec.adjmat))
+    return np.asarray(gainslib.solve_gains(spec.points, spec.adjmat,
+                                           max_nonedges=max_nonedges))
 
 
 def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
@@ -212,7 +218,9 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
         if pending_dispatch is not None and not fsm.done:
             spec = specs[pending_dispatch]
             if pending_dispatch not in gains_cache:
-                gains_cache[pending_dispatch] = _gains_for(spec)
+                bucket = max(n - 4, 1) if _SIMFORM.match(cfg.formation) \
+                    else None
+                gains_cache[pending_dispatch] = _gains_for(spec, bucket)
             cur_formation = make_formation(spec.points, spec.adjmat,
                                            gains_cache[pending_dispatch])
             cur_cfg = fly_cfg

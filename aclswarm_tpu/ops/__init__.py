@@ -5,7 +5,8 @@ only where keeping state resident in VMEM across a whole iteration loop
 beats anything the compiler will do — currently the Sinkhorn assignment
 iteration (`sinkhorn_pallas`) and the dominant-pair rounding loop
 (`rounding_pallas`); together they take the n=1000 assignment pipeline
-from 688 to 965 Hz with bit-identical results.
+from 688 to ~990 Hz with bit-identical results (the committed
+`benchmarks/results/scale_tpu.json` carries the current number).
 """
 from aclswarm_tpu.ops.rounding_pallas import round_dominant_pallas
 from aclswarm_tpu.ops.sinkhorn_pallas import sinkhorn_log_pallas

@@ -77,7 +77,8 @@ def sinkhorn_log_pallas(cost: jnp.ndarray, tau: float = 0.03,
     if not fits_vmem(square_f32_bytes(n, 3)):
         raise ValueError(
             f"n={n} (padded {N}) exceeds the VMEM-resident kernel's budget "
-            f"(~{3 * 4 * N * N / 2**20:.0f} MB needed); use impl='xla'")
+            f"(~{square_f32_bytes(n, 3) / 2**20:.0f} MB needed); "
+            f"use impl='xla'")
     logK = jnp.full((N, N), NEG, jnp.float32)
     logK = logK.at[:n, :n].set((-cost / tau).astype(jnp.float32))
 

@@ -95,8 +95,7 @@ def observe_self(table: EstimateTable, q_true: jnp.ndarray) -> EstimateTable:
     (n,)-row scatter serializes on the TPU (~2 ms at n=1000, measured)
     while the diagonal select fuses into the surrounding tick."""
     n = q_true.shape[0]
-    rows = jnp.arange(n)
-    diag = rows[:, None] == rows[None, :]
+    diag = jnp.eye(n, dtype=bool)
     return EstimateTable(
         est=jnp.where(diag[:, :, None], q_true[None, :, :], table.est),
         age=jnp.where(diag, 0, table.age))

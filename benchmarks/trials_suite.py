@@ -50,6 +50,19 @@ CONFIGS = [
     # scale group: 100 agents, gains solved on dispatch (config 3)
     ("swarm100", dict(formation="swarm100", assignment="sinkhorn",
                       colavoid_neighbors=16), 5, 1),
+    # north-star scale (config 4/5 shape, closed loop): 1000 agents,
+    # random rigid graphs, Sinkhorn auctions, on-dispatch ADMM gain
+    # design, k=16 avoidance pruning. Boxes scale with n (the reference's
+    # 15 x 15 trial box fits ~60 cylinders at 2 m spacing; random
+    # sequential packing of 1000 needs ~5,700 m^2): generation 110 x 110,
+    # ground starts 100 x 100, room 200 x 200. Nothing in the reference
+    # ever flew more than 15 vehicles (`formations.yaml:251`).
+    ("simform1000",
+     dict(formation="simform1000", assignment="sinkhorn",
+          colavoid_neighbors=16, chunk_ticks=100,
+          sim_l=110.0, sim_w=110.0, sim_h=3.0,
+          init_area_w=100.0, init_area_h=100.0,
+          room_x=200.0, room_y=200.0, room_z=30.0), 3, 1),
 ]
 
 

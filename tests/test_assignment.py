@@ -334,3 +334,46 @@ class TestChunkedConsensus:
         res = cbaa.cbaa_from_state(q, p, adj, v2f, n_iters=6,
                                    task_block=32)
         assert res.who.shape == (300, 300)
+
+
+class TestCBAAEarlyExit:
+    """Fixed-point early exit must be bit-identical to the full 2n-round
+    budget: the round map is a deterministic pure function of the tables,
+    so once a round changes nothing, no later round can (the budgeted scan
+    just replays the fixed point). Only the bulk-synchronous form can see
+    this — each reference vehicle only holds its own table
+    (`auctioneer.cpp:441-444` counts iterations instead)."""
+
+    @pytest.mark.parametrize("seed,n", [(0, 6), (1, 9), (2, 14), (3, 20)])
+    def test_bit_parity_and_fewer_rounds(self, seed, n):
+        rng = np.random.default_rng(seed)
+        q = jnp.asarray(rng.normal(size=(n, 3)) * 4)
+        p = jnp.asarray(rng.normal(size=(n, 3)) * 4)
+        adj = np.zeros((n, n))
+        for i in range(n):
+            adj[i, (i + 1) % n] = adj[(i + 1) % n, i] = 1
+            adj[i, (i + 3) % n] = adj[(i + 3) % n, i] = 1
+        adj = jnp.asarray(adj)
+        v2f = jnp.asarray(rng.permutation(n), jnp.int32)
+        fast = cbaa.cbaa_from_state(q, p, adj, v2f, early_exit=True)
+        full = cbaa.cbaa_from_state(q, p, adj, v2f, early_exit=False)
+        for field in ("v2f", "f2v", "price", "who"):
+            np.testing.assert_array_equal(np.asarray(getattr(fast, field)),
+                                          np.asarray(getattr(full, field)))
+        assert bool(fast.valid) == bool(full.valid)
+        assert int(fast.rounds) < int(full.rounds) == 2 * n
+
+    def test_early_exit_with_task_block(self):
+        rng = np.random.default_rng(7)
+        n = 12
+        q = jnp.asarray(rng.normal(size=(n, 3)) * 4)
+        p = jnp.asarray(rng.normal(size=(n, 3)) * 4)
+        adj = jnp.asarray(np.ones((n, n)) - np.eye(n))
+        v2f = jnp.asarray(np.arange(n), jnp.int32)
+        fast = cbaa.cbaa_from_state(q, p, adj, v2f, early_exit=True,
+                                    task_block=5)
+        full = cbaa.cbaa_from_state(q, p, adj, v2f, early_exit=False)
+        np.testing.assert_array_equal(np.asarray(fast.price),
+                                      np.asarray(full.price))
+        np.testing.assert_array_equal(np.asarray(fast.who),
+                                      np.asarray(full.who))
