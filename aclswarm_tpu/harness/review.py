@@ -66,6 +66,7 @@ class Recording:
 
 def review(path: str, n_formations: int = 1,
            takeoff_alt: Optional[float] = None,
+           trial_timeout: Optional[float] = None,
            verbose: bool = False) -> TrialFSM:
     """Replay a recorded rollout through the trial supervisor FSM — the
     `review_bag.py` loop with the recording as the message stream. The
@@ -73,12 +74,21 @@ def review(path: str, n_formations: int = 1,
     (recordings of airborne rollouts should instead use
     `supervisor.evaluate`, the post-takeoff batch oracle). Returns the
     finished (or exhausted) FSM.
+
+    ``trial_timeout`` defaults to the recording's own ``meta_trial_timeout``
+    (stamped by the trial driver for scale configs), falling back to the
+    reference's 600 s — so a replay judges a trial against the same
+    watchdog budget it flew under.
     """
     rec = Recording(path)
     if takeoff_alt is None:
         from aclswarm_tpu.core.types import SafetyParams
         takeoff_alt = float(SafetyParams().takeoff_alt)
-    fsm = TrialFSM(rec.n, n_formations, takeoff_alt=takeoff_alt, dt=rec.dt)
+    if trial_timeout is None:
+        from aclswarm_tpu.harness.supervisor import TRIAL_TIMEOUT
+        trial_timeout = float(rec.meta.get("trial_timeout", TRIAL_TIMEOUT))
+    fsm = TrialFSM(rec.n, n_formations, takeoff_alt=takeoff_alt, dt=rec.dt,
+                   trial_timeout=trial_timeout)
     auction_ok = rec.auctioned & rec.assign_valid
     # the reference reviewer asks a human "/in_formation"; the recording
     # carries the machine signals, so events are re-derived exactly as the

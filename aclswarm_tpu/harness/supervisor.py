@@ -126,7 +126,8 @@ class _Buffer:
 FLYING, IN_FORMATION, GRIDLOCK, COMPLETE, TERMINATE = range(5)
 
 
-def run_fsm(distcmd_norm: np.ndarray, ca_active: np.ndarray, dt: float):
+def run_fsm(distcmd_norm: np.ndarray, ca_active: np.ndarray, dt: float,
+            trial_timeout: float = TRIAL_TIMEOUT):
     """Emulate the supervisor FSM over a recorded rollout (single formation).
 
     Returns (converged, convergence_time_s, entered_gridlock,
@@ -206,7 +207,7 @@ def run_fsm(distcmd_norm: np.ndarray, ca_active: np.ndarray, dt: float):
                 log_stop_t = t
                 next_state(TERMINATE, t)
                 break
-        if t * dt > TRIAL_TIMEOUT:                   # watchdog
+        if t * dt > trial_timeout:                   # watchdog
             timed_out = True
             log_stop_t = t
             next_state(TERMINATE, t)
@@ -303,11 +304,16 @@ class TrialFSM:
     """
 
     def __init__(self, n_vehicles: int, n_formations: int,
-                 takeoff_alt: float, dt: float):
+                 takeoff_alt: float, dt: float,
+                 trial_timeout: float = TRIAL_TIMEOUT):
         self.n = n_vehicles
         self.n_formations = n_formations
         self.takeoff_alt = takeoff_alt
         self.dt = dt
+        # the reference's 600 s watchdog (`supervisor.py:57`) was sized for
+        # <=15 vehicles in a 15 m box; scale configs (simform1000) pass a
+        # larger budget — a config knob, not a predicate change
+        self.trial_timeout = trial_timeout
         self.window = max(1, int(round(BUFFER_SECONDS / dt)))
 
         self.state = TrialState.IDLE
@@ -480,7 +486,7 @@ class TrialFSM:
             self._log_signals(q)
 
         # trial watchdog (`supervisor.py:229-236`)
-        if self.tick_count * self.dt > TRIAL_TIMEOUT and not self.done:
+        if self.tick_count * self.dt > self.trial_timeout and not self.done:
             self._next_state(S.TERMINATE)
 
         return action

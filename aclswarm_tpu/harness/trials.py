@@ -69,6 +69,9 @@ class TrialConfig:
     tau: float = 0.15
     control_dt: float = 0.01
     assign_every: int = 120
+    # accept-if-better-by margin for centralized auctions (see
+    # `SimConfig.assign_eps`; 0.0 = reference accept-any-different)
+    assign_eps: float = 0.0
     colavoid_neighbors: Optional[int] = None
     chunk_ticks: int = 50           # FSM action latency bound (0.5 s)
     # initial-condition sampling (trial.sh:7-9: 20 x 20 area, r=0.75)
@@ -85,6 +88,64 @@ class TrialConfig:
     sim_h: float = 2.0
     sim_min_dist: float = 2.0
     sim_formations: int = 2
+    # scale knobs (None = the reference SIL defaults). The reference's
+    # 0.5 m/s saturation (`SafetyParams.max_vel_xy`) and 600 s watchdog
+    # were sized for <=15 vehicles in a 15 m box; a 110 m 1000-agent
+    # formation at 0.5 m/s cannot physically settle inside 600 s (measured:
+    # first formation converges at 588 s), so the simform1000 config flies
+    # faster and budgets longer — config, not predicate, changes.
+    max_vel_xy: Optional[float] = None
+    max_vel_z: Optional[float] = None
+    # acceleration rate limits (`safety.cpp:30-58` params): scale with the
+    # velocity cap — VO avoidance only has the stopping distance
+    # v^2/(2a) of headroom inside the 1.5 m detection shell, so a faster
+    # fleet needs proportionally more authority
+    max_accel_xy: Optional[float] = None
+    max_accel_z: Optional[float] = None
+    trial_timeout: Optional[float] = None
+    # scale-control deadbands (`cntrl/e_xy_thr` / `cntrl/e_z_thr`,
+    # reference `coordination.launch:36-37` — launch-file tunables, not
+    # constants). The reference ships 0.3 / 0.1 m for 5 m formations; the
+    # scale term F*q_ij grows with BOTH graph degree and pair distance
+    # (`distcntrl.cpp:74-90`), so a near-complete 1000-agent 110 m
+    # formation keeps a >1 m/s noise floor on ~9% of vehicles at the
+    # reference values (measured) and the convergence predicate can never
+    # fire. simform1000 uses 1.0 / 0.3 m — still <1% of its pair scale.
+    e_xy_thr: Optional[float] = None
+    e_z_thr: Optional[float] = None
+    # velocity-damping gain (`cntrl/kd`, `coordination.launch:39`). The
+    # reference accumulates kd*(-vel) once PER NEIGHBOR
+    # (`distcntrl.cpp:93-96`, preserved in `control/distcntrl.py`), so the
+    # effective damping is deg*kd: 0.5 was tuned at deg <= 14 (<= 7 s^-1);
+    # at deg ~998 it becomes 499 s^-1 — discretely unstable at the 100 Hz
+    # tick (mm/s limit cycles whose amplified |u| never clears the 1 m/s
+    # convergence predicate) and it throttles transit to kp*|up|/499.
+    # Scale configs set kd ~= 0.5/deg to keep the reference's effective
+    # damping at reference strength.
+    kd: Optional[float] = None
+    # scale-control magnitudes (`cntrl/K1_xy` etc., `coordination.launch
+    # :32-35`). The scale force is K1*atan(K2*e)*q_ij — proportional to
+    # PAIR DISTANCE, so its deadband discontinuity grows with formation
+    # diameter: at the reference's 5 m formations the step is ~0.08 m/s,
+    # at a 110 m formation it is ~0.75 m/s and 38 vehicles relax-oscillate
+    # around the deadband edge forever (measured), blocking the 1 m/s
+    # convergence predicate. K1 ~ 1/diameter keeps the force at reference
+    # strength.
+    K1_xy: Optional[float] = None
+    K2_xy: Optional[float] = None
+    K1_z: Optional[float] = None
+    K2_z: Optional[float] = None
+    # scalar multiplier on the designed gain matrix. The gain design fixes
+    # only the matrix's *scale-free* structure (trace = -d*m,
+    # `solver.cpp:609-623`); the closed-loop stiffness it implies grows
+    # with n: at n=1000 the max row stiffness sum_j ||A_ij|| reaches ~4.9
+    # (~1.2 at the reference's n=6), which under kp=1.5 + velocity
+    # saturation + accel rate-limit lag rings in ~2 s limit cycles
+    # (measured: 18 vehicles oscillating at |u| up to 6 m/s forever).
+    # 0.15 returns the stiffness to reference range; global shape
+    # convergence rides the auction/alignment loop, not the slow modes,
+    # so trials complete *faster* (formation snaps assignments).
+    gain_scale: Optional[float] = None
     verbose: bool = True
     # per-trial rollout recordings ("bags", `harness.review`): directory
     # for trial_<k>.npz files, or None to skip
@@ -136,9 +197,18 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
         rng, n, cfg.init_area_w, cfg.init_area_h, 0.0,
         min_dist=2 * cfg.init_radius)
 
+    def _overrides(*fields):
+        """Optional scale knobs: None = keep the reference default."""
+        return {k: getattr(cfg, k) for k in fields
+                if getattr(cfg, k) is not None}
+
     sparams = SafetyParams(
         bounds_min=jnp.asarray([-cfg.room_x, -cfg.room_y, 0.0]),
-        bounds_max=jnp.asarray([cfg.room_x, cfg.room_y, cfg.room_z]))
+        bounds_max=jnp.asarray([cfg.room_x, cfg.room_y, cfg.room_z]),
+        **_overrides("max_vel_xy", "max_vel_z", "max_accel_xy",
+                     "max_accel_z"))
+    trial_timeout = (TRIAL_TIMEOUT if cfg.trial_timeout is None
+                     else cfg.trial_timeout)
 
     # fail fast on formations that planar avoidance can never reach
     # (regression guard for the stacked-column Octahedron gridlock)
@@ -150,6 +220,7 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
                      localization=cfg.localization,
                      flood_block=cfg.flood_block,
                      colavoid_neighbors=cfg.colavoid_neighbors,
+                     assign_eps=cfg.assign_eps,
                      flight_fsm=True)
     hover_cfg = sim.SimConfig(assignment="none", **engine_kw)
     fly_cfg = sim.SimConfig(assignment=cfg.assignment, **engine_kw)
@@ -162,8 +233,9 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
     state = sim.init_state(q0, flying=False,
                            localization=cfg.localization == "flooded")
     fsm = TrialFSM(n, len(specs), takeoff_alt=sparams.takeoff_alt,
-                   dt=cfg.control_dt)
-    cgains = ControlGains()
+                   dt=cfg.control_dt, trial_timeout=trial_timeout)
+    cgains = ControlGains(**_overrides(
+        "e_xy_thr", "e_z_thr", "kd", "K1_xy", "K2_xy", "K1_z", "K2_z"))
 
     cur_formation, cur_cfg = hover_formation, hover_cfg
     pending_go = False
@@ -173,7 +245,7 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
     # `formation_just_received_` semantics (`auctioneer.cpp:310-316`)
     formation_just_received = False
     chunk = cfg.chunk_ticks
-    max_ticks = int(TRIAL_TIMEOUT / cfg.control_dt) + 10 * chunk
+    max_ticks = int(trial_timeout / cfg.control_dt) + 10 * chunk
     recorded: list = []
 
     for _ in range(max_ticks // chunk + 1):
@@ -220,13 +292,24 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
             if pending_dispatch not in gains_cache:
                 bucket = max(n - 4, 1) if _SIMFORM.match(cfg.formation) \
                     else None
-                gains_cache[pending_dispatch] = _gains_for(spec, bucket)
+                g = _gains_for(spec, bucket)
+                if cfg.gain_scale is not None:
+                    g = g * cfg.gain_scale
+                gains_cache[pending_dispatch] = g
             cur_formation = make_formation(spec.points, spec.adjmat,
                                            gains_cache[pending_dispatch])
             cur_cfg = fly_cfg
             # the auctioneer resets to the identity assignment on a new
-            # formation (`auctioneer.cpp:42-62`)
-            state = state.replace(v2f=permutil.identity(n))
+            # formation (`auctioneer.cpp:42-62`), and the reference starts
+            # control only after the FIRST assignment of the formation
+            # completes (`coordination_ros.cpp:300-303`). Re-phasing the
+            # tick counter puts an auction on the first post-dispatch tick
+            # (assignment runs before the control law inside `step`), so
+            # vehicles never fly the raw identity assignment — at n=1000
+            # that 1.2 s identity bolt scrambles the cloud into a traffic
+            # jam the avoidance cannot always unwind (measured, seed 3).
+            state = state.replace(v2f=permutil.identity(n),
+                                  tick=jnp.zeros_like(state.tick))
             formation_just_received = True
             pending_dispatch = None
 
@@ -242,7 +325,8 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
         outdir.mkdir(parents=True, exist_ok=True)
         review.record(str(outdir / f"trial_{trial_idx}.npz"), stacked,
                       dt=cfg.control_dt, seed=seed,
-                      formation=cfg.formation)
+                      formation=cfg.formation,
+                      trial_timeout=trial_timeout)
     return fsm
 
 

@@ -125,6 +125,29 @@ class TestSwarm6_3dConvergence:
         assert not res.gridlocked
         assert res.invalid_auctions == 0
 
+    def test_assign_hysteresis(self, pyramid):
+        """assign_eps: near-tie reshuffles are rejected (an impossible
+        margin freezes the first assignment), clear improvements pass, and
+        eps=0 reproduces the reference accept-any-different semantics."""
+        rng = np.random.default_rng(3)
+        scramble = rng.permutation(pyramid.n).astype(np.int32)
+        q0 = pyramid.points[scramble] + [4.0, 4.0, 1.5]
+        st = sim.init_state(q0 + rng.normal(scale=0.05, size=q0.shape))
+        f = pyramid.to_device()
+        # margin nothing can beat -> assignment pinned at identity forever
+        cfg = sim.SimConfig(assignment="auction", assign_eps=0.999)
+        final, m = sim.rollout(st, f, ControlGains(), room_params(), cfg,
+                               300)
+        assert np.array_equal(np.asarray(final.v2f), np.arange(pyramid.n))
+        assert not np.any(np.asarray(m.reassigned))
+        # a 1% margin still lets the scrambled start's large improvement in
+        cfg = sim.SimConfig(assignment="auction", assign_eps=0.01)
+        final, m = sim.rollout(st, f, ControlGains(), room_params(), cfg,
+                               300)
+        assert np.any(np.asarray(m.reassigned))
+        assert not np.array_equal(np.asarray(final.v2f),
+                                  np.arange(pyramid.n))
+
 
 class TestFormationLoader:
     def test_own_library_loads(self):

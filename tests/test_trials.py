@@ -93,6 +93,47 @@ def test_config_layering(tmp_path):
         configlib.load_layers(trials.TrialConfig, overrides=["nope=1"])
 
 
+def test_scale_knobs_thread_through(monkeypatch):
+    """The simform1000 scale knobs (velocity caps, trial budget, scale
+    deadbands — all reference launch-file parameters) must reach the
+    SafetyParams / TrialFSM / ControlGains actually used by the trial."""
+    captured = {}
+    import aclswarm_tpu.sim as sim
+
+    real_rollout = sim.rollout
+
+    def spy_rollout(state, formation, cgains, sparams, cfg, n, inputs=None):
+        captured["cgains"] = cgains
+        captured["sparams"] = sparams
+        captured["formation"] = formation
+        return real_rollout(state, formation, cgains, sparams, cfg, n,
+                            inputs)
+
+    monkeypatch.setattr(sim, "rollout", spy_rollout)
+    cfg = trials.TrialConfig(formation="swarm4", trials=1, seed=1,
+                             max_vel_xy=2.0, max_vel_z=1.0,
+                             trial_timeout=30.0, e_xy_thr=1.0, e_z_thr=0.3,
+                             kd=0.001, gain_scale=0.5,
+                             verbose=False, out="/dev/null")
+    fsm = trials.run_trial(cfg, 0)
+    assert fsm.trial_timeout == 30.0
+    assert float(captured["sparams"].max_vel_xy) == 2.0
+    assert float(captured["sparams"].max_vel_z) == 1.0
+    assert float(captured["cgains"].e_xy_thr) == 1.0
+    assert float(captured["cgains"].e_z_thr) == 0.3
+    assert float(captured["cgains"].kd) == 0.001
+    # gain_scale multiplies the designed/library gains on dispatch (the
+    # captured formation is whichever the trial last flew)
+    from aclswarm_tpu.harness import formations as formlib
+    got = np.asarray(captured["formation"].gains)
+    cands = [0.5 * np.asarray(trials._gains_for(s)).reshape(
+        4, 3, 4, 3).transpose(0, 2, 1, 3)
+        for s in formlib.load_group(None, "swarm4")]
+    assert any(np.allclose(got, c, rtol=1e-6) for c in cands)
+    # 30 s budget: the 2-formation swarm4 cycle cannot finish -> TERMINATE
+    assert fsm.done
+
+
 def test_config_roundtrip_yaml(tmp_path):
     cfg = trials.TrialConfig(formation="simform6", trials=2, seed=9)
     out = tmp_path / "resolved.yaml"
