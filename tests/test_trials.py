@@ -300,6 +300,26 @@ def test_sparse_library_group_trial_runs(tmp_path):
     assert adj.sum() / 2 == 2 * 6 - 3
 
 
+def test_swarm15_group_trial_runs(tmp_path):
+    """The swarm15 group (parity with the reference's largest committed
+    group, mitacl15: 3 formations over a 33-edge sparse graph, precalc'd
+    gains) flies its full 3-formation cycle."""
+    out = tmp_path / "sw15.csv"
+    cfg = trials.TrialConfig(formation="swarm15", trials=1, seed=2,
+                             out=str(out), verbose=False)
+    stats = trials.run_trials(cfg)
+    assert stats["trials_completed"] == 1
+    assert stats["formations_per_trial"] == 3
+    from aclswarm_tpu.harness import formations as formlib
+    specs = formlib.load_group(None, "swarm15")
+    assert len(specs) == 3 and specs[0].n == 15
+    assert all(s.gains is not None for s in specs)   # precalc'd
+    adj = np.asarray(specs[0].adjmat)
+    assert adj.sum() / 2 == 33
+    for s in specs:
+        assert formgen.is_rigid_2d(s.points, s.adjmat)
+
+
 def test_swarm100_scale_group_loads_and_solves():
     """The 100-agent scale group (`mitacl100.m` analogue) ships no gains;
     the dispatch path designs them on device and they validate."""
