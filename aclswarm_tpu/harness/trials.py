@@ -66,6 +66,7 @@ class TrialConfig:
     dynamics: str = "doubleint"
     localization: str = "truth"     # truth | flooded (L3 estimate tables)
     flood_block: Optional[int] = None  # flood-merge blocking (scale knob)
+    cbaa_task_block: Optional[int] = None  # CBAA consensus blocking (scale)
     tau: float = 0.15
     control_dt: float = 0.01
     assign_every: int = 120
@@ -221,6 +222,7 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
                      flood_block=cfg.flood_block,
                      colavoid_neighbors=cfg.colavoid_neighbors,
                      assign_eps=cfg.assign_eps,
+                     cbaa_task_block=cfg.cbaa_task_block,
                      flight_fsm=True)
     hover_cfg = sim.SimConfig(assignment="none", **engine_kw)
     fly_cfg = sim.SimConfig(assignment=cfg.assignment, **engine_kw)

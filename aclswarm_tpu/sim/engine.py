@@ -90,6 +90,12 @@ class SimConfig:
     # integer B caps merge memory at O(n^2 B) — required at n ~ 1000,
     # bit-identical results; see `localization.flood`)
     flood_block: int | None = struct.field(pytree_node=False, default=None)
+    # CBAA consensus task-axis blocking (see `cbaa._consensus_round`):
+    # None = dense (n, n, n) broadcast; an integer B caps the masked
+    # consensus broadcast at O(n^2 B) — required for faithful-mode runs at
+    # n ~ 1000 (4 GB dense), bit-identical results
+    cbaa_task_block: int | None = struct.field(pytree_node=False,
+                                               default=None)
     # assignment hysteresis: accept a centralized auction/sinkhorn result
     # only if it improves the total assignment cost by this relative
     # margin. 0.0 = the reference's accept-any-different semantics
@@ -197,7 +203,8 @@ def _assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
         return _hysteresis(res.row_to_col, c), jnp.asarray(True)
     elif cfg.assignment == "cbaa":
         res = cbaa.cbaa_from_state(swarm.q, formation.points,
-                                   formation.adjmat, v2f, est=est)
+                                   formation.adjmat, v2f, est=est,
+                                   task_block=cfg.cbaa_task_block)
         new_v2f = jnp.where(res.valid, res.v2f, v2f)
         return new_v2f, res.valid
     elif cfg.assignment == "none":

@@ -53,6 +53,17 @@ CONFIGS = [
     # scale group: 100 agents, gains solved on dispatch (config 3)
     ("swarm100", dict(formation="swarm100", assignment="sinkhorn",
                       colavoid_neighbors=16), 5, 1),
+    # the fully-faithful information model at 100 agents: decentralized
+    # CBAA consensus auctions (fixed-point early exit, bit-identical) fed
+    # by flooded-localization estimate tables — reference-default control
+    # parameters throughout; only the generation boxes and the 3 m
+    # avoidance-shell spacing (docs/SCALE_TUNING.md §5) are scaled
+    ("simform100_cbaa_flooded",
+     dict(formation="simform100", assignment="cbaa",
+          localization="flooded", colavoid_neighbors=16, chunk_ticks=100,
+          sim_l=40.0, sim_w=40.0, sim_h=3.0, sim_min_dist=3.0,
+          init_area_w=40.0, init_area_h=40.0, init_radius=1.0,
+          room_x=100.0, room_y=100.0, room_z=30.0), 3, 1),
     # north-star scale (config 4/5 shape, closed loop): 1000 agents,
     # random rigid graphs, Sinkhorn auctions, on-dispatch ADMM gain
     # design, k=16 avoidance pruning. Nothing in the reference ever flew
