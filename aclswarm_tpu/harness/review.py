@@ -67,7 +67,8 @@ class Recording:
 def review(path: str, n_formations: int = 1,
            takeoff_alt: Optional[float] = None,
            trial_timeout: Optional[float] = None,
-           verbose: bool = False) -> TrialFSM:
+           verbose: bool = False,
+           in_formation_gate=None) -> TrialFSM:
     """Replay a recorded rollout through the trial supervisor FSM — the
     `review_bag.py` loop with the recording as the message stream. The
     recording must start on the ground for the takeoff phase to evaluate
@@ -76,9 +77,18 @@ def review(path: str, n_formations: int = 1,
     finished (or exhausted) FSM.
 
     ``trial_timeout`` defaults to the recording's own ``meta_trial_timeout``
-    (stamped by the trial driver for scale configs), falling back to the
+    (stamped by the trial driver on every recording), falling back to the
     reference's 600 s — so a replay judges a trial against the same
     watchdog budget it flew under.
+
+    ``in_formation_gate`` enables the reference reviewer's
+    human-in-the-loop mode (`rosservice call /in_formation`,
+    `review_bag.py:29-60`): a callable ``gate(tick, fsm) -> bool`` polled
+    every tick, returning True on the tick the human declares the
+    formation converged. The human signal then *replaces* the machine
+    convergence predicate (and aborts the trial if it fires during
+    gridlock) — see `TrialFSM.step`. The CLI's ``--interactive`` flag
+    builds a stdin gate.
     """
     rec = Recording(path)
     if takeoff_alt is None:
@@ -101,8 +111,10 @@ def review(path: str, n_formations: int = 1,
         if awaiting_first and bool(auction_ok[t]):
             event = True
             awaiting_first = False
+        gate = (None if in_formation_gate is None
+                else bool(in_formation_gate(t, fsm)))
         action = fsm.step(rec.q[t], rec.distcmd_norm[t], rec.ca_active[t],
-                          event)
+                          event, in_formation=gate)
         if action == "dispatch":
             awaiting_first = True
         if fsm.done:
@@ -111,3 +123,59 @@ def review(path: str, n_formations: int = 1,
         print(f"review: {NAMES[fsm.state]} after {t + 1}/{rec.n_ticks} "
               f"ticks; conv times {[round(x, 2) for x in fsm.times]}")
     return fsm
+
+
+def stdin_gate(dt: float, period_s: float = 1.0):
+    """Interactive `/in_formation` gate: once per ``period_s`` of replay
+    time while the FSM is in a gateable state, ask the operator whether
+    the formation has converged (the CLI analogue of watching rviz and
+    calling the service)."""
+    from aclswarm_tpu.harness.supervisor import TrialState
+    every = max(1, int(round(period_s / dt)))
+
+    def gate(t: int, fsm) -> bool:
+        if fsm.state not in (TrialState.FLYING, TrialState.GRIDLOCK):
+            return False
+        if t % every:
+            return False
+        name = NAMES[fsm.state]
+        try:
+            ans = input(f"t={t * dt:7.2f}s  state={name:9s} formation "
+                        f"{fsm.curr_formation_idx}: in formation? [y/N] ")
+        except EOFError:        # stdin exhausted: no confirmation
+            return False
+        return ans.strip().lower().startswith("y")
+
+    return gate
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Replay a recorded rollout through the trial "
+                    "supervisor FSM (the review_bag.py analogue).")
+    ap.add_argument("path", help="recording .npz written by record()")
+    ap.add_argument("--formations", type=int, default=1)
+    ap.add_argument("--trial-timeout", type=float, default=None)
+    ap.add_argument("--interactive", action="store_true",
+                    help="human-in-the-loop convergence gate "
+                         "(`rosservice call /in_formation` analogue)")
+    ap.add_argument("--gate-period", type=float, default=1.0,
+                    help="seconds of replay time between interactive "
+                         "prompts")
+    args = ap.parse_args(argv)
+    gate = None
+    if args.interactive:
+        # read only the dt scalar — Recording materializes every array,
+        # which review() is about to do anyway
+        dt = float(np.load(args.path)["dt"])
+        gate = stdin_gate(dt, args.gate_period)
+    fsm = review(args.path, n_formations=args.formations,
+                 trial_timeout=args.trial_timeout, verbose=True,
+                 in_formation_gate=gate)
+    return 0 if fsm.completed else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

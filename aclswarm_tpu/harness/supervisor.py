@@ -411,7 +411,8 @@ class TrialFSM:
     def completed(self) -> bool:
         return self.state is TrialState.COMPLETE
 
-    def step(self, q, distcmd_norm, ca_active, assign_event):
+    def step(self, q, distcmd_norm, ca_active, assign_event,
+             in_formation=None):
         """One supervisor tick (`supervisor.py:160-236`).
 
         Args are this tick's signals: q (n, 3) true positions, (n,) |distcmd|,
@@ -419,6 +420,15 @@ class TrialFSM:
         accepted this tick. Returns an action for the driver: 'takeoff'
         (send CMD_GO), 'dispatch' (commit the next formation in the group,
         index `curr_formation_idx`), or None.
+
+        ``in_formation`` switches convergence to the human-in-the-loop
+        review gate (`review_bag.py:29-60`): when not None, the human
+        signal *replaces* the machine convergence predicate — True while
+        FLYING declares the formation converged (the `/in_formation`
+        service call), True while GRIDLOCK aborts the trial
+        (`review_bag.py:168-174`), and IN_FORMATION completes immediately
+        (`review_bag.py:214-217` stops logging without a dwell). Gridlock
+        detection stays machine-derived, as in the reference reviewer.
         """
         if self.done:
             return None
@@ -462,25 +472,41 @@ class TrialFSM:
                 self._next_state(S.TERMINATE)
 
         elif self.state is S.FLYING:
-            if self._elapsed(FORMATION_RECEIVED_WAIT):
+            if in_formation is not None:
+                if in_formation:
+                    self._next_state(S.IN_FORMATION, reset=False)
+                elif self._has_gridlocked(ca_active):
+                    self._next_state(S.GRIDLOCK)
+            elif self._elapsed(FORMATION_RECEIVED_WAIT):
                 if self._has_converged(distcmd_norm):
                     self._next_state(S.IN_FORMATION, reset=False)
                 elif self._has_gridlocked(ca_active):
                     self._next_state(S.GRIDLOCK)
 
         elif self.state is S.IN_FORMATION:
-            if self._elapsed(CONVERGED_WAIT):
+            if in_formation is not None:
+                # the human already confirmed; the reviewer stops logging
+                # and moves on without a dwell (`review_bag.py:214-217`)
+                self._stop_logging()
+                self._next_state(S.HOVERING)
+            elif self._elapsed(CONVERGED_WAIT):
                 self._stop_logging()
                 self._next_state(S.HOVERING)
             elif not self._has_converged(distcmd_norm):
                 self._next_state(S.FLYING)
 
         elif self.state is S.GRIDLOCK:
-            left = (not self._has_gridlocked(ca_active)) and self._grid.full
-            if left:
-                self._next_state(S.FLYING)
-            elif self._elapsed(GRIDLOCK_TIMEOUT):
+            if in_formation is not None and in_formation:
+                # `/in_formation` during gridlock aborts the trial
+                # (`review_bag.py:168-171`)
                 self._next_state(S.TERMINATE)
+            else:
+                left = ((not self._has_gridlocked(ca_active))
+                        and self._grid.full)
+                if left:
+                    self._next_state(S.FLYING)
+                elif self._elapsed(GRIDLOCK_TIMEOUT):
+                    self._next_state(S.TERMINATE)
 
         if self.is_logging:
             self._log_signals(q)

@@ -311,7 +311,8 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
             # that 1.2 s identity bolt scrambles the cloud into a traffic
             # jam the avoidance cannot always unwind (measured, seed 3).
             state = state.replace(v2f=permutil.identity(n),
-                                  tick=jnp.zeros_like(state.tick))
+                                  tick=jnp.zeros_like(state.tick),
+                                  first_auction=jnp.asarray(True))
             formation_just_received = True
             pending_dispatch = None
 

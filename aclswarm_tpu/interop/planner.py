@@ -57,10 +57,10 @@ class PlannerOutput:
 @partial(jax.jit, static_argnames=("cfg",))
 def _tick(swarm: SwarmState, formation: DevFormation, v2f: jnp.ndarray,
           cgains: ControlGains, sparams: SafetyParams,
-          do_assign: jnp.ndarray, cfg):
+          do_assign: jnp.ndarray, first: jnp.ndarray, cfg):
     new_v2f, valid = jax.lax.cond(
         do_assign,
-        lambda s, f, p: engine._assign(s, f, p, cfg),
+        lambda s, f, p: engine.assign(s, f, p, cfg, first=first),
         lambda s, f, p: (p, jnp.asarray(True)),
         swarm, formation, v2f)
     u = control.compute(swarm, formation, new_v2f, cgains)
@@ -176,7 +176,9 @@ class TpuPlanner:
         do_assign = (self._ticks_since_commit % self.cfg.assign_every) == 0
         u, new_v2f, valid, ca = _tick(swarm, self.formation, self.v2f,
                                       self.cgains, self.sparams,
-                                      jnp.asarray(do_assign), self.cfg)
+                                      jnp.asarray(do_assign),
+                                      jnp.asarray(self._await_first_accept),
+                                      self.cfg)
         self._ticks_since_commit += 1
         accepted = do_assign and bool(valid)
         changed = accepted and (bool(jnp.any(new_v2f != self.v2f))

@@ -121,6 +121,13 @@ class SimState:
     tick: jnp.ndarray         # () int32
     flight: FlightState       # per-vehicle flight-mode FSM
     loc: EstimateTable | None = None   # localization tables ('flooded' mode)
+    # () bool: no valid auction has run since the last formation dispatch —
+    # the reference's `formation_just_received_` (`auctioneer.cpp:310-316`):
+    # the first valid auction after a commit is always accepted, so the
+    # `assign_eps` hysteresis must not veto it. Persists across invalid
+    # auctions; cleared by the first valid one.
+    first_auction: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.asarray(True))
 
 
 @struct.dataclass
@@ -154,11 +161,13 @@ def init_state(q0, v2f0=None, flying: bool = True,
         v2f=jnp.asarray(v2f0, jnp.int32),
         tick=jnp.asarray(0, jnp.int32),
         flight=vehicle.init_flight(n, q0.dtype, flying=flying),
-        loc=loclib.init_table(q0) if localization else None)
+        loc=loclib.init_table(q0) if localization else None,
+        first_auction=jnp.asarray(True))
 
 
-def _assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
-            cfg: SimConfig, est: jnp.ndarray | None = None):
+def assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
+           cfg: SimConfig, est: jnp.ndarray | None = None,
+           first: jnp.ndarray | None = None):
     """One re-assignment: returns (new v2f, valid flag).
 
     'auction' follows the centralized path (`assignment.py:94-137`): order the
@@ -172,7 +181,15 @@ def _assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
     reference operator subscribes the vehicles' true poses,
     `operator.py:221-246`); only the decentralized CBAA consumes the
     localization estimates ``est`` when the flooded model is on.
+
+    ``first`` (scalar bool) marks the first auction after a formation
+    dispatch: the reference accepts it unconditionally
+    (`formation_just_received_`, `auctioneer.cpp:310-316`), so the
+    `assign_eps` hysteresis is bypassed on that auction.
     """
+    if first is None:
+        first = jnp.asarray(False)
+
     def _hysteresis(cand, cost):
         """`shouldUseAssignment` with a cost margin (see
         `SimConfig.assign_eps`): keep the current assignment unless the
@@ -184,7 +201,7 @@ def _assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
         rows = jnp.arange(cost.shape[0])
         cost_new = jnp.sum(cost[rows, cand])
         cost_cur = jnp.sum(cost[rows, v2f])
-        take = cost_new < (1.0 - cfg.assign_eps) * cost_cur
+        take = (cost_new < (1.0 - cfg.assign_eps) * cost_cur) | first
         return jnp.where(take, cand, v2f)
 
     if cfg.assignment == "auction":
@@ -254,12 +271,14 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
     else:
         new_v2f, valid = lax.cond(
             do_assign,
-            lambda s, f, p, e: _assign(s, f, p, cfg, e),
+            lambda s, f, p, e: assign(s, f, p, cfg, e,
+                                      first=state.first_auction),
             lambda s, f, p, e: (p, jnp.asarray(True)),
             swarm, formation, v2f, est)
     reassigned = do_assign & jnp.any(new_v2f != v2f)
     auctioned = (do_assign if cfg.assignment != "none"
                  else jnp.asarray(False))
+    first_auction = state.first_auction & ~(auctioned & valid)
     v2f = new_v2f
 
     # --- distributed control law -> distcmd (§3.3) ---
@@ -308,7 +327,8 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
         raise ValueError(f"unknown dynamics model {cfg.dynamics!r}")
 
     new_state = SimState(swarm=swarm, goal=goal, v2f=v2f,
-                         tick=state.tick + 1, flight=fs, loc=loc)
+                         tick=state.tick + 1, flight=fs, loc=loc,
+                         first_auction=first_auction)
     return new_state, StepMetrics(distcmd_norm=distcmd_norm, ca_active=ca,
                                   assign_valid=valid, reassigned=reassigned,
                                   auctioned=auctioned, q=swarm.q,

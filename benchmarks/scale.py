@@ -212,7 +212,10 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
     rr = jax.jit(lambda q: cbaalib.cbaa_from_state(
         q, f.points, f.adjmat, v2f0, task_block=B))(qs_c[0])
     dt = _median_time(jax.jit(cchain), qs_c, Kc, max(2, reps - 3))
-    emit(f"cbaa_faithful_n{n}{btag}_hz", 1.0 / dt, "Hz", chain_k=Kc,
+    # keyed `_earlyexit` since round 4: the pre-round-3 `cbaa_faithful_n*`
+    # key measured the fixed 2n-round budget (now `cbaa_fullbudget_n*`);
+    # distinct keys keep cross-commit artifact comparisons like-for-like
+    emit(f"cbaa_faithful_earlyexit_n{n}{btag}_hz", 1.0 / dt, "Hz", chain_k=Kc,
          s_per_auction=round(dt, 4), rounds=int(rr.rounds),
          budget=2 * n, valid=bool(rr.valid))
 

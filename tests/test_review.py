@@ -107,3 +107,47 @@ class TestRecordReplay:
         review.record(path, m, dt=0.01)
         fsm = review.review(path, n_formations=1, takeoff_alt=1.0)
         assert not fsm.completed
+
+
+class TestInteractiveGate:
+    """Human-in-the-loop `/in_formation` mode (`review_bag.py:29-60`)."""
+
+    def test_human_confirm_replaces_machine_predicate(self, tmp_path):
+        # signals NEVER satisfy the machine convergence predicate; only
+        # the human call completes the formation
+        m = _synthetic_metrics()
+        m.distcmd_norm[:] = 2.0
+        path = str(tmp_path / "trial.npz")
+        review.record(path, m, dt=0.01)
+        assert not review.review(path, n_formations=1,
+                                 takeoff_alt=1.0).completed
+        calls = []
+
+        def gate(t, fsm):
+            calls.append(t)
+            return t >= 1500        # human calls the service at 15 s
+
+        fsm = review.review(path, n_formations=1, takeoff_alt=1.0,
+                            in_formation_gate=gate)
+        assert fsm.completed
+        assert len(fsm.times) == 1 and fsm.times[0] > 0.0
+        assert calls  # gate was polled
+
+    def test_human_call_during_gridlock_aborts(self, tmp_path):
+        m = _synthetic_metrics()
+        m.distcmd_norm[:] = 2.0
+        m.ca_active[900:, :] = True    # hard gridlock from 9 s on
+        path = str(tmp_path / "trial.npz")
+        review.record(path, m, dt=0.01)
+        from aclswarm_tpu.harness.supervisor import TrialState
+
+        def gate(t, fsm):
+            # the human calls once the FSM has entered GRIDLOCK
+            return fsm.state == TrialState.GRIDLOCK
+
+        fsm = review.review(path, n_formations=1, takeoff_alt=1.0,
+                            in_formation_gate=gate)
+        assert fsm.state == TrialState.TERMINATE
+        # the abort fires on the human call, well before the 90 s
+        # gridlock watchdog would have
+        assert fsm.tick_count * 0.01 < 30.0

@@ -126,20 +126,26 @@ class TestSwarm6_3dConvergence:
         assert res.invalid_auctions == 0
 
     def test_assign_hysteresis(self, pyramid):
-        """assign_eps: near-tie reshuffles are rejected (an impossible
-        margin freezes the first assignment), clear improvements pass, and
-        eps=0 reproduces the reference accept-any-different semantics."""
+        """assign_eps: the first post-commit auction always lands
+        (`formation_just_received_`, `auctioneer.cpp:310-316`), later
+        near-tie reshuffles are rejected by the margin, clear improvements
+        pass, and eps=0 reproduces accept-any-different semantics."""
         rng = np.random.default_rng(3)
         scramble = rng.permutation(pyramid.n).astype(np.int32)
         q0 = pyramid.points[scramble] + [4.0, 4.0, 1.5]
         st = sim.init_state(q0 + rng.normal(scale=0.05, size=q0.shape))
         f = pyramid.to_device()
-        # margin nothing can beat -> assignment pinned at identity forever
+        # margin nothing can beat: the tick-0 auction is still accepted
+        # (formation-just-received bypass), every later one is vetoed, so
+        # the assignment is frozen at the first auction's result
         cfg = sim.SimConfig(assignment="auction", assign_eps=0.999)
         final, m = sim.rollout(st, f, ControlGains(), room_params(), cfg,
                                300)
-        assert np.array_equal(np.asarray(final.v2f), np.arange(pyramid.n))
-        assert not np.any(np.asarray(m.reassigned))
+        reassigned = np.asarray(m.reassigned)
+        assert not np.any(reassigned[1:])           # frozen after tick 0
+        first_v2f = np.asarray(m.v2f)[0]
+        assert np.array_equal(np.asarray(final.v2f), first_v2f)
+        assert not bool(np.asarray(final.first_auction))
         # a 1% margin still lets the scrambled start's large improvement in
         cfg = sim.SimConfig(assignment="auction", assign_eps=0.01)
         final, m = sim.rollout(st, f, ControlGains(), room_params(), cfg,
@@ -147,6 +153,23 @@ class TestSwarm6_3dConvergence:
         assert np.any(np.asarray(m.reassigned))
         assert not np.array_equal(np.asarray(final.v2f),
                                   np.arange(pyramid.n))
+
+    def test_first_auction_bypass_cleared_only_by_valid_auction(self,
+                                                                pyramid):
+        """The bypass persists across ticks with no auction and is cleared
+        by the first valid one."""
+        rng = np.random.default_rng(5)
+        q0 = pyramid.points + rng.normal(scale=0.05, size=(pyramid.n, 3))
+        st = sim.init_state(q0 + [2.0, 0.0, 1.0])
+        f = pyramid.to_device()
+        cfg = sim.SimConfig(assignment="auction", assign_every=50)
+        # ticks 1..49 run no auction -> flag stays up
+        mid, _ = sim.rollout(st.replace(tick=st.tick + 1), f,
+                             ControlGains(), room_params(), cfg, 10)
+        assert bool(np.asarray(mid.first_auction))
+        # the tick-0 auction clears it
+        post, _ = sim.rollout(st, f, ControlGains(), room_params(), cfg, 1)
+        assert not bool(np.asarray(post.first_auction))
 
 
 class TestFormationLoader:
