@@ -8,6 +8,11 @@ Channels (one directed ring each, created by this process):
     <ns>-formation   in   Formation        (operator dispatches)
     <ns>-flightmode  in   FlightMode       (operator GO/LAND/KILL broadcast)
     <ns>-estimates   in   VehicleEstimates (state feed, one per tick)
+    <ns>-central-assignment
+                     in   Assignment       (operator-pushed centralized
+                                            permutation, comparison mode —
+                                            the `/central_assignment` topic,
+                                            `coordination_ros.cpp:46-51`)
     <ns>-distcmd     out  DistCmd          (velocity goals per tick)
     <ns>-assignment  out  Assignment       (on newly accepted assignments)
     <ns>-safety      out  SafetyStatusArray (ca-active flags per tick)
@@ -55,6 +60,7 @@ def _send_reliable(channel, msg, grace_s: float = 1.0,
 def run_bridge(n: int, ns: str = "/asw", ticks: int = 0,
                assignment: str = "auction", assign_every: int = 120,
                poll_s: float = 0.001, idle_timeout_s: float = 60.0,
+               central_assignment: bool = False,
                verbose: bool = False) -> int:
     """Serve the planner over shm channels; returns ticks served."""
     import time
@@ -63,11 +69,13 @@ def run_bridge(n: int, ns: str = "/asw", ticks: int = 0,
     from aclswarm_tpu.interop.transport import Channel
 
     planner = TpuPlanner(n, assignment=assignment,
-                         assign_every=assign_every)
+                         assign_every=assign_every,
+                         central_assignment=central_assignment)
     served = 0
     with Channel(f"{ns}-formation", create=True) as ch_form, \
             Channel(f"{ns}-flightmode", create=True) as ch_mode, \
             Channel(f"{ns}-estimates", create=True) as ch_est, \
+            Channel(f"{ns}-central-assignment", create=True) as ch_cen, \
             Channel(f"{ns}-distcmd", create=True) as ch_cmd, \
             Channel(f"{ns}-assignment", create=True) as ch_asn, \
             Channel(f"{ns}-safety", create=True) as ch_safe:
@@ -101,6 +109,18 @@ def run_bridge(n: int, ns: str = "/asw", ticks: int = 0,
                 if verbose:
                     log.info("flight mode %d (killed=%s)", fm.mode,
                              planner.killed)
+            # drain centralized-assignment pushes: only the newest matters
+            # (the reference's queue-size-1 subscription,
+            # `coordination_ros.cpp:49-51`); outside comparison mode the
+            # reference never subscribes, so frames are discarded
+            while isinstance(ca := ch_cen.recv(), m.Assignment):
+                progressed = True
+                if planner.central_assignment:
+                    ok = planner.handle_central_assignment(ca)
+                    if not ok:
+                        log.warning("rejected malformed central assignment")
+                    elif verbose:
+                        log.info("central assignment received")
             est = ch_est.recv()
             if isinstance(est, m.VehicleEstimates):
                 out = planner.tick(est)
@@ -150,11 +170,17 @@ def main(argv=None):
     ap.add_argument("--assignment", default="auction")
     ap.add_argument("--assign-every", type=int, default=120)
     ap.add_argument("--idle-timeout", type=float, default=60.0)
+    ap.add_argument("--central-assignment", action="store_true",
+                    help="comparison mode: adopt operator-pushed "
+                         "permutations from <ns>-central-assignment "
+                         "instead of auctioning "
+                         "(`/operator/central_assignment`)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     served = run_bridge(args.n, args.ns, args.ticks, args.assignment,
                         args.assign_every,
                         idle_timeout_s=args.idle_timeout,
+                        central_assignment=args.central_assignment,
                         verbose=args.verbose)
     print(f"bridge served {served} ticks", flush=True)
     return 0

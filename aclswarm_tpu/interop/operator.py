@@ -48,6 +48,7 @@ class Operator:
         self.idx = -1            # START cycles to the next formation
         self.seq = 0
         self.flying = False      # NOT_FLYING/FLYING (`operator.py:83`)
+        self._last_P: Optional[np.ndarray] = None   # `operator.py:66`
 
     @property
     def n(self) -> int:
@@ -69,6 +70,39 @@ class Operator:
         msg = self.next_formation(stamp)
         send(msg)
         return msg
+
+    # -- centralized-comparison assignment (`operator.py:221-246`) --------
+    def central_assignment(self, q, stamp: float = 0.0
+                           ) -> Optional[m.Assignment]:
+        """`sendAssignmentCb`: the base station's Hungarian on ground-truth
+        poses — order the swarm by the last assignment, align the current
+        formation to it (forced d=2, `assignment.py:55-92`), solve the
+        vehicle->point LAP (`find_optimal_assignment`,
+        `assignment.py:94-137`). Returns a wire `Assignment` for the
+        `<ns>-central-assignment` channel, or None before any formation
+        has been dispatched (`operator.py:231`: formidx == -1 guard).
+
+        In the reference this runs on its own 0.75 s timer but only takes
+        effect at each vehicle's auction cadence
+        (`operator.py:234-237` note); here the caller provides the timer
+        and the planner provides the cadence gate.
+        """
+        if self.idx < 0:
+            return None
+        from aclswarm_tpu.assignment.cbaa_ref import arun_np
+        from aclswarm_tpu.assignment.lapjv import solve_assignment_host
+        q = np.asarray(q, dtype=np.float64)
+        p = np.asarray(self.specs[self.idx].points, dtype=np.float64)
+        last = (self._last_P if self._last_P is not None
+                else np.arange(self.n))
+        qq = np.zeros_like(q)
+        qq[last] = q                   # q in formation-point order
+        R, t = arun_np(p, qq, d=2)     # align formation onto the swarm
+        P = solve_assignment_host(q, p @ R.T + t)
+        self._last_P = P
+        self.seq += 1
+        return m.Assignment(header=m.Header(seq=self.seq, stamp=stamp),
+                            perm=P.astype(np.int32))
 
     # -- flight-mode service (`operator.py:111-135` srvCB) ---------------
     def _broadcast(self, send_mode, mode: int, stamp: float) -> None:

@@ -100,8 +100,16 @@ class TpuPlanner:
                  assign_every: int = 120,
                  cgains: Optional[ControlGains] = None,
                  sparams: Optional[SafetyParams] = None,
-                 colavoid_neighbors: Optional[int] = "auto"):
+                 colavoid_neighbors: Optional[int] = "auto",
+                 central_assignment: bool = False):
         self.n = n
+        # comparison mode (`/operator/central_assignment`,
+        # `coordination_ros.cpp:46-51`): the planner runs NO auctions and
+        # instead adopts operator-pushed permutations at the auction
+        # cadence (`autoauctionCb`, `coordination_ros.cpp:330-343`)
+        self.central_assignment = central_assignment
+        self._Pcentral: Optional[np.ndarray] = None
+        self._central_rcvd = False
         if colavoid_neighbors == "auto":
             # dense VO is exact but O(n^3); above small-swarm scale prune
             # to the 16 nearest (exact whenever <= 16 vehicles are inside
@@ -131,6 +139,38 @@ class TpuPlanner:
         elif msg.mode == m.MODE_GO:
             self.killed = False
 
+    # -- centralized-comparison boundary ----------------------------------
+    def handle_central_assignment(self, msg) -> bool:
+        """Accept an operator-computed assignment (`centralAssignmentCb`,
+        `coordination_ros.cpp:272-280`): remember it, and flag it for
+        adoption if it is the first assignment since a formation commit or
+        differs from the current one. Adoption happens at the auction
+        cadence inside `tick` (`autoauctionCb`, `:330-343`) — in the
+        reference this interrupts/preempts whatever CBAA auction would
+        have run; here the whole auction is one kernel that simply never
+        launches while this mode is on.
+
+        ``msg`` is a wire `Assignment` (or a bare (n,) permutation).
+        Returns False (and changes nothing) for a malformed permutation —
+        a wire-level corruption guard the reference gets implicitly from
+        typed ROS messages.
+
+        The pending flag LATCHES across pushes exactly as
+        `central_assignment_rcvd_` does: a later unchanged push updates
+        the stored permutation but does not cancel a pending adoption —
+        whatever is newest at the cadence gets adopted.
+        """
+        perm = np.asarray(msg.perm if isinstance(msg, m.Assignment)
+                          else msg, np.int32)
+        if perm.shape != (self.n,) or not np.array_equal(
+                np.sort(perm), np.arange(self.n)):
+            return False
+        self._Pcentral = perm
+        changed = bool(np.any(perm != np.asarray(self.v2f)))
+        if self._await_first_accept or changed:
+            self._central_rcvd = True
+        return True
+
     # -- operator boundary ------------------------------------------------
     def handle_formation(self, msg: m.Formation) -> None:
         """Commit a formation dispatch (`formationCb` + the spin-loop
@@ -152,6 +192,13 @@ class TpuPlanner:
         # even if the assignment is unchanged (`auctioneer.cpp:310-316`
         # formation_just_received); persists across invalid auctions
         self._await_first_accept = True
+        # discard a central permutation computed for the superseded
+        # formation (deliberate divergence: the reference leaves
+        # `central_assignment_rcvd_` latched across commits, but its
+        # operator re-pushes every 0.75 s so nothing ever relies on
+        # adopting a stale cross-formation permutation)
+        self._Pcentral = None
+        self._central_rcvd = False
 
     # -- per-tick boundary ------------------------------------------------
     def tick(self, estimates, vel: Optional[np.ndarray] = None
@@ -174,14 +221,29 @@ class TpuPlanner:
             else jnp.asarray(vel)
         swarm = SwarmState(q=jnp.asarray(q), vel=v)
         do_assign = (self._ticks_since_commit % self.cfg.assign_every) == 0
+        adopted_central = False
+        if self.central_assignment:
+            # comparison mode: the received permutation is used "as if the
+            # auctioneer had decided it", at the auction cadence, and no
+            # CBAA/device auction ever starts (`coordination_ros.cpp
+            # :330-343`)
+            if do_assign and self._central_rcvd:
+                self.v2f = jnp.asarray(self._Pcentral)
+                self._central_rcvd = False
+                adopted_central = True
+            do_assign = False
         u, new_v2f, valid, ca = _tick(swarm, self.formation, self.v2f,
                                       self.cgains, self.sparams,
                                       jnp.asarray(do_assign),
                                       jnp.asarray(self._await_first_accept),
                                       self.cfg)
         self._ticks_since_commit += 1
-        accepted = do_assign and bool(valid)
-        changed = accepted and (bool(jnp.any(new_v2f != self.v2f))
+        # an adoption is published unconditionally (`newAssignmentCb`,
+        # `coordination_ros.cpp:284-304`); a device auction publishes on
+        # change or on the first acceptance after a commit
+        accepted = adopted_central or (do_assign and bool(valid))
+        changed = accepted and (adopted_central
+                                or bool(jnp.any(new_v2f != self.v2f))
                                 or self._await_first_accept)
         if accepted:
             self._await_first_accept = False

@@ -1,0 +1,432 @@
+"""ROS `aclswarm_msgs` adapter: the `backend=tpu` node on a live ROS graph.
+
+The north-star deployment (`BASELINE.md`): the TPU planner is dispatched
+through the reference's own `aclswarm_msgs` boundary so the existing SIL
+tooling (`aclswarm_sim/scripts/trial.sh:102`, `start.sh:148-160`) drives
+it unchanged. This module is that shim: ONE ROS node that replaces the n
+per-vehicle `coordination` C++ nodes (`coordination_ros.cpp`), speaking
+exactly their topics —
+
+    subscribe  /formation                aclswarm_msgs/Formation
+    subscribe  /globalflightmode        snapstack_msgs/QuadFlightMode
+    subscribe  /central_assignment      std_msgs/UInt8MultiArray (opt.)
+    subscribe  /<veh>/vehicle_estimates aclswarm_msgs/VehicleEstimates
+    publish    /<veh>/distcmd           geometry_msgs/Vector3Stamped
+    publish    /<veh>/assignment        std_msgs/UInt8MultiArray
+
+— and dispatching every control tick to the batched `TpuPlanner`. The
+per-vehicle `safety` and `localization` nodes (and the operator, rviz,
+supervisor) keep running untouched; only the coordination layer is
+swapped. `<veh>/cbaabid` topics disappear by design: the CBAA exchange
+the reference runs over TCPROS (`coordination_ros.cpp:392-431`) happens
+inside the device auction kernel, so the graph carries no bid traffic.
+
+`rospy` and the message classes are INJECTED (see `main` for the
+real-ROS wiring and `aclswarm_tpu.interop.ros_fakes` for the CI fakes),
+so the adapter logic is import-safe and fully testable without ROS.
+
+Fleet bring-up mapping (`trial.sh` / `start.sh`): where the reference's
+`start.sh:148-160` tmux-launches n x `start.launch` (safety +
+coordination + localization per vehicle), the TPU deployment launches
+n x {safety, localization} plus ONE `python -m
+aclswarm_tpu.interop.ros_bridge` — everything else in `trial.sh`
+(operator.launch, rosparam formation load, supervisor.py) is unchanged.
+See README "ROS interop".
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from aclswarm_tpu.interop import messages as m
+from aclswarm_tpu.utils.log import get_logger
+
+log = get_logger("interop.ros_bridge")
+
+
+# ---------------------------------------------------------------------------
+# field-for-field converters: rospy message objects <-> wire dataclasses
+# ---------------------------------------------------------------------------
+
+def _as_array(data) -> np.ndarray:
+    """rospy deserializes ``uint8[]`` fields as Python ``bytes`` (lists
+    only appear on locally constructed messages and in the fakes) — decode
+    both representations."""
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        return np.frombuffer(data, np.uint8)
+    return np.asarray(data)
+
+
+def _decode_multiarray(msg, dtype) -> np.ndarray:
+    """Decode a 2D std_msgs MultiArray exactly as the C++ nodes do
+    (`utils.h:83-126`): ``data[offset + dim[1].stride * i + j]``."""
+    dims = msg.layout.dim
+    if len(dims) != 2:
+        raise ValueError(f"expected 2 layout dims, got {len(dims)}")
+    rows, cols = int(dims[0].size), int(dims[1].size)
+    off, stride = int(msg.layout.data_offset), int(dims[1].stride)
+    data = _as_array(msg.data)
+    out = np.empty((rows, cols), dtype=dtype)
+    for i in range(rows):
+        out[i] = data[off + stride * i: off + stride * i + cols]
+    return out
+
+
+def _encode_multiarray(arr: np.ndarray, msg, msgs):
+    """Fill a MultiArray message with the operator's layout convention
+    (`operator.py:173-181`: row-major, dim0 stride = total size)."""
+    arr = np.asarray(arr)
+    msg.data = arr.flatten().tolist()
+    d0, d1 = msgs.MultiArrayDimension(), msgs.MultiArrayDimension()
+    d0.label, d0.size, d0.stride = "rows", arr.shape[0], arr.size
+    d1.label, d1.size, d1.stride = "cols", arr.shape[1], arr.shape[1]
+    msg.layout.dim = [d0, d1]
+    return msg
+
+
+def _stamp_to_sec(stamp) -> float:
+    return float(stamp.to_sec() if hasattr(stamp, "to_sec") else stamp)
+
+
+def _fill_ros_header(msg, h: m.Header, msgs) -> None:
+    """Copy a wire Header into a ros message's header (seq, stamp via
+    Time.from_sec when available, frame_id)."""
+    msg.header.seq = int(h.seq)
+    msg.header.frame_id = h.frame_id
+    stamp_cls = type(msgs.Header().stamp)
+    make = (stamp_cls.from_sec if hasattr(stamp_cls, "from_sec")
+            else stamp_cls)
+    msg.header.stamp = make(float(h.stamp))
+
+
+def formation_from_ros(msg) -> m.Formation:
+    """aclswarm_msgs/Formation -> wire (`formationCb` decode path,
+    `coordination_ros.cpp:210-232`): points from geometry_msgs/Point[],
+    adjmat/gains from the MultiArray layouts; an empty gains array means
+    "solve on commit" (`coordination_ros.cpp:112-119`)."""
+    pts = np.array([[p.x, p.y, p.z] for p in msg.points], dtype=np.float64)
+    adj = _decode_multiarray(msg.adjmat, np.uint8)
+    gains = None
+    if len(msg.gains.data):
+        gains = _decode_multiarray(msg.gains, np.float32)
+    return m.Formation(
+        header=m.Header(seq=int(msg.header.seq),
+                        stamp=_stamp_to_sec(msg.header.stamp),
+                        frame_id=msg.header.frame_id),
+        name=msg.name, points=pts, adjmat=adj, gains=gains)
+
+
+def formation_to_ros(fm: m.Formation, msgs, stamp=None):
+    """wire -> aclswarm_msgs/Formation, mirroring the operator's
+    `buildFormationMessage` layout exactly (`operator.py:159-213`)."""
+    msg = msgs.Formation()
+    _fill_ros_header(msg, fm.header, msgs)
+    msg.name = fm.name
+    msg.points = [msgs.Point(float(x), float(y), float(z))
+                  for x, y, z in np.asarray(fm.points)]
+    _encode_multiarray(np.asarray(fm.adjmat, np.uint8), msg.adjmat, msgs)
+    if fm.gains is not None:
+        _encode_multiarray(np.asarray(fm.gains, np.float32), msg.gains,
+                           msgs)
+    if stamp is not None:
+        msg.header.stamp = stamp
+    return msg
+
+
+def estimates_from_ros(msg, n: Optional[int] = None) -> m.VehicleEstimates:
+    """aclswarm_msgs/VehicleEstimates -> wire: per-entry stamped positions
+    (`VehicleEstimates.msg:10`; zeros = unknown)."""
+    k = len(msg.positions)
+    if n is not None and k != n:
+        raise ValueError(f"estimates for {k} vehicles, expected {n}")
+    pos = np.array([[e.point.x, e.point.y, e.point.z]
+                    for e in msg.positions], dtype=np.float64)
+    stamps = np.array([_stamp_to_sec(e.header.stamp)
+                       for e in msg.positions], dtype=np.float64)
+    return m.VehicleEstimates(
+        header=m.Header(seq=int(msg.header.seq),
+                        stamp=_stamp_to_sec(msg.header.stamp),
+                        frame_id=msg.header.frame_id),
+        positions=pos, stamps=stamps)
+
+
+def estimates_to_ros(est: m.VehicleEstimates, msgs):
+    """wire -> aclswarm_msgs/VehicleEstimates (`trackingCb` encode,
+    `localization_ros.cpp:132-148`)."""
+    msg = msgs.VehicleEstimates()
+    _fill_ros_header(msg, est.header, msgs)
+    stamp_cls = type(msgs.Header().stamp)
+    make_stamp = (stamp_cls.from_sec if hasattr(stamp_cls, "from_sec")
+                  else stamp_cls)     # rospy.Time.from_sec vs fake Time(s)
+    for (x, y, z), s in zip(np.asarray(est.positions), est.stamps):
+        e = msgs.PointStamped()
+        e.point = msgs.Point(float(x), float(y), float(z))
+        e.header.stamp = make_stamp(float(s))
+        msg.positions.append(e)
+    return msg
+
+
+def cbaa_from_ros(msg) -> m.CBAA:
+    """aclswarm_msgs/CBAA -> wire (`cbaabidCb`, `coordination_ros.cpp
+    :262-268`). The TPU node publishes no bids (the auction is a kernel),
+    but the converter completes the message-family mapping for replay
+    tooling and tests."""
+    return m.CBAA(
+        header=m.Header(seq=int(msg.header.seq),
+                        stamp=_stamp_to_sec(msg.header.stamp),
+                        frame_id=msg.header.frame_id),
+        auction_id=int(msg.auctionId), iter=int(msg.iter),
+        price=np.asarray(msg.price, np.float32),
+        who=np.asarray(msg.who, np.int32))
+
+
+def cbaa_to_ros(bid: m.CBAA, msgs):
+    """wire -> aclswarm_msgs/CBAA (`sendBidCb` encode,
+    `coordination_ros.cpp:308-318`)."""
+    msg = msgs.CBAA()
+    _fill_ros_header(msg, bid.header, msgs)
+    msg.auctionId = int(bid.auction_id)
+    msg.iter = int(bid.iter)
+    msg.price = [float(p) for p in bid.price]
+    msg.who = [int(w) for w in bid.who]
+    return msg
+
+
+def assignment_from_ros(msg) -> np.ndarray:
+    """std_msgs/UInt8MultiArray permutation -> (n,) int32
+    (`centralAssignmentCb`, `coordination_ros.cpp:272-280`: a bare data
+    vector, no layout)."""
+    return _as_array(msg.data).astype(np.int32)
+
+
+def assignment_to_ros(perm: np.ndarray, msgs):
+    """(n,) permutation -> std_msgs/UInt8MultiArray exactly as the
+    coordination node publishes it (`newAssignmentCb`,
+    `coordination_ros.cpp:293-297`: flat data, empty layout). n > 255
+    does not fit uint8 — the reference shares this wire limit; the shm
+    wire (`interop.codec`) is the int32-clean path at scale."""
+    perm = np.asarray(perm)
+    if perm.size and int(perm.max()) > 255:
+        raise ValueError("UInt8MultiArray assignment cannot carry indices "
+                         "> 255; use the shm wire for n > 256 swarms")
+    msg = msgs.UInt8MultiArray()
+    msg.data = [int(v) for v in perm]
+    return msg
+
+
+def distcmd_to_ros(vel: np.ndarray, msgs, stamp=None, frame_id: str = ""):
+    """One vehicle's (3,) velocity goal -> geometry_msgs/Vector3Stamped
+    (the `distcmd` topic, `coordination_ros.cpp:80,370-378`)."""
+    msg = msgs.Vector3Stamped()
+    msg.vector = msgs.Vector3(float(vel[0]), float(vel[1]), float(vel[2]))
+    if stamp is not None:
+        msg.header.stamp = stamp
+    msg.header.frame_id = frame_id
+    return msg
+
+
+def flightmode_from_ros(msg, quad_cls=None) -> m.FlightMode:
+    """snapstack_msgs/QuadFlightMode -> wire FlightMode. The operator
+    broadcasts GO / LAND / KILL (`operator.py:117-135`); other enum values
+    are passed through as GO-neutral (mode 0 is ignored by the planner)."""
+    cls = quad_cls if quad_cls is not None else type(msg)
+    mode = int(msg.mode)
+    table = {int(cls.GO): m.MODE_GO, int(cls.LAND): m.MODE_LAND,
+             int(cls.KILL): m.MODE_KILL}
+    return m.FlightMode(
+        header=m.Header(seq=int(msg.header.seq),
+                        stamp=_stamp_to_sec(msg.header.stamp)),
+        mode=table.get(mode, 0))
+
+
+# ---------------------------------------------------------------------------
+# the node
+# ---------------------------------------------------------------------------
+
+class TpuCoordinationNode:
+    """The n coordination nodes, as one planner-backed ROS node.
+
+    ``rospy``/``msgs`` are the injected ROS API and message namespace
+    (real modules in `main`, `ros_fakes.FakeRospy`/`FakeMsgs` in CI).
+    Subscription callbacks only RECORD the newest message under a lock;
+    all planner work happens in `step()` — the 100 Hz control-timer body
+    (`control_dt`, `coordination.launch:24`) — so rospy's concurrent
+    callback threads never race the device. This is the reference's own
+    split: callbacks stash `newformation_`, `spin()` commits it
+    (`coordination_ros.cpp:94-160`).
+
+    State feed: each vehicle's own localization flood
+    (`<veh>/vehicle_estimates`) carries a full n-vector; the batched
+    planner consumes one swarm state, so the node takes each vehicle's
+    self-estimate — entry v of vehicle v's vector, which is its autopilot
+    state (`localization_ros.cpp:101-110`), the same signal the
+    per-vehicle coordination node trusts for `q_[v]`.
+    """
+
+    def __init__(self, rospy, msgs, vehs: Optional[Sequence[str]] = None,
+                 planner=None, assignment: str = "auction",
+                 assign_every: int = 120,
+                 central_assignment: Optional[bool] = None):
+        self.rospy = rospy
+        self.msgs = msgs
+        vehs = list(vehs if vehs is not None
+                    else rospy.get_param("/vehs"))
+        self.vehs = vehs
+        n = len(vehs)
+        if central_assignment is None:
+            central_assignment = bool(
+                rospy.get_param("/operator/central_assignment", False))
+        if planner is None:
+            from aclswarm_tpu.interop.planner import TpuPlanner
+            planner = TpuPlanner(n, assignment=assignment,
+                                 assign_every=assign_every,
+                                 central_assignment=central_assignment)
+        self.planner = planner
+        self._lock = threading.Lock()
+        self._pending_formation = None
+        self._pending_modes: list = []
+        self._pending_central: Optional[np.ndarray] = None
+        self._q = np.zeros((n, 3))
+        self._seen = np.zeros(n, dtype=bool)
+        self.ticks = 0
+
+        rospy.Subscriber("/formation", msgs.Formation, self._formation_cb,
+                         queue_size=10)   # "don't miss a msg", `:74`
+        rospy.Subscriber("/globalflightmode", msgs.QuadFlightMode,
+                         self._mode_cb, queue_size=1)
+        if central_assignment:
+            rospy.logwarn("Expecting centralized assignment. Cheater!")
+            rospy.Subscriber("/central_assignment", msgs.UInt8MultiArray,
+                             self._central_cb, queue_size=1)
+        self._pub_cmd = []
+        self._pub_asn = []
+        for i, veh in enumerate(vehs):
+            rospy.Subscriber(f"/{veh}/vehicle_estimates",
+                             msgs.VehicleEstimates, self._estimates_cb,
+                             callback_args=i, queue_size=1)
+            self._pub_cmd.append(rospy.Publisher(
+                f"/{veh}/distcmd", msgs.Vector3Stamped, queue_size=1))
+            self._pub_asn.append(rospy.Publisher(
+                f"/{veh}/assignment", msgs.UInt8MultiArray, queue_size=1))
+
+    # -- callbacks: record only --------------------------------------------
+
+    def _formation_cb(self, msg) -> None:
+        fm = formation_from_ros(msg)
+        with self._lock:
+            self._pending_formation = fm   # newest wins, like newformation_
+
+    def _mode_cb(self, msg) -> None:
+        fm = flightmode_from_ros(msg, self.msgs.QuadFlightMode)
+        if fm.mode:
+            with self._lock:
+                self._pending_modes.append(fm)
+
+    def _central_cb(self, msg) -> None:
+        perm = assignment_from_ros(msg)
+        with self._lock:
+            self._pending_central = perm
+
+    def _estimates_cb(self, msg, vehid: int) -> None:
+        est = estimates_from_ros(msg, n=len(self.vehs))
+        with self._lock:
+            self._q[vehid] = est.positions[vehid]   # self-estimate
+            self._seen[vehid] = True
+
+    # -- the control tick --------------------------------------------------
+
+    def step(self, _event=None) -> Optional[m.Assignment]:
+        """One control tick: commit pending inputs, tick the planner,
+        publish per-vehicle distcmd (+ assignment when newly accepted).
+        Returns the published wire Assignment for observability/tests."""
+        with self._lock:
+            fm = self._pending_formation
+            self._pending_formation = None
+            modes = self._pending_modes
+            self._pending_modes = []
+            central = self._pending_central
+            self._pending_central = None
+            q = self._q.copy()
+            ready = bool(self._seen.all())
+        for mode in modes:
+            self.planner.handle_flightmode(mode)
+        if fm is not None:
+            # commit (incl. on-demand gain solve); the reference zeroes
+            # distcmd while committing (`coordination_ros.cpp:102-106`) —
+            # here the timer simply publishes nothing during the solve
+            self.planner.handle_formation(fm)
+            self.rospy.loginfo("committed formation %r", fm.name)
+        if central is not None:
+            if not self.planner.handle_central_assignment(central):
+                self.rospy.logwarn("rejected malformed central assignment")
+        if not ready:
+            return None    # not every vehicle has reported yet
+        out = self.planner.tick(q)
+        stamp = self.rospy.Time.now()
+        for v, pub in enumerate(self._pub_cmd):
+            pub.publish(distcmd_to_ros(out.distcmd[v], self.msgs,
+                                       stamp=stamp,
+                                       frame_id=self.vehs[v]))
+        self.ticks += 1
+        if out.assignment is None:
+            return None
+        asn = assignment_to_ros(out.assignment, self.msgs)
+        for pub in self._pub_asn:
+            pub.publish(asn)
+        return m.Assignment(header=m.Header(stamp=stamp.to_sec()
+                                            if hasattr(stamp, "to_sec")
+                                            else 0.0),
+                            perm=out.assignment)
+
+
+def run(rospy, msgs, control_dt: float = 0.01, **kw) -> TpuCoordinationNode:
+    """Init the node on a (real or fake) rospy, arm the control timer."""
+    rospy.init_node("coordination_tpu")
+    node = TpuCoordinationNode(rospy, msgs, **kw)
+    rospy.Timer(rospy.Duration(control_dt), node.step)
+    return node
+
+
+def main(argv=None):  # pragma: no cover - requires a live ROS graph
+    """Real-ROS entry point: `rosrun`-able once rospy + aclswarm_msgs are
+    on the PYTHONPATH (a catkin overlay). CI covers the identical code
+    path through `ros_fakes`."""
+    try:
+        import rospy
+        from aclswarm_msgs.msg import (CBAA, Formation, SafetyStatus,
+                                       VehicleEstimates)
+        from geometry_msgs.msg import (Point, PointStamped, Vector3,
+                                       Vector3Stamped)
+        from snapstack_msgs.msg import QuadFlightMode
+        from std_msgs.msg import (Float32MultiArray, Header,
+                                  MultiArrayDimension, UInt8MultiArray)
+    except ImportError as e:
+        raise SystemExit(
+            f"ros_bridge.main needs a sourced ROS workspace with "
+            f"aclswarm_msgs + snapstack_msgs: {e}")
+
+    class Msgs:
+        pass
+
+    for cls in (CBAA, Formation, SafetyStatus, VehicleEstimates, Point,
+                PointStamped, Vector3, Vector3Stamped, QuadFlightMode,
+                Float32MultiArray, Header, MultiArrayDimension,
+                UInt8MultiArray):
+        setattr(Msgs, cls.__name__, cls)
+
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--assignment", default="auction")
+    ap.add_argument("--assign-every", type=int, default=120)
+    ap.add_argument("--control-dt", type=float, default=0.01)
+    args = ap.parse_args(argv)
+    run(rospy, Msgs, control_dt=args.control_dt,
+        assignment=args.assignment, assign_every=args.assign_every)
+    rospy.spin()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -454,6 +454,121 @@ class TestPlannerFirstAcceptSemantics:
         assert out.assignment is None
 
 
+class TestCentralAssignment:
+    """Comparison-mode backdoor: an operator-computed assignment is pushed
+    into the flying planner at runtime and used as if the auctioneer had
+    decided it (`coordination_ros.cpp:272-280,330-343`,
+    `operator.py:221-246`)."""
+
+    def _planner(self, n=6, assign_every=10):
+        from aclswarm_tpu.interop import TpuPlanner
+        ang = np.linspace(0, 2 * np.pi, n, endpoint=False)
+        pts = np.stack([3 * np.cos(ang), 3 * np.sin(ang),
+                        np.full(n, 1.5)], 1)
+        adj = np.ones((n, n), np.uint8) - np.eye(n, dtype=np.uint8)
+        G = np.zeros((3 * n, 3 * n), np.float32)   # skip the ADMM solve
+        pl = TpuPlanner(n, assign_every=assign_every,
+                        central_assignment=True)
+        pl.handle_formation(m.Formation(header=m.Header(), name="ring",
+                                        points=pts, adjmat=adj, gains=G))
+        return pl, pts
+
+    def test_pushed_assignment_overrides_auction(self):
+        """A deliberately suboptimal central permutation wins over what
+        the device auction would have computed — proof no auction ran."""
+        pl, pts = self._planner()
+        n = 6
+        rng = np.random.default_rng(1)
+        q = pts[rng.permutation(n)]
+        pushed = np.roll(np.arange(n), 1).astype(np.int32)
+        assert pl.handle_central_assignment(
+            m.Assignment(header=m.Header(), perm=pushed))
+        out = pl.tick(q)
+        np.testing.assert_array_equal(out.assignment, pushed)
+        np.testing.assert_array_equal(np.asarray(pl.v2f), pushed)
+        assert out.auction_valid
+
+    def test_cadence_and_change_gating(self):
+        """Adoption happens only at the auction cadence; an unchanged push
+        after the first is ignored (`centralAssignmentCb`'s
+        first_assignment_ || changed gate)."""
+        pl, pts = self._planner(assign_every=10)
+        n = 6
+        ident = np.arange(n, dtype=np.int32)
+        pl.handle_central_assignment(ident)
+        out = pl.tick(pts)
+        # first assignment after the commit publishes even though it is
+        # the identity the planner already held
+        assert out.assignment is not None
+        pl.handle_central_assignment(ident)          # unchanged -> ignored
+        for _ in range(10):
+            out = pl.tick(pts)
+            assert out.assignment is None
+        newp = np.roll(ident, 2).astype(np.int32)
+        pl.handle_central_assignment(newp)
+        emitted = [(k, out.assignment) for k in range(10)
+                   if (out := pl.tick(pts)).assignment is not None]
+        assert len(emitted) == 1                     # once, on the cadence
+        np.testing.assert_array_equal(emitted[0][1], newp)
+
+    def test_new_formation_discards_pending_push(self):
+        """A permutation pushed for formation A is not adopted after a
+        commit of formation B (documented divergence: the reference
+        leaves the latch set but its operator re-pushes faster than the
+        cadence)."""
+        pl, pts = self._planner(assign_every=10)
+        stale = np.roll(np.arange(6), 3).astype(np.int32)
+        pl.handle_central_assignment(stale)
+        # commit a new formation before any adoption cadence elapses
+        n = 6
+        adj = np.ones((n, n), np.uint8) - np.eye(n, dtype=np.uint8)
+        pl.handle_formation(m.Formation(
+            header=m.Header(), name="ring2", points=pts * 1.5, adjmat=adj,
+            gains=np.zeros((3 * n, 3 * n), np.float32)))
+        for _ in range(12):
+            assert pl.tick(pts).assignment is None
+        np.testing.assert_array_equal(np.asarray(pl.v2f), np.arange(6))
+
+    def test_malformed_push_rejected(self):
+        pl, pts = self._planner()
+        bad_dup = np.array([0, 0, 1, 2, 3, 4], np.int32)
+        assert not pl.handle_central_assignment(bad_dup)
+        assert not pl.handle_central_assignment(
+            np.arange(5, dtype=np.int32))
+        out = pl.tick(pts)
+        assert out.assignment is None
+        np.testing.assert_array_equal(np.asarray(pl.v2f), np.arange(6))
+
+    def test_no_auction_without_push(self):
+        """Central mode with no operator push: the planner holds identity
+        forever (the reference never starts the auctioneer in this mode)."""
+        pl, pts = self._planner()
+        rng = np.random.default_rng(2)
+        q = pts[rng.permutation(6)]
+        for _ in range(25):
+            assert pl.tick(q).assignment is None
+        np.testing.assert_array_equal(np.asarray(pl.v2f), np.arange(6))
+
+    def test_operator_central_matches_lap_oracle(self):
+        from aclswarm_tpu.assignment.cbaa_ref import arun_np
+        from aclswarm_tpu.assignment.lapjv import solve_assignment_host
+        from aclswarm_tpu.interop.operator import Operator
+        op = Operator("swarm4")
+        # before any dispatch: formidx == -1 guard (`operator.py:231`)
+        assert op.central_assignment(np.zeros((4, 3))) is None
+        fmsg = op.next_formation()
+        p = np.asarray(fmsg.points, np.float64)
+        rng = np.random.default_rng(3)
+        q = p[rng.permutation(4)] + rng.normal(scale=0.05, size=(4, 3)) \
+            + [5.0, 0.0, 0.0]
+        msg = op.central_assignment(q)
+        assert sorted(msg.perm.tolist()) == list(range(4))
+        # parity with align+LAP done by hand (last=identity -> qq == q)
+        R, t = arun_np(p, q, d=2)
+        np.testing.assert_array_equal(
+            msg.perm, solve_assignment_host(q, p @ R.T + t))
+
+
 @needs_native
 class TestOversizeFrame:
     def test_never_fitting_frame_raises(self):
@@ -740,6 +855,112 @@ class TestBridgeLifecycle:
             assert bool(jnp.all(fs.mode == veh.NOT_FLYING))
 
             # shut the bridge down cleanly over the wire
+            pts = np.asarray(fmsg.points)
+            chans["formation"].send(m.Formation(
+                header=m.Header(), name="__shutdown__", points=pts,
+                adjmat=np.asarray(fmsg.adjmat)))
+        finally:
+            child.terminate()
+            try:
+                child.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                child.kill()
+                child.wait(timeout=30)
+            for ch in chans.values():
+                ch.close()
+
+
+class TestCentralAssignmentWire:
+    def test_operator_pushed_assignment_over_wire(self):
+        """Centralized-vs-decentralized comparison end-to-end over the
+        wire: a bridge in --central-assignment mode adopts the operator's
+        Hungarian permutation from the <ns>-central-assignment channel
+        instead of auctioning (`coordination_ros.cpp:330-343`), and a
+        later push interrupts the flying swarm's assignment at the next
+        cadence."""
+        import pathlib
+        import time
+
+        from aclswarm_tpu.interop.operator import Operator
+        from aclswarm_tpu.interop.transport import Channel
+
+        ns = f"/aswtest-{uuid.uuid4().hex[:8]}"
+        repo = str(pathlib.Path(__file__).resolve().parents[1])
+        n = 4
+        lf = _load_factor()
+        child = subprocess.Popen(
+            [sys.executable, "-m", "aclswarm_tpu.interop.bridge",
+             "--n", str(n), "--ns", ns, "--assign-every", "5",
+             "--central-assignment",
+             "--idle-timeout", str(180 * lf)], cwd=repo)
+        chans = {}
+        try:
+            deadline = time.time() + 60 * lf
+            for name in ("formation", "estimates", "central-assignment",
+                         "distcmd", "assignment"):
+                while True:
+                    try:
+                        chans[name] = Channel(f"{ns}-{name}")
+                        break
+                    except OSError:
+                        if time.time() > deadline:
+                            raise
+                        time.sleep(0.05)
+
+            op = Operator("swarm4")
+            fmsg = op.next_formation()
+            # zero gains: skip the on-commit ADMM solve (not under test)
+            fmsg.gains = np.zeros((3 * n, 3 * n), np.float32)
+            assert chans["formation"].send(fmsg)
+
+            rng = np.random.default_rng(11)
+            q = np.asarray(fmsg.points)[rng.permutation(n)] \
+                + rng.normal(scale=0.05, size=(n, 3))
+
+            def tick(k, q):
+                assert chans["estimates"].send(m.VehicleEstimates(
+                    header=m.Header(seq=k, stamp=k * 0.01),
+                    positions=q, stamps=np.full(n, k * 0.01)))
+                t0 = time.time()
+                while time.time() - t0 < 60 * lf:
+                    if (cmd := chans["distcmd"].recv()) is not None:
+                        return cmd
+                    time.sleep(0.0005)
+                raise AssertionError(f"no distcmd at tick {k}")
+
+            # phase 1: no push yet -> no assignment ever published
+            for k in range(6):
+                tick(k, q)
+            assert chans["assignment"].recv() is None
+
+            # phase 2: operator pushes its Hungarian -> adopted at the
+            # next cadence and published on <ns>-assignment
+            push1 = op.central_assignment(q, stamp=0.06)
+            assert chans["central-assignment"].send(push1)
+            got = None
+            for k in range(6, 20):
+                tick(k, q)
+                if (msg := chans["assignment"].recv()) is not None:
+                    got = msg
+                    break
+            assert got is not None, "central assignment never adopted"
+            np.testing.assert_array_equal(got.perm, push1.perm)
+
+            # phase 3: a *different* push mid-flight interrupts the held
+            # assignment (the runtime-injection semantics)
+            push2 = m.Assignment(header=m.Header(seq=99),
+                                 perm=np.roll(push1.perm, 1).astype(
+                                     np.int32))
+            assert chans["central-assignment"].send(push2)
+            got = None
+            for k in range(20, 40):
+                tick(k, q)
+                if (msg := chans["assignment"].recv()) is not None:
+                    got = msg
+                    break
+            assert got is not None
+            np.testing.assert_array_equal(got.perm, push2.perm)
+
             pts = np.asarray(fmsg.points)
             chans["formation"].send(m.Formation(
                 header=m.Header(), name="__shutdown__", points=pts,

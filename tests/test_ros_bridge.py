@@ -1,0 +1,279 @@
+"""ROS `aclswarm_msgs` adapter tests — fake-rospy loopback, no ROS.
+
+The done-criterion from the round-3 review: a test drives `TpuPlanner`
+through the ACTUAL `aclswarm_msgs` field layouts (points as
+geometry_msgs/Point[], adjmat/gains as 2D MultiArrays with the
+`utils.h:83-126` layout convention, estimates as PointStamped[]) over an
+in-process rospy fake, so the real-ROS deployment is a pure import swap
+(`ros_bridge.main`).
+"""
+import numpy as np
+import pytest
+
+from aclswarm_tpu.interop import messages as m
+from aclswarm_tpu.interop import ros_bridge as rb
+from aclswarm_tpu.interop.ros_fakes import FakeMsgs, FakeRospy, Time
+
+RNG = np.random.default_rng(0)
+
+
+def _wire_formation(n=4, gains="zeros", name="sq"):
+    pts = np.array([[0.0, 0, 1], [2, 0, 1], [2, 2, 1], [0, 2, 1]])[:n]
+    adj = np.ones((n, n), np.uint8) - np.eye(n, dtype=np.uint8)
+    g = None
+    if gains == "zeros":
+        g = np.zeros((3 * n, 3 * n), np.float32)
+    elif gains == "solve":
+        from aclswarm_tpu import gains as gainslib
+        g = np.asarray(gainslib.solve_gains(pts, adj), np.float32)
+    return m.Formation(header=m.Header(seq=1, stamp=0.5, frame_id="world"),
+                       name=name, points=pts, adjmat=adj, gains=g)
+
+
+class TestConverters:
+    def test_formation_roundtrip(self):
+        fm = _wire_formation(gains="zeros")
+        ros = rb.formation_to_ros(fm, FakeMsgs, stamp=Time(0.5))
+        # the ros message carries the operator's exact layout
+        assert [d.label for d in ros.adjmat.layout.dim] == ["rows", "cols"]
+        assert ros.adjmat.layout.dim[0].stride == 16
+        assert ros.adjmat.layout.dim[1].stride == 4
+        assert len(ros.points) == 4 and ros.points[1].x == 2.0
+        back = rb.formation_from_ros(ros)
+        np.testing.assert_array_equal(back.points, fm.points)
+        np.testing.assert_array_equal(back.adjmat, fm.adjmat)
+        np.testing.assert_array_equal(back.gains, fm.gains)
+        assert back.name == "sq"
+
+    def test_formation_without_gains(self):
+        fm = _wire_formation(gains=None)
+        back = rb.formation_from_ros(rb.formation_to_ros(fm, FakeMsgs))
+        assert back.gains is None   # empty array = solve on commit
+
+    def test_multiarray_layout_faithful_decode(self):
+        """Decode honors data_offset and a row stride wider than cols —
+        the C++ convention (`utils.h:83-94`), not just flat reshape."""
+        msg = FakeMsgs.UInt8MultiArray()
+        rows, cols, stride, off = 2, 3, 5, 4
+        d0, d1 = (FakeMsgs.MultiArrayDimension(),
+                  FakeMsgs.MultiArrayDimension())
+        d0.size, d0.stride = rows, rows * stride
+        d1.size, d1.stride = cols, stride
+        msg.layout.dim = [d0, d1]
+        msg.layout.data_offset = off
+        data = np.zeros(off + rows * stride, np.uint8)
+        want = np.arange(1, 7, dtype=np.uint8).reshape(2, 3)
+        for i in range(rows):
+            data[off + i * stride: off + i * stride + cols] = want[i]
+        msg.data = data.tolist()
+        np.testing.assert_array_equal(
+            rb._decode_multiarray(msg, np.uint8), want)
+
+    def test_estimates_roundtrip(self):
+        est = m.VehicleEstimates(
+            header=m.Header(seq=3, stamp=1.25),
+            positions=RNG.normal(size=(5, 3)), stamps=RNG.random(5))
+        ros = rb.estimates_to_ros(est, FakeMsgs)
+        assert len(ros.positions) == 5
+        assert ros.positions[2].header.stamp.to_sec() == \
+            pytest.approx(est.stamps[2])
+        back = rb.estimates_from_ros(ros)
+        np.testing.assert_allclose(back.positions, est.positions)
+        np.testing.assert_allclose(back.stamps, est.stamps)
+
+    def test_estimates_wrong_n_rejected(self):
+        est = m.VehicleEstimates(header=m.Header(),
+                                 positions=np.zeros((3, 3)),
+                                 stamps=np.zeros(3))
+        ros = rb.estimates_to_ros(est, FakeMsgs)
+        with pytest.raises(ValueError):
+            rb.estimates_from_ros(ros, n=4)
+
+    def test_cbaa_roundtrip(self):
+        bid = m.CBAA(header=m.Header(seq=2, stamp=0.1), auction_id=7,
+                     iter=3, price=RNG.random(6).astype(np.float32),
+                     who=RNG.integers(-1, 6, 6).astype(np.int32))
+        back = rb.cbaa_from_ros(rb.cbaa_to_ros(bid, FakeMsgs))
+        assert back.auction_id == 7 and back.iter == 3
+        np.testing.assert_allclose(back.price, bid.price, rtol=1e-6)
+        np.testing.assert_array_equal(back.who, bid.who)
+
+    def test_assignment_roundtrip_and_uint8_limit(self):
+        perm = np.array([2, 0, 3, 1], np.int32)
+        ros = rb.assignment_to_ros(perm, FakeMsgs)
+        assert ros.data == [2, 0, 3, 1]       # bare data, no layout
+        assert ros.layout.dim == []
+        np.testing.assert_array_equal(rb.assignment_from_ros(ros), perm)
+        with pytest.raises(ValueError):
+            rb.assignment_to_ros(np.arange(300), FakeMsgs)
+
+    def test_flightmode_mapping(self):
+        q = FakeMsgs.QuadFlightMode()
+        for ros_mode, wire in ((q.GO, m.MODE_GO), (q.LAND, m.MODE_LAND),
+                               (q.KILL, m.MODE_KILL)):
+            q.mode = ros_mode
+            assert rb.flightmode_from_ros(q).mode == wire
+        q.mode = q.ESTOP                      # unmapped enum: neutral
+        assert rb.flightmode_from_ros(q).mode == 0
+
+
+class _SwarmSide:
+    """The rest of the ROS graph, faked: per-vehicle localization
+    publishers feeding `<veh>/vehicle_estimates`, and first-order
+    vehicles consuming `<veh>/distcmd`."""
+
+    def __init__(self, ros, vehs, q0, dt=0.01, tau=0.15):
+        self.ros, self.vehs, self.dt, self.tau = ros, vehs, dt, tau
+        self.q = np.asarray(q0, float).copy()
+        self.vel = np.zeros_like(self.q)
+        n = len(vehs)
+        self.pub_est = [ros.Publisher(f"/{v}/vehicle_estimates",
+                                      FakeMsgs.VehicleEstimates)
+                        for v in vehs]
+        self.n = n
+        self.k = 0
+
+    def publish_estimates(self):
+        for v, pub in enumerate(self.pub_est):
+            est = m.VehicleEstimates(
+                header=m.Header(seq=self.k, stamp=self.k * self.dt),
+                positions=self.q, stamps=np.full(self.n, self.k * self.dt))
+            pub.publish(rb.estimates_to_ros(est, FakeMsgs))
+
+    def consume_distcmd(self):
+        moved = 0.0
+        for v, veh in enumerate(self.vehs):
+            pub = self.ros.pubs[f"/{veh}/distcmd"]
+            if not pub.published:
+                continue
+            cmd = pub.published[-1].vector
+            u = np.array([cmd.x, cmd.y, cmd.z])
+            self.vel[v] += (self.dt / self.tau) * (u - self.vel[v])
+            moved = max(moved, float(np.abs(u).max()))
+        self.q += self.vel * self.dt
+        self.k += 1
+        return moved
+
+
+class TestLoopback:
+    def _node(self, ros=None, **kw):
+        vehs = ["SQ01s", "SQ02s", "SQ03s", "SQ04s"]
+        ros = ros or FakeRospy(params={"/vehs": vehs})
+        node = rb.run(ros, FakeMsgs, **kw)
+        assert ros.node_name == "coordination_tpu"
+        assert len(ros.timers) == 1      # the control timer owns step()
+        return ros, node, vehs
+
+    def test_formation_to_convergence_over_ros_graph(self):
+        """The full SIL shape on a fake graph: operator publishes
+        /formation, localization publishes vehicle_estimates, the TPU
+        node publishes per-vehicle distcmd + assignment, vehicles fly to
+        convergence."""
+        ros, node, vehs = self._node(assign_every=50)
+        fm = _wire_formation(gains="solve")
+        rng = np.random.default_rng(4)
+        q0 = np.asarray(fm.points)[rng.permutation(4)] \
+            + rng.normal(scale=0.05, size=(4, 3)) + [3.0, 1.0, 0.0]
+        swarm = _SwarmSide(ros, vehs, q0)
+
+        # before any estimates: step publishes nothing (not ready)
+        assert node.step() is None
+        assert not ros.pubs["/SQ01s/distcmd"].published
+
+        # operator dispatch through the REAL message layout
+        ros.pubs.setdefault(
+            "/formation", ros.Publisher("/formation", FakeMsgs.Formation))
+        ros.pubs["/formation"].publish(
+            rb.formation_to_ros(fm, FakeMsgs, stamp=Time(0.0)))
+
+        for _ in range(1200):
+            swarm.publish_estimates()
+            node.step()
+            swarm.consume_distcmd()
+        # assignment published per vehicle as UInt8MultiArray
+        asn = ros.pubs["/SQ03s/assignment"].published
+        assert asn, "no assignment published"
+        perm = rb.assignment_from_ros(asn[0])
+        assert sorted(perm.tolist()) == list(range(4))
+        # converged: the last distcmds are small
+        last = ros.pubs["/SQ01s/distcmd"].published[-1].vector
+        u = np.linalg.norm([[last.x, last.y, last.z]])
+        assert u < 0.3, u
+        # vehicles actually sit on an aligned square (pairwise distances)
+        from scipy.spatial.distance import pdist
+        got = np.sort(pdist(swarm.q))
+        want = np.sort(pdist(np.asarray(fm.points)))
+        np.testing.assert_allclose(got, want, atol=0.25)
+
+    def test_kill_over_globalflightmode(self):
+        ros, node, vehs = self._node(assign_every=10)
+        fm = _wire_formation(gains="zeros")
+        # stretched square: range errors drive the atan scale term, so the
+        # command is nonzero even with zero linear gains
+        swarm = _SwarmSide(ros, vehs, np.asarray(fm.points) * 1.6)
+        pub_form = ros.Publisher("/formation", FakeMsgs.Formation)
+        pub_mode = ros.Publisher("/globalflightmode",
+                                 FakeMsgs.QuadFlightMode)
+        pub_form.publish(rb.formation_to_ros(fm, FakeMsgs))
+        swarm.publish_estimates()
+        node.step()
+        assert swarm.consume_distcmd() > 0.0
+        kill = FakeMsgs.QuadFlightMode()
+        kill.mode = FakeMsgs.QuadFlightMode.KILL
+        pub_mode.publish(kill)
+        swarm.publish_estimates()
+        node.step()
+        last = ros.pubs["/SQ02s/distcmd"].published[-1].vector
+        assert last.x == last.y == last.z == 0.0    # e-stop cut this tick
+
+    def test_central_assignment_param_path(self):
+        """/operator/central_assignment true: the node subscribes
+        /central_assignment and adopts the operator's pushed permutation
+        instead of auctioning (`coordination_ros.cpp:46-51,330-343`)."""
+        vehs = ["SQ01s", "SQ02s", "SQ03s", "SQ04s"]
+        ros = FakeRospy(params={"/vehs": vehs,
+                                "/operator/central_assignment": True})
+        ros, node, vehs = self._node(ros=ros, assign_every=5)
+        assert node.planner.central_assignment
+        fm = _wire_formation(gains="zeros")
+        rng = np.random.default_rng(9)
+        swarm = _SwarmSide(ros, vehs,
+                           np.asarray(fm.points)[rng.permutation(4)])
+        ros.Publisher("/formation", FakeMsgs.Formation).publish(
+            rb.formation_to_ros(fm, FakeMsgs))
+        pub_central = ros.Publisher("/central_assignment",
+                                    FakeMsgs.UInt8MultiArray)
+        # no push yet -> no auction, no assignment ever
+        for _ in range(8):
+            swarm.publish_estimates()
+            node.step()
+            swarm.consume_distcmd()
+        assert not ros.pubs["/SQ01s/assignment"].published
+        pushed = np.array([1, 2, 3, 0], np.int32)
+        pub_central.publish(rb.assignment_to_ros(pushed, FakeMsgs))
+        got = None
+        for _ in range(8):
+            swarm.publish_estimates()
+            got = node.step() or got
+            swarm.consume_distcmd()
+        assert got is not None
+        np.testing.assert_array_equal(got.perm, pushed)
+        np.testing.assert_array_equal(
+            rb.assignment_from_ros(
+                ros.pubs["/SQ04s/assignment"].published[-1]), pushed)
+
+    def test_on_commit_gain_solve_over_ros(self):
+        """A Formation with empty gains triggers the on-device ADMM solve
+        at commit (`coordination_ros.cpp:112-119`) — through the ROS
+        layout's 'empty Float32MultiArray' convention."""
+        ros, node, vehs = self._node(assign_every=10)
+        fm = _wire_formation(gains=None)
+        swarm = _SwarmSide(ros, vehs, np.asarray(fm.points) + 0.3)
+        ros.Publisher("/formation", FakeMsgs.Formation).publish(
+            rb.formation_to_ros(fm, FakeMsgs))
+        swarm.publish_estimates()
+        out = node.step()
+        assert out is not None            # first auction published
+        assert node.planner.formation is not None
+        g = np.asarray(node.planner.formation.gains)
+        assert np.any(g != 0.0)           # real solved gains
