@@ -1,10 +1,13 @@
-from aclswarm_tpu.core import geometry, perm, types
+from aclswarm_tpu.core import geometry, perm, registry, types
+from aclswarm_tpu.core.registry import (VehicleRegistry, load_registry,
+                                        make_registry)
 from aclswarm_tpu.core.types import (ControlGains, Formation, SafetyParams,
                                      SwarmState, gains_from_flat,
                                      gains_to_flat, make_formation)
 
 __all__ = [
-    "geometry", "perm", "types",
+    "geometry", "perm", "registry", "types",
     "SwarmState", "Formation", "ControlGains", "SafetyParams",
     "make_formation", "gains_to_flat", "gains_from_flat",
+    "VehicleRegistry", "make_registry", "load_registry",
 ]

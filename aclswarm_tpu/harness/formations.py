@@ -9,7 +9,9 @@ the operator's exact semantics (`aclswarm/nodes/operator.py:88-109,155-157`):
 - if the group supplies any ``adjmat`` key it overrides every formation's own
   (`operator.py:95-103`) — note ``adjmat: fc`` is a *string*, so a group-level
   ``fc`` forces every formation fully connected even when per-formation
-  matrices exist (this is how the shipped swarm6_3d demo actually flies);
+  matrices exist (the reference's shipped swarm6_3d yaml has this quirk; this
+  framework's library omits the group key there so the sparse per-formation
+  graphs — the config its committed gains were designed for — actually fly);
 - anything that is not a list at that point becomes fully connected
   (`operator.py:105-109`);
 - ``scale`` multiplies the points only — never the gains (`operator.py:155-157`).

@@ -50,6 +50,52 @@ def _adj(arr) -> list:
 def build_groups() -> dict:
     groups = {}
 
+    # --- swarm6_3d: the reference's flagship demo group, like-for-like ---
+    # Geometry AND per-formation sparse adjmats reproduced from
+    # `/root/reference/aclswarm/param/formations.yaml:141-250` (category-b
+    # data reuse, declared in the library header); gains are designed by
+    # this framework's own ADMM solver (precalc) — they land on the same
+    # spectral gap as the reference's committed gains (0.2653 / 0.7302).
+    # NOTE the reference yaml also carries a group-level `adjmat: fc`,
+    # which its operator's manageAdjmat would let OVERRIDE the sparse
+    # per-formation graphs (`operator.py:88-109`: any group key wins).
+    # The sparse graphs are clearly the intended demo config — the
+    # reference's committed gains have zero blocks exactly on the sparse
+    # non-edges — so this library ships NO group-level key and flies the
+    # sparse (harder) graphs.
+    pyramid = np.array([[0.000, 0.0000, 1.7], [2.000, 0.0000, 0.0],
+                        [0.618, 1.9021, 0.0], [-1.618, 1.1756, 0.0],
+                        [-1.618, -1.1756, 0.0], [0.618, -1.9021, 0.0]])
+    adj_pyramid = np.array([[0, 0, 1, 1, 0, 1], [0, 0, 1, 0, 0, 1],
+                            [1, 1, 0, 1, 0, 0], [1, 0, 1, 0, 1, 0],
+                            [0, 0, 0, 1, 0, 1], [1, 1, 0, 0, 1, 0]])
+    prism_ref = np.array([[0.0, 0.0, 0.0], [2.0, 0.0, 1.0],
+                          [4.0, 0.0, 0.0], [0.0, 2.0, 0.0],
+                          [2.0, 2.0, 1.0], [4.0, 2.0, 0.0]])
+    slanted = np.array([[0.0, 0.0, 0.0], [2.0, 0.0, 0.5],
+                        [4.0, 0.0, 1.0], [0.0, 2.0, 0.0],
+                        [2.0, 2.0, 0.5], [4.0, 2.0, 1.0]])
+    adj_prism = np.array([[0, 1, 1, 1, 0, 0], [1, 0, 1, 0, 1, 0],
+                          [1, 1, 0, 0, 0, 1], [1, 0, 0, 0, 1, 1],
+                          [0, 1, 0, 1, 0, 1], [0, 0, 1, 1, 1, 0]])
+    for f, a in ((pyramid, adj_pyramid), (prism_ref, adj_prism),
+                 (slanted, adj_prism)):
+        assert formlib.min_planar_separation(f) > 1.2
+        # NB: the pyramid graph has 8 edges (< 2n-3), so it is not
+        # 2D-rigid — rigidity is not the gate here; the precalc gain
+        # eigenstructure validation is, and all three pass it.
+    groups["swarm6_3d"] = {
+        "agents": 6,
+        "formations": [
+            {"name": "Pentagonal Pyramid", "scale": 1.0,
+             "points": _pts(pyramid), "adjmat": _adj(adj_pyramid)},
+            {"name": "Triangular Prism", "scale": 1.0,
+             "points": _pts(prism_ref), "adjmat": _adj(adj_prism)},
+            {"name": "Slanted Plane", "scale": 1.0,
+             "points": _pts(slanted), "adjmat": _adj(adj_prism)},
+        ],
+    }
+
     # --- swarm6_sparse ---
     ang = np.linspace(0, 2 * np.pi, 6, endpoint=False)
     hexagon = np.stack([2.5 * np.cos(ang), 2.5 * np.sin(ang),

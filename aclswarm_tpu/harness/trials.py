@@ -66,6 +66,7 @@ class TrialConfig:
     dynamics: str = "doubleint"
     localization: str = "truth"     # truth | flooded (L3 estimate tables)
     flood_block: Optional[int] = None  # flood-merge blocking (scale knob)
+    flood_phases: int = 1           # phased flood stripes (scale knob)
     cbaa_task_block: Optional[int] = None  # CBAA consensus blocking (scale)
     tau: float = 0.15
     control_dt: float = 0.01
@@ -220,6 +221,7 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
                      dynamics=cfg.dynamics, tau=cfg.tau,
                      localization=cfg.localization,
                      flood_block=cfg.flood_block,
+                     flood_phases=cfg.flood_phases,
                      colavoid_neighbors=cfg.colavoid_neighbors,
                      assign_eps=cfg.assign_eps,
                      cbaa_task_block=cfg.cbaa_task_block,

@@ -271,10 +271,11 @@ class TpuCoordinationNode:
                  central_assignment: Optional[bool] = None):
         self.rospy = rospy
         self.msgs = msgs
-        vehs = list(vehs if vehs is not None
-                    else rospy.get_param("/vehs"))
-        self.vehs = vehs
-        n = len(vehs)
+        from aclswarm_tpu.core.registry import make_registry
+        self.registry = make_registry(
+            vehs if vehs is not None else rospy.get_param("/vehs"))
+        vehs = self.vehs = list(self.registry.names)
+        n = self.registry.n
         if central_assignment is None:
             central_assignment = bool(
                 rospy.get_param("/operator/central_assignment", False))

@@ -41,27 +41,30 @@ def _kernel(comm_ref, packed_ref, out_ref, *, n_chunks: int):
     out_ref[:] = jax.lax.fori_loop(0, n_chunks, body, acc)
 
 
-def flood_merge_bytes(n: int) -> int:
+def flood_merge_bytes(n: int, w: int | None = None) -> int:
     """VMEM-resident bytes of one grid step: the shared packed matrix,
-    the (TV, WC, N) candidate temporary, and the comm/out row tiles."""
+    the (TV, WC, W) candidate temporary, and the comm/out row tiles.
+    ``w`` is the target-stripe width (defaults to n — the full table)."""
     from aclswarm_tpu.ops._vmem import pad128
     N = pad128(n)
-    return 4 * N * N + 4 * _TV * _WC * N + 2 * 4 * _TV * N
+    W = pad128(n if w is None else w)
+    return 4 * N * W + 4 * _TV * _WC * W + 4 * _TV * N + 4 * _TV * W
 
 
 def flood_merge_pallas(packed: jnp.ndarray, comm: jnp.ndarray,
                        interpret: bool = False) -> jnp.ndarray:
-    """(n, n) packed ages + (n, n) comm mask -> (n, n) best packed per
-    (receiver, target); rows with no neighbors return SENTINEL."""
+    """(n, w) packed ages (senders x targets; w = n or a stripe) +
+    (n, n) comm mask -> (n, w) best packed per (receiver, target); rows
+    with no neighbors return SENTINEL."""
     from aclswarm_tpu.ops._vmem import fits_vmem, pad128
-    n = packed.shape[0]
-    N = pad128(n)
-    if not fits_vmem(flood_merge_bytes(n)):
+    n, w = packed.shape
+    N, W = pad128(n), pad128(w)
+    if not fits_vmem(flood_merge_bytes(n, w)):
         raise ValueError(
-            f"n={n} (padded {N}) exceeds the VMEM-resident flood-merge "
-            "budget; use the blocked XLA path (target_block)")
-    packed_p = jnp.full((N, N), SENTINEL, jnp.int32)
-    packed_p = packed_p.at[:n, :n].set(packed.astype(jnp.int32))
+            f"n={n} (padded {N}) x {w} exceeds the VMEM-resident "
+            "flood-merge budget; use the blocked XLA path (target_block)")
+    packed_p = jnp.full((N, W), SENTINEL, jnp.int32)
+    packed_p = packed_p.at[:n, :w].set(packed.astype(jnp.int32))
     comm_p = jnp.zeros((N, N), jnp.float32)
     comm_p = comm_p.at[:n, :n].set(comm.astype(jnp.float32))
 
@@ -71,12 +74,12 @@ def flood_merge_pallas(packed: jnp.ndarray, comm: jnp.ndarray,
         in_specs=[
             pl.BlockSpec((_TV, N), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),      # comm row tile
-            pl.BlockSpec((N, N), lambda i: (0, 0),
+            pl.BlockSpec((N, W), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),      # packed (shared)
         ],
-        out_specs=pl.BlockSpec((_TV, N), lambda i: (i, 0),
+        out_specs=pl.BlockSpec((_TV, W), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((N, N), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((N, W), jnp.int32),
         interpret=interpret,
     )(comm_p, packed_p)
-    return out[:n, :n]
+    return out[:n, :w]

@@ -90,6 +90,12 @@ class SimConfig:
     # integer B caps merge memory at O(n^2 B) — required at n ~ 1000,
     # bit-identical results; see `localization.flood`)
     flood_block: int | None = struct.field(pytree_node=False, default=None)
+    # phased flood: split the merge's target axis into this many stripes,
+    # one stripe per tick across the flood_every window (each target
+    # still refreshes at the 50 Hz cadence; spreads the O(n^3) merge so
+    # no single tick spikes — see `localization.tick_phased`). 1 = the
+    # bulk-synchronous all-targets flood. Must divide flood_every.
+    flood_phases: int = struct.field(pytree_node=False, default=1)
     # CBAA consensus task-axis blocking (see `cbaa._consensus_round`):
     # None = dense (n, n, n) broadcast; an integer B caps the masked
     # consensus broadcast at O(n^2 B) — required for faithful-mode runs at
@@ -250,9 +256,15 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
         if loc is None:
             raise ValueError("cfg.localization='flooded' needs "
                              "init_state(..., localization=True)")
-        loc = loclib.tick(loc, swarm.q, formation.adjmat, v2f,
-                          (state.tick % cfg.flood_every) == 0,
-                          target_block=cfg.flood_block)
+        if cfg.flood_phases == 1:
+            loc = loclib.tick(loc, swarm.q, formation.adjmat, v2f,
+                              (state.tick % cfg.flood_every) == 0,
+                              target_block=cfg.flood_block)
+        else:
+            loc = loclib.tick_phased(loc, swarm.q, formation.adjmat, v2f,
+                                     state.tick, cfg.flood_every,
+                                     cfg.flood_phases,
+                                     target_block=cfg.flood_block)
         est = loc.est
     elif cfg.localization == "truth":
         est = None

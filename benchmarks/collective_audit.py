@@ -159,11 +159,137 @@ def main(argv=None):
         q_put, label=f"sinkhorn_assign_n{n}_dev{ndev}_staged",
         in_shardings=(row_sh,), out_shardings=rep))
 
-    out = {"n": n, "devices": ndev, "entries": rows}
+    # --- crossover cost model (round-3 weak #1) ------------------------
+    # This box gives the virtual mesh ONE physical core
+    # (os.cpu_count()=1), so a wall-clock sharded-vs-single crossover is
+    # unobservable here BY CONSTRUCTION: 8 "devices" timeshare the same
+    # silicon and collectives only add work. The crossover evidence is
+    # therefore a cost model built from measurable quantities:
+    #   * per-device compute from XLA's cost analysis of the ACTUAL
+    #     compiled sharded vs unsharded programs (GSPMD partitions by
+    #     annotations, identically on CPU and TPU);
+    #   * collective payloads from the HLO inventory above;
+    #   * the real chip's measured achieved FLOP/s for the same kernel
+    #     (scale_tpu.json roofline fields) and public v5e ICI bandwidth.
+    model = cost_model(mesh, n_list=(512, 1024, 2048, 4096))
+    out = {"n": n, "devices": ndev, "entries": rows,
+           "crossover_model": model}
     Path(args.out).parent.mkdir(parents=True, exist_ok=True)
     Path(args.out).write_text(json.dumps(out, indent=1))
     print(f"wrote {args.out}")
     return 0
+
+
+# v5e ICI: 4 links/chip, ~50 GB/s/direction each (public "How to Scale
+# Your Model" numbers give ~4.5e10 B/s/link one-way); a ring all-gather
+# of V bytes over D devices costs ~ V * (D-1)/D / W_link.
+ICI_LINK_BPS = 4.5e10
+
+
+def _flops_bytes(jfn, *args) -> tuple:
+    comp = jfn.lower(*args).compile()
+    ca = comp.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return float(ca.get("flops", 0.0)), float(ca.get("bytes accessed", 0.0))
+
+
+def cost_model(mesh, n_list=(1000, 2000, 4000, 8000)) -> dict:
+    """Sharded-vs-single crossover model from compiled-program statistics.
+
+    For each n: compile the engine control tick unsharded and sharded
+    over the mesh, read XLA's flops estimate for both (the sharded
+    number is PER DEVICE under SPMD), inventory the sharded program's
+    collective bytes, and predict single-chip vs D-chip time using the
+    real chip's measured achieved FLOP/s at n=1000 (compute term; both
+    programs share it — same kernels, same dtype) plus a ring-collective
+    term at v5e ICI bandwidth. Reports the modeled speedup and the n at
+    which sharded beats single (the crossover the 1-core CI box cannot
+    show on a clock).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from aclswarm_tpu import sim
+    from aclswarm_tpu.core.types import (ControlGains, SafetyParams,
+                                         make_formation)
+    from aclswarm_tpu.parallel import mesh as meshlib
+
+    ndev = len(mesh.devices.ravel())
+    rng = np.random.default_rng(1)
+    # achieved f32 FLOP/s of this very kernel on the real chip: from the
+    # committed scale_tpu.json roofline (control_tick achieved_gflops_s);
+    # fallback to a conservative 2 TFLOP/s if the artifact predates the
+    # roofline fields
+    achieved = 2e12
+    art = RESULTS / "scale_tpu.json"
+    if art.exists():
+        for line in art.read_text().splitlines():
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if row.get("metric", "").startswith("control_tick_n1000") and \
+                    row.get("achieved_gflops_s"):
+                achieved = row["achieved_gflops_s"] * 1e9
+    rows = []
+    for n in n_list:
+        pts = rng.normal(size=(n, 3)).astype(np.float32) * 20
+        adj = (np.ones((n, n)) - np.eye(n)).astype(np.float32)
+        gains = (rng.normal(size=(n, n, 3, 3)) * 0.01).astype(np.float32)
+        f = make_formation(jnp.asarray(pts), jnp.asarray(adj),
+                           jnp.asarray(gains))
+        sp = SafetyParams(bounds_min=jnp.asarray([-100.0, -100.0, 0.0]),
+                          bounds_max=jnp.asarray([100.0, 100.0, 20.0]))
+        st = sim.init_state(
+            rng.normal(size=(n, 3)).astype(np.float32) * 20 + [0, 0, 2])
+        cfg = sim.SimConfig(assignment="none", colavoid_neighbors=16)
+
+        def tick(s, ff):
+            return sim.step(s, ff, ControlGains(), sp, cfg)[0]
+
+        single_flops, _ = _flops_bytes(jax.jit(tick), st, f)
+
+        st_put, f_put, st_sh, f_sh = meshlib.shard_problem(st, f, mesh)
+        jsh = jax.jit(tick, in_shardings=(st_sh, f_sh),
+                      out_shardings=st_sh)
+        comp = jsh.lower(st_put, f_put).compile()   # one 8-way compile
+        ca = comp.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        dev_flops = float(ca.get("flops", 0.0))
+        hlo = comp.as_text()
+        cbytes = sum(_op_bytes(ls) for ls in hlo.splitlines()
+                     if any(re.search(rf"=\s*\S+\s+{c}(-start)?\(", ls)
+                            for c in COLLECTIVES))
+        t_single = single_flops / achieved
+        t_shard = dev_flops / achieved \
+            + cbytes * (ndev - 1) / ndev / ICI_LINK_BPS
+        rows.append({
+            "n": n,
+            "single_flops": single_flops,
+            "per_device_flops": dev_flops,
+            "compute_partition_ratio": round(single_flops
+                                             / max(dev_flops, 1.0), 2),
+            "collective_bytes": cbytes,
+            "modeled_t_single_us": round(t_single * 1e6, 1),
+            "modeled_t_sharded_us": round(t_shard * 1e6, 1),
+            "modeled_speedup": round(t_single / t_shard, 2),
+        })
+        ratio = rows[-1]["compute_partition_ratio"]
+        print(f"cost_model n={n}: partition {ratio}x/dev, collectives "
+              f"{cbytes / 1e6:.2f} MB, modeled speedup "
+              f"{rows[-1]['modeled_speedup']}x")
+    cross = next((r["n"] for r in rows if r["modeled_speedup"] > 1.0),
+                 None)
+    return {"devices": ndev, "achieved_flops_s": achieved,
+            "ici_link_Bps": ICI_LINK_BPS, "rows": rows,
+            "modeled_crossover_n": cross,
+            "note": "wall-clock crossover unobservable on this CI box: "
+                    "the 8-device mesh shares 1 physical core "
+                    "(os.cpu_count()=1); model built from compiled "
+                    "per-device flops + HLO collective bytes + "
+                    "real-chip achieved FLOP/s"}
 
 
 if __name__ == "__main__":

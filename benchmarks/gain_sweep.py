@@ -81,6 +81,14 @@ def sweep(quick: bool = False, full: bool = False, out: str | None = None):
                   "nonedges": nonedges, "chain_k": K})
 
     # --- quality sweep vs the independent SDP oracle ---
+    # THREE solvers through one metric: the device ADMM, the SDP oracle,
+    # and the faithful NumPy re-derivation of the reference's own ADMM
+    # (`gains/reference.py`, `solver.cpp` semantics). The third column
+    # dispositions the device's 0.79-0.88 gap ratio (round-3 weak #5):
+    # if the reference algorithm lands in the same band, the gap is
+    # inherent to ADMM-with-early-stopping vs a converged SDP, not a
+    # device regression.
+    from aclswarm_tpu.gains import reference as refadmm
     qsizes = [8, 12] if quick else [8, 12, 16, 20]
     iters = 400 if quick else 1200
     for n in qsizes:
@@ -92,12 +100,19 @@ def sweep(quick: bool = False, full: bool = False, out: str | None = None):
         A_sdp = sdp.solve_sdp_gains(pts, adj, iters=iters)
         t_sdp = time.perf_counter() - t0
         A_admm = np.asarray(gl.solve_gains(jnp.asarray(pts), adj))
+        A_ref = refadmm.solve_gains(pts, adj)
         gap_sdp = sdp.spectral_gap(A_sdp, nullity)
         gap_admm = sdp.spectral_gap(A_admm, nullity)
+        gap_ref = sdp.spectral_gap(A_ref, nullity)
         emit({"metric": f"gain_quality_n{n}_ratio",
               "value": round(gap_admm / max(gap_sdp, 1e-12), 4),
               "unit": "ratio", "n": n,
               "gap_admm": round(gap_admm, 5), "gap_sdp": round(gap_sdp, 5),
+              "gap_reference_admm": round(gap_ref, 5),
+              "reference_admm_ratio": round(
+                  gap_ref / max(gap_sdp, 1e-12), 4),
+              "device_vs_reference": round(
+                  gap_admm / max(gap_ref, 1e-12), 4),
               "sdp_oracle_s": round(t_sdp, 2)})
 
     if out:

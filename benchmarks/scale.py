@@ -45,6 +45,44 @@ def _median_time(fn, arg, per: int, reps: int) -> float:
     return stats["median_s"]
 
 
+# v5e single-chip peaks (public specs): 197 TFLOP/s bf16 on the MXU and
+# 819 GB/s HBM bandwidth. The fractions below are *roofline positions*,
+# not efficiency grades — these kernels are f32 elementwise/reduction
+# dominated (VPU + HBM), so hbm_frac_peak is the binding axis for most of
+# them and mxu_frac is expected to be small; the point is attributable
+# regressions (a kernel that loses Hz shows WHERE: FLOP/s or GB/s).
+V5E_PEAK_BF16_FLOPS = 197e12
+V5E_HBM_BPS = 819e9
+
+
+def _roofline(jfn, arg, dt: float, per: int = 1) -> dict:
+    """Achieved FLOP/s + HBM GB/s from XLA's compiled cost analysis.
+
+    ``jfn`` must be the jitted callable that was timed, ``arg`` its input,
+    ``dt`` the measured per-instance seconds, ``per`` the instances per
+    call (chained scans). Uses `Compiled.cost_analysis()` — XLA's static
+    estimate of flops and bytes accessed (custom-call/Pallas bodies are
+    opaque to it, so kernels routed through Pallas under-report flops;
+    the HBM number still covers their operand traffic). Returns {} where
+    the backend offers no analysis."""
+    try:
+        ca = jfn.lower(arg).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0)) / per
+        byts = float(ca.get("bytes accessed", 0.0)) / per
+        if flops <= 0.0 and byts <= 0.0:
+            return {}
+        return {"flops_per_instance": round(flops),
+                "achieved_gflops_s": round(flops / dt / 1e9, 1),
+                "hbm_gb_s": round(byts / dt / 1e9, 1),
+                "mxu_frac_bf16peak": round(
+                    flops / dt / V5E_PEAK_BF16_FLOPS, 5),
+                "hbm_frac_peak": round(byts / dt / V5E_HBM_BPS, 4)}
+    except Exception:
+        return {}
+
+
 def sinkhorn_throughput(n: int, K: int, reps: int, n_iters: int = 50,
                         seed: int = 0) -> dict:
     """The headline measurement, shared with the repo-root `bench.py`
@@ -68,8 +106,10 @@ def sinkhorn_throughput(n: int, K: int, reps: int, n_iters: int = 50,
             return c + r.row_to_col.sum(), None
         return lax.scan(body, jnp.int32(0), qs)[0]
 
-    dt = _median_time(jax.jit(chain), qs, K, reps)
+    jchain = jax.jit(chain)
+    dt = _median_time(jchain, qs, K, reps)
     spread = dict(_LAST_SPREAD)
+    roofline = _roofline(jchain, qs, dt, K)
 
     f1 = jax.jit(
         lambda q: sinkhorn.sinkhorn_assign(q, p, n_iters=n_iters).row_to_col)
@@ -82,6 +122,7 @@ def sinkhorn_throughput(n: int, K: int, reps: int, n_iters: int = 50,
     subopt = float(cost[np.arange(n), v].sum() / opt - 1.0)
     return {"hz": 1.0 / dt, "latency_ms": latency * 1000.0,
             "subopt": subopt, "chain_k": K, "n_iters": n_iters,
+            "roofline": roofline,
             "hz_spread": ([round(1.0 / spread["max_s"], 1),
                            round(1.0 / spread["min_s"], 1)]
                           if spread else None),
@@ -154,7 +195,8 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
     # pruning the avoidance kernel is approximate when > k vehicles are
     # inside d_avoid_thresh (see control.collision_avoidance)
     ca_tag = f"_k{k_ca}" if k_ca is not None else ""
-    emit(f"control_tick_n{n}{ca_tag}_hz", 1.0 / dt, "Hz", baseline=100.0)
+    emit(f"control_tick_n{n}{ca_tag}_hz", 1.0 / dt, "Hz", baseline=100.0,
+         **_roofline(roll, st, dt, ticks))
 
     # --- streaming re-assignment (north star config 5): the full engine
     # tick with a fresh Sinkhorn assignment EVERY tick — the gridlock-
@@ -186,7 +228,31 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
                                           flood_cfg, ticks_f)[0])
     dt = _median_time(froll, st_loc, ticks_f, reps)
     emit(f"flooded_tick_n{n}{ca_tag}{btag}_hz", 1.0 / dt, "Hz",
-         baseline=100.0)
+         baseline=100.0, **_roofline(froll, st_loc, dt, ticks_f))
+
+    # the WORST tick of the bulk flood (every 2nd tick does the whole
+    # O(n^3) merge; the average above hides the spike): flood_every=1
+    # makes every tick a flood-round tick, so the mean IS the spike
+    spike_cfg = sim.SimConfig(assignment="none", localization="flooded",
+                              flood_block=B, colavoid_neighbors=k_ca,
+                              flood_every=1)
+    sroll = jax.jit(lambda s: sim.rollout(s, f, ControlGains(), sp,
+                                          spike_cfg, ticks_f)[0])
+    dt = _median_time(sroll, st_loc, ticks_f, reps)
+    emit(f"flooded_roundtick_n{n}{ca_tag}{btag}_hz", 1.0 / dt, "Hz",
+         baseline=100.0, **_roofline(sroll, st_loc, dt, ticks_f))
+
+    # phased flood (flood_phases=2): the merge's target axis spreads over
+    # the 50 Hz window, so EVERY tick carries half a merge and none
+    # spikes — per-target cadence unchanged (`localization.tick_phased`)
+    ph_cfg = sim.SimConfig(assignment="none", localization="flooded",
+                           flood_block=B, colavoid_neighbors=k_ca,
+                           flood_phases=2)
+    proll = jax.jit(lambda s: sim.rollout(s, f, ControlGains(), sp,
+                                          ph_cfg, ticks_f)[0])
+    dt = _median_time(proll, st_loc, ticks_f, reps)
+    emit(f"flooded_tick_n{n}{ca_tag}{btag}_phased2_hz", 1.0 / dt, "Hz",
+         baseline=100.0, **_roofline(proll, st_loc, dt, ticks_f))
 
     from aclswarm_tpu.assignment import cbaa as cbaalib
     from aclswarm_tpu.core import perm as permutil
@@ -211,13 +277,15 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
 
     rr = jax.jit(lambda q: cbaalib.cbaa_from_state(
         q, f.points, f.adjmat, v2f0, task_block=B))(qs_c[0])
-    dt = _median_time(jax.jit(cchain), qs_c, Kc, max(2, reps - 3))
+    jc = jax.jit(cchain)
+    dt = _median_time(jc, qs_c, Kc, max(2, reps - 3))
     # keyed `_earlyexit` since round 4: the pre-round-3 `cbaa_faithful_n*`
     # key measured the fixed 2n-round budget (now `cbaa_fullbudget_n*`);
     # distinct keys keep cross-commit artifact comparisons like-for-like
     emit(f"cbaa_faithful_earlyexit_n{n}{btag}_hz", 1.0 / dt, "Hz", chain_k=Kc,
          s_per_auction=round(dt, 4), rounds=int(rr.rounds),
-         budget=2 * n, valid=bool(rr.valid))
+         budget=2 * n, valid=bool(rr.valid),
+         **_roofline(jc, qs_c, dt, Kc))
 
     if not (quick and n > 512):
         Kb = 1 if n > 512 else Kc
@@ -242,7 +310,8 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
     # (chained + single-shot), so the implicit last-spread would tag the
     # throughput row with the latency run's jitter
     emit(f"sinkhorn_assign_n{n}_hz", sk["hz"], "Hz", baseline=100.0,
-         chain_k=K, spread_s=sk["chain_spread_s"])
+         chain_k=K, spread_s=sk["chain_spread_s"],
+         **(sk["roofline"] or {}))
     # single-shot latency (includes this environment's fixed per-launch
     # tunnel overhead — see module docstring; honest but pessimistic)
     emit(f"sinkhorn_assign_n{n}_latency_ms", sk["latency_ms"], "ms",
@@ -321,9 +390,10 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
                     pp, adj_g, max_nonedges=n_g - 4).sum(), None
             return lax.scan(body, jnp.float32(0), ptss)[0]
 
-        dt = _median_time(jax.jit(gchain), ptss, G, reps)
+        jg = jax.jit(gchain)
+        dt = _median_time(jg, ptss, G, reps)
         emit(f"admm_gain_design_n{n_g}{tag}_ms", dt * 1000, "ms",
-             chain_k=G)
+             chain_k=G, **_roofline(jg, ptss, dt, G))
 
     # --- gain design at n=1000 (north star config 4, the honest number):
     # a (3992, 3992)-matrix ADMM solve; runs per formation *dispatch*
@@ -336,7 +406,8 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
         g1k = jax.jit(lambda p: gl.solve_gains(
             p, adj1k, max_nonedges=n - 4).sum())
         dt = _median_time(g1k, pts1k, 1, max(2, reps - 2))
-        emit(f"admm_gain_design_n{n}_s", dt, "s")
+        emit(f"admm_gain_design_n{n}_s", dt, "s",
+             **_roofline(g1k, pts1k, dt, 1))
 
     if out:
         print(f"# wrote {len(results)} rows to {out} (incrementally)")

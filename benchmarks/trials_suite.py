@@ -63,7 +63,7 @@ CONFIGS = [
           localization="flooded", colavoid_neighbors=16, chunk_ticks=100,
           sim_l=40.0, sim_w=40.0, sim_h=3.0, sim_min_dist=3.0,
           init_area_w=40.0, init_area_h=40.0, init_radius=1.0,
-          room_x=100.0, room_y=100.0, room_z=30.0), 3, 1),
+          room_x=100.0, room_y=100.0, room_z=30.0), 10, 1),
     # north-star scale (config 4/5 shape, closed loop): 1000 agents,
     # random rigid graphs, Sinkhorn auctions, on-dispatch ADMM gain
     # design, k=16 avoidance pruning. Nothing in the reference ever flew
@@ -103,6 +103,23 @@ CONFIGS = [
           gain_scale=0.15,
           # break Sinkhorn near-tie churn (SimConfig.assign_eps)
           assign_eps=0.01), 5, 1),
+    # the north-star scale WITH the faithful information model: control
+    # consumes flooded-localization estimate tables (the reference's
+    # actual L3, `localization_ros.cpp`) instead of ground truth.
+    # flood_block bounds merge memory; flood_phases=2 spreads the O(n^3)
+    # merge across the 50 Hz window so no tick spikes below 100 Hz
+    # (`localization.tick_phased`). All other knobs = simform1000's.
+    ("simform1000_flooded",
+     dict(formation="simform1000", assignment="sinkhorn",
+          localization="flooded", flood_block=64, flood_phases=2,
+          colavoid_neighbors=16, chunk_ticks=100,
+          sim_l=130.0, sim_w=130.0, sim_h=3.0, sim_min_dist=3.0,
+          init_area_w=120.0, init_area_h=120.0, init_radius=1.0,
+          room_x=200.0, room_y=200.0, room_z=30.0,
+          max_vel_xy=1.0, max_vel_z=0.5,
+          max_accel_xy=1.0, max_accel_z=1.0, trial_timeout=1200.0,
+          e_xy_thr=1.0, e_z_thr=0.3, kd=0.0005, K1_xy=0.005,
+          gain_scale=0.15, assign_eps=0.01), 5, 1),
 ]
 
 

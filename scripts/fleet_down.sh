@@ -1,0 +1,25 @@
+#!/bin/bash
+# Teardown for scripts/fleet_up.sh — the reference trial driver's
+# defensive cleanup (`trial.sh:129-156`: kill the tmux sessions, pkill
+# leftovers, and clear shared memory so the next run starts clean).
+set -uo pipefail
+
+NS=/asw
+SESSION=aclswarm_tpu
+while getopts "s:" opt; do
+  case $opt in
+    s) NS=$OPTARG ;;
+    *) echo "usage: $0 [-s NS]"; exit 1 ;;
+  esac
+done
+
+tmux kill-session -t $SESSION 2>/dev/null && echo "killed tmux $SESSION"
+pkill -f "aclswarm_tpu.interop.bridge" 2>/dev/null || true
+pkill -f "aclswarm_tpu.interop.operator" 2>/dev/null || true
+# shm-ring cleanup (the reference clears /dev/shm leftovers the same way,
+# trial.sh:150-156); ring names are the channel names minus the leading /
+shopt -s nullglob
+for f in /dev/shm/"${NS#/}"-*; do
+  rm -f "$f" && echo "removed $f"
+done
+echo "fleet down"

@@ -1,0 +1,65 @@
+#!/bin/bash
+# Fleet bring-up for the wire (shm-ring) deployment — the analogue of the
+# reference's tmux orchestration (`aclswarm_sim/scripts/start.sh:126-160`
+# launches n simulator + n vehicle-stack panes; `aclswarm/scripts/
+# remote_start.sh` does the onboard equivalent over ssh). The TPU-native
+# deployment needs exactly THREE processes instead of 3n+1:
+#
+#   pane 0  planner bridge daemon (the whole coordination layer, batched)
+#   pane 1  operator             (takeoff broadcast + formation cycling)
+#   pane 2  live wire plot       (the rqt_multiplot analogue)
+#
+# Usage: scripts/fleet_up.sh [-n N] [-g GROUP] [-s NS] [-d DISPATCH_S]
+#        scripts/fleet_down.sh [-s NS]          # teardown (trial.sh:129-156)
+#
+# For real multi-host hardware the same shape applies per host (the shm
+# rings are per-host; cross-host transport is the ROS adapter,
+# `aclswarm_tpu/interop/ros_bridge.py`, or any socket pump of the framed
+# codec).
+set -euo pipefail
+
+N=6
+GROUP=swarm6_3d
+NS=/asw
+DISPATCH=10
+SESSION=aclswarm_tpu
+
+while getopts "n:g:s:d:" opt; do
+  case $opt in
+    n) N=$OPTARG ;;
+    g) GROUP=$OPTARG ;;
+    s) NS=$OPTARG ;;
+    d) DISPATCH=$OPTARG ;;
+    *) echo "usage: $0 [-n N] [-g GROUP] [-s NS] [-d DISPATCH_S]"; exit 1 ;;
+  esac
+done
+
+cd "$(dirname "$0")/.."
+
+if tmux has-session -t $SESSION 2>/dev/null; then
+  echo "session '$SESSION' already running (scripts/fleet_down.sh first)"
+  exit 1
+fi
+
+tmux new-session -d -s $SESSION -n fleet
+tmux split-window -h -t $SESSION:0
+tmux split-window -v -t $SESSION:0.1
+
+# pane 0: the planner bridge (creates the rings; everything else opens them)
+tmux send-keys -t $SESSION:0.0 \
+  "python -m aclswarm_tpu.interop.bridge --n $N --ns $NS --verbose" Enter
+sleep 2
+
+# pane 1: operator — GO broadcast then formation cycling at the dispatch
+# period (the reference operator's START semantics, operator.py:126-134)
+tmux send-keys -t $SESSION:0.1 \
+  "python -m aclswarm_tpu.interop.operator --group $GROUP \
+--channel $NS-formation --mode-channel $NS-flightmode-veh --create \
+--action start --dispatch $DISPATCH" Enter
+
+# pane 2: live wire-signal plot (rqt_multiplot analogue)
+tmux send-keys -t $SESSION:0.2 \
+  "python -m aclswarm_tpu.harness.liveplot --ns $NS || \
+echo 'liveplot unavailable (headless?)'" Enter
+
+echo "fleet up: tmux attach -t $SESSION   (scripts/fleet_down.sh tears down)"

@@ -197,8 +197,11 @@ class TestClosedLoopWithDesignedGains:
             gainslib.solve_gains(spec.points, spec.adjmat)))
         st = sim.init_state(spread_start(6, 11))
         cfg = sim.SimConfig(assignment="auction")
+        # 90 s: the library's sparse per-formation graph (8 edges, spectral
+        # gap 0.27 vs the complete graph's) settles about 2x slower than
+        # the fc demo did — shape error 0.37 at 45 s, 0.22 at 90 s
         final, m = sim.rollout(st, f, ControlGains(), room_params(), cfg,
-                               4500)
+                               9000)
         res = supervisor.evaluate(
             np.asarray(m.distcmd_norm), np.asarray(m.ca_active),
             np.asarray(m.q), np.asarray(m.reassigned),

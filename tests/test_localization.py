@@ -131,6 +131,69 @@ class TestFlood:
         np.testing.assert_array_equal(
             np.asarray(out.age)[0], np.where(take, best, age[0]))
 
+    def test_stripe_merge_bit_identical_to_full(self):
+        """A stripe flood equals the full flood restricted to the stripe's
+        columns, and leaves every other column untouched (the phased-flood
+        correctness contract, `SimConfig.flood_phases`)."""
+        n = 13
+        rng = np.random.default_rng(5)
+        adj = (rng.random((n, n)) < 0.4).astype(float)
+        adj = np.triu(adj, 1)
+        adj = adj + adj.T
+        comm = loc.comm_mask(jnp.asarray(adj), permutil.identity(n))
+        t = loc.EstimateTable(
+            est=jnp.asarray(rng.normal(size=(n, n, 3))),
+            age=jnp.asarray(rng.integers(0, 50, (n, n)), jnp.int32))
+        full = loc.flood(t, comm)
+        for start, width in ((0, 5), (5, 5), (8, 5), (0, 13), (6, 7)):
+            s = loc.flood(t, comm, stripe=(start, width))
+            sl = slice(start, start + width)
+            np.testing.assert_array_equal(np.asarray(s.est[:, sl]),
+                                          np.asarray(full.est[:, sl]))
+            np.testing.assert_array_equal(np.asarray(s.age[:, sl]),
+                                          np.asarray(full.age[:, sl]))
+            # untouched outside the stripe
+            mask = np.ones(n, bool)
+            mask[sl] = False
+            np.testing.assert_array_equal(np.asarray(s.est[:, mask]),
+                                          np.asarray(t.est[:, mask]))
+            np.testing.assert_array_equal(np.asarray(s.age[:, mask]),
+                                          np.asarray(t.age[:, mask]))
+        # stripe + target_block compose (the n=1000 phased scale mode)
+        s = loc.flood(t, comm, target_block=3, stripe=(2, 7))
+        np.testing.assert_array_equal(np.asarray(s.est[:, 2:9]),
+                                      np.asarray(full.est[:, 2:9]))
+
+    def test_phased_tick_refreshes_every_target_each_window(self):
+        """Over one flood_every window, tick_phased merges every target
+        exactly once — per-entry cadence identical to the bulk flood."""
+        n = 8
+        rng = np.random.default_rng(7)
+        q = jnp.asarray(rng.normal(size=(n, 3)))
+        adj = jnp.asarray(np.ones((n, n)) - np.eye(n))
+        v2f = permutil.identity(n)
+        bulk = phased = loc.init_table(q)
+        # age the tables so merges visibly refresh entries
+        bulk = loc.EstimateTable(est=bulk.est, age=bulk.age + 40)
+        phased = loc.EstimateTable(est=phased.est, age=phased.age + 40)
+        for t in range(4):
+            bulk = loc.tick(bulk, q, adj, v2f, do_flood=(t % 2) == 0)
+            phased = loc.tick_phased(phased, q, adj, v2f, t,
+                                     flood_every=2, phases=2)
+        # static swarm: both reach the same steady table after one window
+        np.testing.assert_array_equal(np.asarray(bulk.est),
+                                      np.asarray(phased.est))
+        # ages agree up to the stripe's phase shift within the window
+        assert int(jnp.max(phased.age)) <= int(jnp.max(bulk.age)) + 1
+
+    def test_phased_must_divide_flood_every(self):
+        n = 4
+        t = loc.init_table(jnp.zeros((n, 3)))
+        with pytest.raises(ValueError):
+            loc.tick_phased(t, jnp.zeros((n, 3)),
+                            jnp.ones((n, n)), permutil.identity(n), 0,
+                            flood_every=2, phases=3)
+
     def test_comm_graph_follows_assignment(self):
         """v hears w iff their formation points are adjacent
         (`localization_ros.cpp:152-185`)."""

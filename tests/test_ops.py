@@ -96,6 +96,21 @@ class TestFloodMergePallas:
                                             interpret=not ON_TPU))
         np.testing.assert_array_equal(ref, out)
 
+    @pytest.mark.parametrize("n,w", [(64, 32), (130, 65), (7, 3)])
+    def test_stripe_bit_identical(self, n, w):
+        """Non-square (senders x stripe) inputs — the phased-flood mode."""
+        from aclswarm_tpu.ops.flood_pallas import (SENTINEL,
+                                                   flood_merge_pallas)
+        rng = np.random.default_rng(n + w)
+        packed = jnp.asarray(rng.integers(0, 2**30, (n, w)), jnp.int32)
+        comm = jnp.asarray(rng.random((n, n)) < 0.3)
+        ref = np.where(np.asarray(comm)[:, :, None],
+                       np.asarray(packed)[None, :, :],
+                       SENTINEL).min(axis=1)
+        out = np.asarray(flood_merge_pallas(packed, comm,
+                                            interpret=not ON_TPU))
+        np.testing.assert_array_equal(ref, out)
+
 
 @pytest.mark.f32
 class TestSinkhornPallasDevice:

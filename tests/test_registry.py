@@ -1,0 +1,45 @@
+"""Vehicle registry tests (O4: named vehicles <-> batch indices,
+`utils.h:43-72` loadVehicleInfo semantics + `param/vehicles.yaml`)."""
+import pytest
+
+from aclswarm_tpu.core.registry import (DEFAULT_REGISTRY, VehicleRegistry,
+                                        load_registry, make_registry)
+
+
+class TestRegistry:
+    def test_mixed_fleet_names(self):
+        r = make_registry(["SQ01s", "HX04", "SQ03s"])
+        assert r.n == 3
+        assert r.index("HX04") == 1          # index = list position
+        assert r.name(2) == "SQ03s"
+        assert list(r) == ["SQ01s", "HX04", "SQ03s"]
+
+    def test_unknown_name_is_error(self):
+        # the reference errors out, never defaults (`utils.h:60-64`)
+        r = make_registry(["SQ01s"])
+        with pytest.raises(KeyError):
+            r.index("HX99")
+
+    def test_duplicate_names_rejected(self):
+        with pytest.raises(ValueError):
+            make_registry(["SQ01s", "SQ01s"])
+
+    def test_int_builds_sil_convention(self):
+        # trial.sh:64-78 builds /vehs as SQ01s..SQnns
+        r = make_registry(3)
+        assert list(r) == ["SQ01s", "SQ02s", "SQ03s"]
+
+    def test_shipped_registry_loads(self):
+        r = load_registry()
+        assert DEFAULT_REGISTRY.exists()
+        assert r.n >= 1 and r.index(r.name(0)) == 0
+
+    def test_ros_adapter_uses_registry(self):
+        from aclswarm_tpu.interop import ros_bridge as rb
+        from aclswarm_tpu.interop.ros_fakes import FakeMsgs, FakeRospy
+        ros = FakeRospy(params={"/vehs": ["SQ01s", "HX04"]})
+        node = rb.run(ros, FakeMsgs)
+        assert isinstance(node.registry, VehicleRegistry)
+        assert node.registry.index("HX04") == 1
+        # per-vehicle topics follow the registered names
+        assert "/HX04/distcmd" in ros.pubs

@@ -174,31 +174,43 @@ class TestSwarm6_3dConvergence:
 
 class TestFormationLoader:
     def test_own_library_loads(self):
+        """The shipped swarm6_3d is the reference demo group like-for-like:
+        three formations on the reference's SPARSE per-formation graphs
+        (`/root/reference/aclswarm/param/formations.yaml:141-250`; no
+        group-level key, so the per-formation matrices load)."""
         group = harness.load_group(group="swarm6_3d")
         names = [f.name for f in group]
-        assert "Pentagonal Pyramid" in names
+        assert names == ["Pentagonal Pyramid", "Triangular Prism",
+                         "Slanted Plane"]
         fm = group[0]
         assert fm.points.shape == (6, 3)
-        # group-level 'fc' => complete graph regardless of per-formation entry
-        np.testing.assert_allclose(fm.adjmat,
-                                   np.ones((6, 6)) - np.eye(6))
+        want = np.array([[0, 0, 1, 1, 0, 1], [0, 0, 1, 0, 0, 1],
+                         [1, 1, 0, 1, 0, 0], [1, 0, 1, 0, 1, 0],
+                         [0, 0, 0, 1, 0, 1], [1, 1, 0, 0, 1, 0]])
+        np.testing.assert_allclose(fm.adjmat, want)
+        # the committed gains were designed for the sparse graph: zero
+        # 3x3 blocks exactly on the non-edges
+        for i in range(6):
+            for j in range(6):
+                if i != j and not want[i, j]:
+                    np.testing.assert_allclose(
+                        fm.gains[3 * i:3 * i + 3, 3 * j:3 * j + 3], 0.0,
+                        atol=1e-9)
 
-    def test_scale_applied_to_points_only(self):
+    def test_scale_applied_to_points_only(self, tmp_path):
         """Loader multiplies points by the formation's scale and leaves the
-        gains untouched (`operator.py:155-157`); pinned against the raw
-        yaml so the check survives geometry redesigns."""
+        gains untouched (`operator.py:155-157`)."""
         import yaml
-
-        from aclswarm_tpu.harness.formations import DEFAULT_LIBRARY
-        fm = harness.load_formation("Octahedron", group="swarm6_3d")
-        lib = yaml.safe_load(open(DEFAULT_LIBRARY))
-        raw = [f for f in lib["swarm6_3d"]["formations"]
-               if f["name"] == "Octahedron"][0]
-        scale = float(raw["scale"])
-        assert scale != 1.0   # the check must exercise a real scale
-        np.testing.assert_allclose(fm.points,
-                                   scale * np.asarray(raw["points"]))
-        np.testing.assert_allclose(fm.gains, np.asarray(raw["gains"]))
+        pts = [[0.0, 0.0, 0.0], [2.0, 0.0, 0.0], [0.0, 2.0, 0.0]]
+        gains = np.arange(81, dtype=float).reshape(9, 9)
+        lib = {"g": {"agents": 3, "adjmat": "fc", "formations": [
+            {"name": "tri", "scale": 1.5, "points": pts,
+             "gains": gains.tolist()}]}}
+        path = tmp_path / "lib.yaml"
+        path.write_text(yaml.safe_dump(lib))
+        fm = harness.load_formation("tri", path=str(path), group="g")
+        np.testing.assert_allclose(fm.points, 1.5 * np.asarray(pts))
+        np.testing.assert_allclose(fm.gains, gains)
 
     @needs_reference
     def test_reference_library_group_fc_override(self):

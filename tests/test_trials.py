@@ -337,11 +337,11 @@ def test_swarm100_scale_group_loads_and_solves():
 
 def test_flagship_swarm6_3d_trial_completes(tmp_path):
     """The flagship demo group (BASELINE.md config #1) completes under the
-    honest second-order dynamics. Regression for round 2's headline
-    failure: the shipped Octahedron stacked two vertices on one xy column
-    (planar separation 0 < r_keep_out), putting the two vehicles assigned
-    there in permanent mutual avoidance — a gridlock no reassignment can
-    escape, terminating 100% of trials."""
+    honest second-order dynamics — since round 4 this is the reference's
+    exact demo cycle (Pentagonal Pyramid / Triangular Prism / Slanted
+    Plane) on its SPARSE per-formation graphs. Also the load-time
+    feasibility regression ground: round 2's gridlocked-Octahedron
+    failure mode (stacked xy columns) is now rejected at library load."""
     out = tmp_path / "sw6.csv"
     cfg = trials.TrialConfig(formation="swarm6_3d", trials=2, seed=1,
                              dynamics="doubleint", out=str(out),
@@ -349,7 +349,8 @@ def test_flagship_swarm6_3d_trial_completes(tmp_path):
     stats = trials.run_trials(cfg)
     assert stats["completion_pct"] == 100.0
     data = np.loadtxt(out, delimiter=",", ndmin=2)
-    assert data.shape == (2, 1 + 6 + 3 * 2)
+    # [trial, dist x 6, (time, time_avoidance, assignments) x 3 formations]
+    assert data.shape == (2, 1 + 6 + 3 * 3)
 
 
 def test_shipped_library_formations_are_feasible():
