@@ -50,6 +50,11 @@ def main():
         # min/max Hz over the 5 timing reps (round-2 next-step #9: spread
         # makes regressions visible beyond the single median)
         "hz_spread": sk["hz_spread"],
+        # roofline position (round-3 weak #6): achieved FLOP/s + HBM GB/s
+        # from XLA's cost analysis vs v5e peaks (197 TF bf16 / 819 GB/s);
+        # Pallas bodies are opaque to the flops estimate — see
+        # benchmarks/scale.py _roofline
+        "roofline": sk["roofline"],
     }))
 
 
