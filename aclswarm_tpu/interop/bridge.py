@@ -40,21 +40,9 @@ log = get_logger("interop.bridge")
 SHUTDOWN = "__shutdown__"
 
 
-def _send_reliable(channel, msg, grace_s: float = 1.0,
-                   poll_s: float = 0.001) -> bool:
-    """Send with bounded retry through backpressure; a drop after the
-    grace period is loud (the reference's 'queue size 1 but don't want to
-    lose any' intent, `coordination_ros.cpp:417-418`)."""
-    import time
-
-    deadline = time.time() + grace_s
-    while not channel.send(msg):
-        if time.time() > deadline:
-            log.warning("DROPPED %s on %s after %ss backpressure",
-                        type(msg).__name__, channel.name, grace_s)
-            return False
-        time.sleep(poll_s)
-    return True
+def _send_reliable(channel, msg, grace_s: float = 1.0) -> bool:
+    from aclswarm_tpu.interop.transport import send_reliable
+    return send_reliable(channel, msg, grace_s=grace_s, log=log)
 
 
 def run_bridge(n: int, ns: str = "/asw", ticks: int = 0,
@@ -72,7 +60,12 @@ def run_bridge(n: int, ns: str = "/asw", ticks: int = 0,
                          assign_every=assign_every,
                          central_assignment=central_assignment)
     served = 0
-    with Channel(f"{ns}-formation", create=True) as ch_form, \
+    # the formation ring must hold a dispatch WITH explicit gains
+    # (9 n^2 f32 dominates: 36 MB at n=1000) — the creator dictates ring
+    # capacity, so size it here rather than failing in the operator
+    form_cap = max(1 << 20, 2 * (9 * n * n * 4 + n * n + 24 * n + 4096))
+    with Channel(f"{ns}-formation", create=True,
+                 capacity=form_cap) as ch_form, \
             Channel(f"{ns}-flightmode", create=True) as ch_mode, \
             Channel(f"{ns}-estimates", create=True) as ch_est, \
             Channel(f"{ns}-central-assignment", create=True) as ch_cen, \
@@ -83,6 +76,7 @@ def run_bridge(n: int, ns: str = "/asw", ticks: int = 0,
             log.info("bridge up: ns=%s n=%d", ns, n)
         deadline = time.time() + idle_timeout_s
         shutdown = False
+        discarded_central_warned = False
         while True:
             progressed = False
             # drain the formation channel: a burst of operator dispatches
@@ -121,11 +115,22 @@ def run_bridge(n: int, ns: str = "/asw", ticks: int = 0,
                         log.warning("rejected malformed central assignment")
                     elif verbose:
                         log.info("central assignment received")
+                elif not discarded_central_warned:
+                    # a client IS pushing but this daemon was started
+                    # without --central-assignment: silent discard would
+                    # look like the opposite mode (loud once)
+                    discarded_central_warned = True
+                    log.warning(
+                        "central-assignment push received but this bridge "
+                        "runs WITHOUT --central-assignment; pushes are "
+                        "discarded and the daemon keeps auctioning")
             est = ch_est.recv()
             if isinstance(est, m.VehicleEstimates):
                 out = planner.tick(est)
-                _send_reliable(ch_cmd, m.DistCmd(header=est.header,
-                                                 vel=out.distcmd))
+                # ORDER MATTERS: safety and assignment go out BEFORE the
+                # distcmd, so a consumer that blocks on the distcmd for
+                # this tick (ShmPlannerClient matches header.seq) finds
+                # the same tick's other frames already in their rings
                 if out.safety is not None:
                     # per-tick health stream; a dropped frame is stale the
                     # next tick, so plain best-effort send (queue-size-1
@@ -140,6 +145,8 @@ def run_bridge(n: int, ns: str = "/asw", ticks: int = 0,
                     _send_reliable(ch_asn, m.Assignment(
                         header=est.header, perm=out.assignment),
                         grace_s=5.0)
+                _send_reliable(ch_cmd, m.DistCmd(header=est.header,
+                                                 vel=out.distcmd))
                 served += 1
                 progressed = True
                 if ticks and served >= ticks:

@@ -242,6 +242,122 @@ def flightmode_from_ros(msg, quad_cls=None) -> m.FlightMode:
 
 
 # ---------------------------------------------------------------------------
+# shm backend: forward to a planner daemon instead of owning the device
+# ---------------------------------------------------------------------------
+
+class ShmPlannerClient:
+    """`TpuPlanner` duck-type that forwards over the shm wire to a
+    planner daemon (`python -m aclswarm_tpu.interop.bridge`).
+
+    The two-process deployment shape: the rospy node lives at the graph
+    edge (GIL, callbacks, ROS deps) while the daemon owns the device and
+    the jitted planner. The ROS node's `step()` then costs one shm
+    round-trip (~10 us/message on the SPSC rings) instead of a device
+    dispatch. Same channels as the daemon serves (see `interop.bridge`).
+    """
+
+    def __init__(self, n: int, ns: str = "/asw",
+                 central_assignment: bool = False,
+                 connect_timeout_s: float = 60.0,
+                 tick_timeout_s: float = 60.0):
+        import time
+
+        from aclswarm_tpu.interop.transport import Channel
+
+        self.n = n
+        self.central_assignment = central_assignment
+        self.tick_timeout_s = tick_timeout_s
+        self._seq = 0
+        self._chans = {}
+        deadline = time.time() + connect_timeout_s
+        for name in ("formation", "flightmode", "estimates",
+                     "central-assignment", "distcmd", "assignment",
+                     "safety"):
+            while True:
+                try:
+                    self._chans[name] = Channel(f"{ns}-{name}")
+                    break
+                except OSError:
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.05)
+
+    def close(self) -> None:
+        for ch in self._chans.values():
+            ch.close()
+
+    # -- TpuPlanner surface ----------------------------------------------
+    def handle_formation(self, fm: m.Formation) -> None:
+        # a dropped commit would leave the daemon on the old formation
+        # with no signal — retry through backpressure, loud on failure
+        from aclswarm_tpu.interop.transport import send_reliable
+        send_reliable(self._chans["formation"], fm, grace_s=5.0, log=log)
+
+    def handle_flightmode(self, fm: m.FlightMode) -> None:
+        # KILL is the e-stop: silent loss is never acceptable
+        from aclswarm_tpu.interop.transport import send_reliable
+        send_reliable(self._chans["flightmode"], fm, grace_s=5.0, log=log)
+
+    def handle_central_assignment(self, perm) -> bool:
+        perm = np.asarray(perm.perm if isinstance(perm, m.Assignment)
+                          else perm, np.int32)
+        if perm.shape != (self.n,) or not np.array_equal(
+                np.sort(perm), np.arange(self.n)):
+            return False       # same wire-corruption guard as TpuPlanner
+        from aclswarm_tpu.interop.transport import send_reliable
+        self._seq += 1
+        return send_reliable(
+            self._chans["central-assignment"],
+            m.Assignment(header=m.Header(seq=self._seq), perm=perm),
+            grace_s=5.0, log=log)
+
+    def tick(self, q: np.ndarray):
+        """One forwarded tick: estimates out, the SAME tick's distcmd
+        back (matched on header.seq — stale replies from a timed-out
+        earlier tick are discarded, so one stall cannot desynchronize the
+        stream). The daemon writes safety/assignment BEFORE the distcmd
+        (`bridge.py` output order), so once the matching distcmd arrives,
+        this tick's other frames are already readable."""
+        import time
+
+        from aclswarm_tpu.interop.planner import PlannerOutput
+
+        q = np.asarray(q)
+        self._seq += 1
+        self._chans["estimates"].send(m.VehicleEstimates(
+            header=m.Header(seq=self._seq), positions=q,
+            stamps=np.zeros(self.n)))
+        deadline = time.time() + self.tick_timeout_s
+        cmd = None
+        while cmd is None or cmd.header.seq != self._seq:
+            if cmd is not None and cmd.header.seq > self._seq:
+                raise RuntimeError(
+                    f"distcmd seq {cmd.header.seq} from the future "
+                    f"(ours {self._seq}): two clients on one namespace?")
+            cmd = self._chans["distcmd"].recv()
+            if cmd is None:
+                if time.time() > deadline:
+                    raise TimeoutError("planner daemon did not answer the "
+                                       "tick (distcmd timeout)")
+                time.sleep(0.0005)
+        # drain to the newest frames for this tick; an assignment is
+        # one-shot, so any frame found (even a stale-seq one that raced a
+        # previous timeout) is the daemon's latest accepted permutation
+        asn = last_safe = None
+        while (x := self._chans["assignment"].recv()) is not None:
+            asn = x
+        while (x := self._chans["safety"].recv()) is not None:
+            last_safe = x
+        return PlannerOutput(
+            distcmd=np.asarray(cmd.vel),
+            assignment=(None if asn is None
+                        else np.asarray(asn.perm, np.int32)),
+            auction_valid=True,
+            safety=(None if last_safe is None
+                    else np.asarray(last_safe.active, bool)))
+
+
+# ---------------------------------------------------------------------------
 # the node
 # ---------------------------------------------------------------------------
 
@@ -284,6 +400,10 @@ class TpuCoordinationNode:
             planner = TpuPlanner(n, assignment=assignment,
                                  assign_every=assign_every,
                                  central_assignment=central_assignment)
+        # an injected planner (e.g. ShmPlannerClient) knows its own mode;
+        # the /central_assignment subscription must follow it
+        central_assignment = getattr(planner, "central_assignment",
+                                     central_assignment)
         self.planner = planner
         self._lock = threading.Lock()
         self._pending_formation = None
@@ -422,8 +542,30 @@ def main(argv=None):  # pragma: no cover - requires a live ROS graph
     ap.add_argument("--assignment", default="auction")
     ap.add_argument("--assign-every", type=int, default=120)
     ap.add_argument("--control-dt", type=float, default=0.01)
+    ap.add_argument("--backend", choices=("inproc", "shm"),
+                    default="inproc",
+                    help="inproc = this node owns the device planner; "
+                         "shm = forward to a planner daemon "
+                         "(`python -m aclswarm_tpu.interop.bridge`) over "
+                         "the shm rings — the two-process deployment")
+    ap.add_argument("--ns", default="/asw",
+                    help="shm channel namespace (--backend shm)")
     args = ap.parse_args(argv)
-    run(rospy, Msgs, control_dt=args.control_dt,
+    planner = None
+    if args.backend == "shm":
+        rospy.init_node("coordination_tpu")   # params need a node
+        vehs = rospy.get_param("/vehs")
+        planner = ShmPlannerClient(
+            len(vehs), args.ns,
+            central_assignment=bool(
+                rospy.get_param("/operator/central_assignment", False)))
+        if planner.central_assignment:
+            # the MODE lives in the daemon: a bridge started without
+            # --central-assignment discards pushes (and warns); this side
+            # can only remind
+            rospy.logwarn("central-assignment mode: the planner daemon "
+                          "must also run with --central-assignment")
+    run(rospy, Msgs, control_dt=args.control_dt, planner=planner,
         assignment=args.assignment, assign_every=args.assign_every)
     rospy.spin()
     return 0

@@ -108,3 +108,24 @@ class Channel:
             self.close()
         except Exception:
             pass
+
+
+def send_reliable(channel: "Channel", msg, grace_s: float = 1.0,
+                  poll_s: float = 0.001, log=None) -> bool:
+    """Send with bounded retry through backpressure; a drop after the
+    grace period is loud. The 'queue size 1 but don't want to lose any'
+    intent of the reference's subscriptions (`coordination_ros.cpp
+    :417-418`) — shared by the bridge daemon and the shm planner client
+    for frames that must not vanish (formation commits, KILL broadcasts,
+    one-shot assignments)."""
+    import time
+
+    deadline = time.time() + grace_s
+    while not channel.send(msg):
+        if time.time() > deadline:
+            if log is not None:
+                log.warning("DROPPED %s on %s after %ss backpressure",
+                            type(msg).__name__, channel.name, grace_s)
+            return False
+        time.sleep(poll_s)
+    return True

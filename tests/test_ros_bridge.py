@@ -262,6 +262,69 @@ class TestLoopback:
             rb.assignment_from_ros(
                 ros.pubs["/SQ04s/assignment"].published[-1]), pushed)
 
+    def test_shm_backend_two_process_deployment(self):
+        """The full deployment composition: fake-ROS graph -> adapter
+        node -> ShmPlannerClient -> shm rings -> planner daemon
+        subprocess -> back. One wire, two processes, real field layouts
+        end to end."""
+        import pathlib
+        import subprocess
+        import sys
+        import time
+        import uuid
+
+        from aclswarm_tpu.interop.ros_bridge import ShmPlannerClient
+
+        ns = f"/aswros-{uuid.uuid4().hex[:8]}"
+        repo = str(pathlib.Path(__file__).resolve().parents[1])
+        n = 4
+        child = subprocess.Popen(
+            [sys.executable, "-m", "aclswarm_tpu.interop.bridge",
+             "--n", str(n), "--ns", ns, "--assign-every", "5",
+             "--idle-timeout", "120"], cwd=repo)
+        client = None
+        try:
+            client = ShmPlannerClient(n, ns, connect_timeout_s=60)
+            vehs = ["SQ01s", "SQ02s", "SQ03s", "SQ04s"]
+            ros = FakeRospy(params={"/vehs": vehs})
+            node = rb.run(ros, FakeMsgs, planner=client)
+            fm = _wire_formation(gains="zeros")
+            rng = np.random.default_rng(21)
+            swarm = _SwarmSide(ros, vehs,
+                               np.asarray(fm.points)[rng.permutation(n)]
+                               + [2.0, 1.0, 0.0])
+            ros.Publisher("/formation", FakeMsgs.Formation).publish(
+                rb.formation_to_ros(fm, FakeMsgs))
+            got_asn = False
+            deadline = time.time() + 120
+            for k in range(40):
+                swarm.publish_estimates()
+                node.step()
+                swarm.consume_distcmd()
+                if ros.pubs["/SQ01s/assignment"].published:
+                    got_asn = True
+                    break
+                if time.time() > deadline:
+                    break
+            assert got_asn, "no assignment made it through the composed " \
+                            "ROS->shm->daemon path"
+            perm = rb.assignment_from_ros(
+                ros.pubs["/SQ01s/assignment"].published[0])
+            assert sorted(perm.tolist()) == list(range(n))
+            # distcmds flowed end-to-end
+            assert ros.pubs["/SQ02s/distcmd"].published
+        finally:
+            if client is not None:
+                fm = _wire_formation(gains=None, name="__shutdown__")
+                client.handle_formation(fm)
+                client.close()
+            child.terminate()
+            try:
+                child.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                child.kill()
+                child.wait(timeout=30)
+
     def test_on_commit_gain_solve_over_ros(self):
         """A Formation with empty gains triggers the on-device ADMM solve
         at commit (`coordination_ros.cpp:112-119`) — through the ROS
