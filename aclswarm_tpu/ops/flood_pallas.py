@@ -25,15 +25,15 @@ _TV = 8   # receiver rows per grid step (f32 sublane granularity)
 _WC = 128   # sender chunk per inner iteration (lane-aligned slices)
 
 
-def _kernel(comm_ref, packed_ref, out_ref, *, n_chunks: int):
+def _kernel(comm_ref, packed_ref, out_ref, *, n_chunks: int, wc: int):
     TV = comm_ref.shape[0]
     N = packed_ref.shape[1]
     acc = jnp.full((TV, N), SENTINEL, jnp.int32)
 
     def body(c, acc):
-        w0 = c * _WC
-        sub = packed_ref[pl.ds(w0, _WC), :]              # (WC, N) i32
-        msk = comm_ref[:, pl.ds(w0, _WC)]                # (TV, WC) f32
+        w0 = c * wc
+        sub = packed_ref[pl.ds(w0, wc), :]               # (WC, N) i32
+        msk = comm_ref[:, pl.ds(w0, wc)]                 # (TV, WC) f32
         cand = jnp.where(msk[:, :, None] > 0.5, sub[None, :, :],
                          SENTINEL)                       # (TV, WC, N)
         return jnp.minimum(acc, jnp.min(cand, axis=1))
@@ -41,25 +41,33 @@ def _kernel(comm_ref, packed_ref, out_ref, *, n_chunks: int):
     out_ref[:] = jax.lax.fori_loop(0, n_chunks, body, acc)
 
 
-def flood_merge_bytes(n: int, w: int | None = None) -> int:
+def flood_merge_bytes(n: int, w: int | None = None, tv: int = _TV,
+                      wc: int = _WC) -> int:
     """VMEM-resident bytes of one grid step: the shared packed matrix,
     the (TV, WC, W) candidate temporary, and the comm/out row tiles.
     ``w`` is the target-stripe width (defaults to n — the full table)."""
     from aclswarm_tpu.ops._vmem import pad128
     N = pad128(n)
     W = pad128(n if w is None else w)
-    return 4 * N * W + 4 * _TV * _WC * W + 4 * _TV * N + 4 * _TV * W
+    return 4 * N * W + 4 * tv * wc * W + 4 * tv * N + 4 * tv * W
 
 
 def flood_merge_pallas(packed: jnp.ndarray, comm: jnp.ndarray,
-                       interpret: bool = False) -> jnp.ndarray:
+                       interpret: bool = False, tv: int = _TV,
+                       wc: int = _WC) -> jnp.ndarray:
     """(n, w) packed ages (senders x targets; w = n or a stripe) +
     (n, n) comm mask -> (n, w) best packed per (receiver, target); rows
-    with no neighbors return SENTINEL."""
+    with no neighbors return SENTINEL. ``tv``/``wc`` are the receiver
+    tile height and sender chunk width (benchmarked defaults)."""
     from aclswarm_tpu.ops._vmem import fits_vmem, pad128
     n, w = packed.shape
     N, W = pad128(n), pad128(w)
-    if not fits_vmem(flood_merge_bytes(n, w)):
+    if N % tv or N % wc:
+        # non-divisor tiles would silently drop senders/receivers (the
+        # grid and chunk loop cover exactly (N//tv)*tv and (N//wc)*wc)
+        raise ValueError(f"tv={tv} and wc={wc} must divide the padded "
+                         f"size {N}")
+    if not fits_vmem(flood_merge_bytes(n, w, tv, wc)):
         raise ValueError(
             f"n={n} (padded {N}) x {w} exceeds the VMEM-resident "
             "flood-merge budget; use the blocked XLA path (target_block)")
@@ -69,15 +77,15 @@ def flood_merge_pallas(packed: jnp.ndarray, comm: jnp.ndarray,
     comm_p = comm_p.at[:n, :n].set(comm.astype(jnp.float32))
 
     out = pl.pallas_call(
-        partial(_kernel, n_chunks=N // _WC),
-        grid=(N // _TV,),
+        partial(_kernel, n_chunks=N // wc, wc=wc),
+        grid=(N // tv,),
         in_specs=[
-            pl.BlockSpec((_TV, N), lambda i: (i, 0),
+            pl.BlockSpec((tv, N), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),      # comm row tile
             pl.BlockSpec((N, W), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),      # packed (shared)
         ],
-        out_specs=pl.BlockSpec((_TV, W), lambda i: (i, 0),
+        out_specs=pl.BlockSpec((tv, W), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((N, W), jnp.int32),
         interpret=interpret,

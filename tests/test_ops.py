@@ -96,6 +96,24 @@ class TestFloodMergePallas:
                                             interpret=not ON_TPU))
         np.testing.assert_array_equal(ref, out)
 
+    def test_tile_params_bit_identical_and_guarded(self):
+        """Non-default tv/wc tiles produce identical results; non-divisor
+        tiles raise instead of silently dropping senders/receivers."""
+        from aclswarm_tpu.ops.flood_pallas import flood_merge_pallas
+        rng = np.random.default_rng(9)
+        n = 130
+        packed = jnp.asarray(rng.integers(0, 2**30, (n, n)), jnp.int32)
+        comm = jnp.asarray(rng.random((n, n)) < 0.3)
+        ref = np.asarray(flood_merge_pallas(packed, comm,
+                                            interpret=not ON_TPU))
+        out = np.asarray(flood_merge_pallas(packed, comm, tv=16, wc=64,
+                                            interpret=not ON_TPU))
+        np.testing.assert_array_equal(ref, out)
+        with pytest.raises(ValueError, match="divide"):
+            flood_merge_pallas(packed, comm, wc=96)
+        with pytest.raises(ValueError, match="divide"):
+            flood_merge_pallas(packed, comm, tv=48)
+
     @pytest.mark.parametrize("n,w", [(64, 32), (130, 65), (7, 3)])
     def test_stripe_bit_identical(self, n, w):
         """Non-square (senders x stripe) inputs — the phased-flood mode."""
