@@ -136,7 +136,23 @@ def _one_agent(qij_xy: jnp.ndarray, active: jnp.ndarray, vel: jnp.ndarray,
     v_avoid = jnp.where(escape_ok, v_edge, v_stop)
 
     v_out = jnp.where(unsafe, v_avoid, vel)
-    return v_out, unsafe
+
+    # opt-in keep-out escape (`SafetyParams.keepout_repulse_vel`): inside
+    # a violation, separate radially from the deepest violator instead of
+    # running the degenerate half-plane VO (see the field's docstring)
+    viol = active & (d < params.r_keep_out)
+    any_viol = jnp.any(viol) & (params.keepout_repulse_vel > 0.0)
+    j = jnp.argmin(jnp.where(viol, d, jnp.inf))
+    away = -qij_xy[j] / jnp.maximum(d[j], 1e-9)
+    # clamped to the vehicle speed limit (avoidance runs AFTER saturation,
+    # so every path out of here must respect max_vel_xy); the vertical
+    # command is preserved like the v_edge path — the violation test is
+    # planar, and halting a climb would remove the safest escape axis
+    rep_mag = jnp.minimum(params.keepout_repulse_vel, params.max_vel_xy)
+    v_rep = jnp.concatenate([rep_mag * away, vel[2:3]])
+    v_out = jnp.where(any_viol, v_rep, v_out)
+    modified = unsafe | any_viol
+    return v_out, modified
 
 
 def collision_avoidance(q: jnp.ndarray, vel_des: jnp.ndarray,

@@ -100,6 +100,16 @@ class SafetyParams:
     max_vel_z: float = 0.3
     d_avoid_thresh: float = 1.5
     r_keep_out: float = 1.2
+    # OPT-IN divergence (0.0 = off = reference semantics): when a pair of
+    # vehicles ends up INSIDE each other's keep-out cylinders, the planar
+    # VO degenerates — both sectors become half-planes (asin(1) = pi/2)
+    # and the pair can deadlock orbiting each other (the reference's own
+    # gridlock failure mode; measured in docs/SCALE_TUNING.md par.6). A
+    # positive value replaces the command of a vehicle in violation with a
+    # radial separation velocity of this magnitude (m/s), away from its
+    # deepest violator, until the keep-out is clear again; normal VO
+    # resumes beyond r_keep_out. Still reported as ca-active.
+    keepout_repulse_vel: float = 0.0
 
 
 def gains_to_flat(gains: jnp.ndarray) -> jnp.ndarray:

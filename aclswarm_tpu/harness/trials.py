@@ -104,6 +104,11 @@ class TrialConfig:
     # fleet needs proportionally more authority
     max_accel_xy: Optional[float] = None
     max_accel_z: Optional[float] = None
+    # opt-in keep-out escape (`SafetyParams.keepout_repulse_vel`): radial
+    # separation speed for vehicles locked inside each other's keep-out
+    # cylinders (None/0 = reference semantics — such pairs can deadlock,
+    # docs/SCALE_TUNING.md par.6)
+    keepout_repulse_vel: Optional[float] = None
     trial_timeout: Optional[float] = None
     # scale-control deadbands (`cntrl/e_xy_thr` / `cntrl/e_z_thr`,
     # reference `coordination.launch:36-37` — launch-file tunables, not
@@ -208,7 +213,7 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
         bounds_min=jnp.asarray([-cfg.room_x, -cfg.room_y, 0.0]),
         bounds_max=jnp.asarray([cfg.room_x, cfg.room_y, cfg.room_z]),
         **_overrides("max_vel_xy", "max_vel_z", "max_accel_xy",
-                     "max_accel_z"))
+                     "max_accel_z", "keepout_repulse_vel"))
     trial_timeout = (TRIAL_TIMEOUT if cfg.trial_timeout is None
                      else cfg.trial_timeout)
 

@@ -218,6 +218,44 @@ class TestColAvoid:
         # heading moved off the direct bearing
         assert abs(math.atan2(float(out[0, 1]), float(out[0, 0]))) > 0.1
 
+    def test_keepout_repulse_escapes_pair_trap(self):
+        """Two vehicles locked INSIDE each other's keep-out cylinders:
+        with the reference semantics (repulse off) the degenerate
+        half-plane sectors hold them; the opt-in escape pushes them
+        radially apart until the keep-out clears (SCALE_TUNING par.6's
+        failure mode)."""
+        p = self._params()            # r_keep_out = 0.6
+        q = np.array([[0.0, 0, 1], [0.4, 0, 1]])   # 0.4 m < r_keep_out
+        vel = np.array([[0.5, 0, 0], [-0.5, 0, 0]])  # pushing together
+        # reference semantics: both flagged, neither commanded apart
+        out, mod = control.collision_avoidance(jnp.asarray(q),
+                                               jnp.asarray(vel), p)
+        assert np.all(np.asarray(mod))
+        assert float(out[1, 0] - out[0, 0]) <= 1e-9   # no separation cmd
+        # opt-in repulse: radial separation at the configured speed
+        pr = p.replace(keepout_repulse_vel=0.4)
+        out, mod = control.collision_avoidance(jnp.asarray(q),
+                                               jnp.asarray(vel), pr)
+        assert np.all(np.asarray(mod))
+        np.testing.assert_allclose(np.asarray(out)[0, :2], [-0.4, 0.0],
+                                   atol=1e-7)
+        np.testing.assert_allclose(np.asarray(out)[1, :2], [0.4, 0.0],
+                                   atol=1e-7)
+        # closed loop: the pair separates past the keep-out and repulse
+        # disengages (normal VO resumes outside r_keep_out)
+        qq = q.copy()
+        for _ in range(400):
+            out, mod = control.collision_avoidance(jnp.asarray(qq),
+                                                   jnp.asarray(vel), pr)
+            qq = qq + np.asarray(out) * 0.01
+        assert np.linalg.norm(qq[0, :2] - qq[1, :2]) > 0.6
+        # and far-apart pairs are untouched by the knob
+        qfar = np.array([[0.0, 0, 1], [10.0, 0, 1]])
+        out, mod = control.collision_avoidance(jnp.asarray(qfar),
+                                               jnp.asarray(vel), pr)
+        np.testing.assert_allclose(np.asarray(out), vel)
+        assert not np.any(np.asarray(mod))
+
     def test_heading_exactly_pi_still_avoided(self):
         # INTENTIONAL divergence from the reference: its linearized strict
         # zone test can never flag psi == ±pi (safety.cpp:487-493), letting a
