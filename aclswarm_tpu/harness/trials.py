@@ -90,6 +90,9 @@ class TrialConfig:
     sim_h: float = 2.0
     sim_min_dist: float = 2.0
     sim_formations: int = 2
+    # complete vs noncomplete random graphs — the reference's `-fc` flag
+    # on generate_random_formation.py (README FAQ #2; default noncomplete)
+    sim_fc: bool = False
     # scale knobs (None = the reference SIL defaults). The reference's
     # 0.5 m/s saturation (`SafetyParams.max_vel_xy`) and 600 s watchdog
     # were sized for <=15 vehicles in a 15 m box; a 110 m 1000-agent
@@ -168,7 +171,8 @@ def _formations_for_trial(cfg: TrialConfig, seed: int
     if m:
         return formgen.generate_specs(
             int(m.group(1)), seed=seed, l=cfg.sim_l, w=cfg.sim_w,
-            h=cfg.sim_h, min_dist=cfg.sim_min_dist, k=cfg.sim_formations)
+            h=cfg.sim_h, min_dist=cfg.sim_min_dist, k=cfg.sim_formations,
+            fc=cfg.sim_fc)
     return formlib.load_group(cfg.library, cfg.formation)
 
 
@@ -299,8 +303,14 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
         if pending_dispatch is not None and not fsm.done:
             spec = specs[pending_dispatch]
             if pending_dispatch not in gains_cache:
-                bucket = max(n - 4, 1) if _SIMFORM.match(cfg.formation) \
-                    else None
+                # fc graphs have exactly zero non-edges: a 1-slot bucket
+                # avoids padding n-4 dead constraint slots into the solve
+                if not _SIMFORM.match(cfg.formation):
+                    bucket = None
+                elif cfg.sim_fc:
+                    bucket = 1
+                else:
+                    bucket = max(n - 4, 1)
                 g = _gains_for(spec, bucket)
                 if cfg.gain_scale is not None:
                     g = g * cfg.gain_scale
