@@ -7,6 +7,8 @@
 - ``transport`` — host-local channels over the native shared-memory ring.
 - ``planner``   — the `backend=tpu` coordination stack driven purely
   through wire messages.
+- ``ros_bridge``— the `aclswarm_msgs` ROS adapter node (rospy injected;
+  `ros_fakes` supplies the CI stand-ins with the real field layouts).
 
 The planner (which pulls in jax and the sim engine) is exposed lazily so
 lightweight bridge/recorder processes can import the codec, messages, and

@@ -47,6 +47,8 @@ CONFIGS = [
     ("swarm6_sparse_cbaa_flooded",
      dict(formation="swarm6_sparse", assignment="cbaa",
           localization="flooded"), 10, 1),
+    # mid-size shipped group on the grid-with-diagonals sparse graph
+    ("grid9", dict(formation="grid9"), 10, 1),
     # parity with the reference's largest committed group (mitacl15):
     # 15 agents, 3 formations, sparse 33-edge graph, precalc'd gains
     ("swarm15", dict(formation="swarm15"), 10, 1),
