@@ -198,6 +198,21 @@ class TestShardedFloodedLocalization:
                                    np.asarray(ref_state.loc.est),
                                    atol=1e-12)
 
+        # the phased flood (flood_phases=2) under the same mesh: the
+        # stripe's dynamic_slice/update along the TARGET axis must not
+        # disturb the owning-agent sharding — bit parity again
+        cfg_p = sim.SimConfig(assignment="cbaa", localization="flooded",
+                              dynamics="firstorder", flood_phases=2)
+        ref_p, _ = sim.rollout(state, formation, ControlGains(),
+                               SafetyParams(), cfg_p, 300)
+        roll_p = sharded_rollout_fn(mesh, f_sh, ControlGains(),
+                                    SafetyParams(), cfg_p, 300)
+        sh_p, _ = roll_p(st_sh)
+        np.testing.assert_allclose(np.asarray(sh_p.swarm.q),
+                                   np.asarray(ref_p.swarm.q), atol=1e-12)
+        np.testing.assert_allclose(np.asarray(sh_p.loc.est),
+                                   np.asarray(ref_p.loc.est), atol=1e-12)
+
 
 class TestMultihost:
     def test_single_process_degenerate(self):
