@@ -201,6 +201,12 @@ def flood(table: EstimateTable, comm: jnp.ndarray,
     best = best_packed >> 16                # (n, w) freshest neighbor age
     src = best_packed & jnp.int32(0xFFFF)
     take = best < jnp.minimum(age_t, AGE_CAP)  # strictly newer wins
+    # NOTE (perf): this winner-position gather is the flood's second cost
+    # center after the merge (the (n, w) cross-row gather does not fuse
+    # as well as the min reduction). Keeping the positions out of the
+    # packed min is still the right trade — payload-through-min needs a
+    # per-chunk in-kernel gather with the same access pattern — and the
+    # phased mode (`tick_phased`) already bounds the per-tick total.
     est_new = jnp.take_along_axis(
         est_t, src[:, :, None].astype(jnp.int32), axis=0)  # est[src[v,j], j]
     # take_along_axis over axis 0 with index (n, w, 1) broadcasts the last
