@@ -45,14 +45,25 @@ def record(path: str, metrics, dt: float = 0.01, **meta) -> str:
 
 
 class Recording:
-    """A loaded bag; attribute access mirrors `StepMetrics`."""
+    """A loaded bag; attribute access mirrors `StepMetrics`.
+
+    Accepts a recording ``.npz`` (written by `record()`) or an actual
+    rosbag ``.bag`` from a hardware flight — the latter is ingested by
+    the pure-Python reader (`harness.rosbag1.bag_to_recording`,
+    `readACLBag.m`/`review_bag.py` parity) and resampled onto the
+    reviewer's 50 Hz grid."""
 
     def __init__(self, path: str):
-        data = np.load(path)
+        if str(path).endswith(".bag"):
+            from aclswarm_tpu.harness import rosbag1
+            data = rosbag1.bag_to_recording(path)
+        else:
+            data = np.load(path)
         for f in _FIELDS:
             setattr(self, f, data[f])
         self.dt = float(data["dt"])
-        self.meta = {k[5:]: data[k] for k in data.files
+        files = data.files if hasattr(data, "files") else data.keys()
+        self.meta = {k[5:]: data[k] for k in files
                      if k.startswith("meta_")}
 
     @property
@@ -155,7 +166,8 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Replay a recorded rollout through the trial "
                     "supervisor FSM (the review_bag.py analogue).")
-    ap.add_argument("path", help="recording .npz written by record()")
+    ap.add_argument("path", help="recording .npz written by record(), or "
+                                 "a hardware .bag (rosbag v2.0)")
     ap.add_argument("--formations", type=int, default=1)
     ap.add_argument("--trial-timeout", type=float, default=None)
     ap.add_argument("--interactive", action="store_true",
@@ -167,9 +179,12 @@ def main(argv=None):
     args = ap.parse_args(argv)
     gate = None
     if args.interactive:
-        # read only the dt scalar — Recording materializes every array,
-        # which review() is about to do anyway
-        dt = float(np.load(args.path)["dt"])
+        if args.path.endswith(".bag"):
+            dt = 0.02        # the bag resampler's reviewer-rate grid
+        else:
+            # read only the dt scalar — Recording materializes every
+            # array, which review() is about to do anyway
+            dt = float(np.load(args.path)["dt"])
         gate = stdin_gate(dt, args.gate_period)
     fsm = review(args.path, n_formations=args.formations,
                  trial_timeout=args.trial_timeout, verbose=True,

@@ -1,0 +1,492 @@
+"""Pure-Python rosbag (v2.0) ingestion for the hardware-bag reviewer.
+
+The reference reviews real flight recordings by playing a `.bag` through
+`review_bag.py`'s metric FSM (`aclswarm/nodes/review_bag.py:80-100`
+subscribes `/<veh>/world`, `/<veh>/safety/status`, `/<veh>/assignment`,
+`/formation`; `launch/review.launch` wires `rosbag play`), and MATLAB
+analysis reads bags directly (`aclswarm_sim/matlab/readACLBag.m:1-30`).
+This module gives the TPU framework the same capability without ROS: a
+self-contained rosbag1 format reader (records, connections, chunks with
+none/bz2 compression) plus hand-rolled deserializers for the exact
+message types the aclswarm topics carry, and `bag_to_recording()` which
+resamples the topic streams onto the reviewer's 50 Hz tick grid
+(`review_bag.py` `tick_rate = 50`) as a `harness.review` recording — so
+`review()` / `--analyze` score a hardware bag with the same FSM oracle
+that scores sim rollouts.
+
+A minimal writer (single chunk, uncompressed) is included so CI can
+fabricate fixture bags through the same serializers the reader decodes
+— and so fieldwork can convert npz recordings back into bags for ROS
+tooling.
+
+Format reference: the rosbag v2.0 container is records of
+``header_len(u32) header data_len(u32) data`` where the header is a
+field list (``len(u32) name=value``); op=0x03 bag header, 0x05 chunk,
+0x07 connection, 0x02 message data, 0x04/0x06 index (skipped — the
+reader scans chunks linearly). All integers little-endian.
+"""
+from __future__ import annotations
+
+import bz2
+import struct
+from pathlib import Path
+from typing import Iterator, NamedTuple, Optional
+
+import numpy as np
+
+MAGIC = b"#ROSBAG V2.0\n"
+
+OP_MSG = 0x02
+OP_BAG_HEADER = 0x03
+OP_INDEX = 0x04
+OP_CHUNK = 0x05
+OP_CHUNK_INFO = 0x06
+OP_CONNECTION = 0x07
+
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+
+# ---------------------------------------------------------------------------
+# low-level record plumbing
+# ---------------------------------------------------------------------------
+
+def _pack_header(fields: dict[str, bytes]) -> bytes:
+    out = b""
+    for name, value in fields.items():
+        entry = name.encode() + b"=" + value
+        out += _U32.pack(len(entry)) + entry
+    return out
+
+
+def _parse_header(buf: bytes) -> dict[str, bytes]:
+    fields, off = {}, 0
+    while off < len(buf):
+        (ln,) = _U32.unpack_from(buf, off)
+        off += 4
+        entry = buf[off:off + ln]
+        off += ln
+        name, _, value = entry.partition(b"=")
+        fields[name.decode()] = value
+    return fields
+
+
+def _read_record(buf: bytes, off: int) -> tuple[dict, bytes, int]:
+    (hlen,) = _U32.unpack_from(buf, off)
+    header = _parse_header(buf[off + 4:off + 4 + hlen])
+    off += 4 + hlen
+    (dlen,) = _U32.unpack_from(buf, off)
+    data = buf[off + 4:off + 4 + dlen]
+    return header, data, off + 4 + dlen
+
+
+def _time_bytes(t: float) -> bytes:
+    secs = int(t)
+    nsecs = int(round((t - secs) * 1e9))
+    return _U32.pack(secs) + _U32.pack(nsecs)
+
+
+def _time_from(b: bytes) -> float:
+    secs, nsecs = struct.unpack("<II", b)
+    return secs + nsecs * 1e-9
+
+
+# ---------------------------------------------------------------------------
+# message (de)serializers — the aclswarm topic family
+# ---------------------------------------------------------------------------
+# ROS1 serialization: little-endian, strings = u32 len + bytes, Header =
+# seq(u32) stamp(2xu32) frame_id(string), float64 fields packed raw.
+
+def _ser_string(s: str) -> bytes:
+    b = s.encode()
+    return _U32.pack(len(b)) + b
+
+
+def _des_string(buf: bytes, off: int) -> tuple[str, int]:
+    (ln,) = _U32.unpack_from(buf, off)
+    return buf[off + 4:off + 4 + ln].decode(), off + 4 + ln
+
+
+def _ser_rosheader(stamp: float, frame_id: str = "", seq: int = 0) -> bytes:
+    return _U32.pack(seq) + _time_bytes(stamp) + _ser_string(frame_id)
+
+
+def _des_rosheader(buf: bytes, off: int) -> tuple[float, str, int]:
+    stamp = _time_from(buf[off + 4:off + 12])
+    frame_id, off2 = _des_string(buf, off + 12)
+    return stamp, frame_id, off2
+
+
+def ser_pose_stamped(stamp: float, pos, quat=(0.0, 0.0, 0.0, 1.0),
+                     frame_id: str = "world") -> bytes:
+    """geometry_msgs/PoseStamped (the `/<veh>/world` topic)."""
+    return (_ser_rosheader(stamp, frame_id)
+            + struct.pack("<3d", *[float(x) for x in pos])
+            + struct.pack("<4d", *[float(x) for x in quat]))
+
+
+def des_pose_stamped(buf: bytes) -> tuple[float, np.ndarray]:
+    stamp, _, off = _des_rosheader(buf, 0)
+    pos = np.frombuffer(buf, np.float64, 3, off)
+    return stamp, pos
+
+
+def ser_vector3_stamped(stamp: float, vec, frame_id: str = "") -> bytes:
+    """geometry_msgs/Vector3Stamped (the `distcmd` topic)."""
+    return (_ser_rosheader(stamp, frame_id)
+            + struct.pack("<3d", *[float(x) for x in vec]))
+
+
+def des_vector3_stamped(buf: bytes) -> tuple[float, np.ndarray]:
+    stamp, _, off = _des_rosheader(buf, 0)
+    return stamp, np.frombuffer(buf, np.float64, 3, off)
+
+
+def ser_safety_status(stamp: float, ca_active: bool) -> bytes:
+    """aclswarm_msgs/SafetyStatus (`SafetyStatus.msg:1-5`: Header +
+    bool collision_avoidance_active)."""
+    return _ser_rosheader(stamp) + bytes([1 if ca_active else 0])
+
+
+def des_safety_status(buf: bytes) -> tuple[float, bool]:
+    stamp, _, off = _des_rosheader(buf, 0)
+    return stamp, bool(buf[off])
+
+
+def ser_uint8_multiarray(data) -> bytes:
+    """std_msgs/UInt8MultiArray as the coordination node publishes the
+    `assignment` topic (`coordination_ros.cpp:293-297`): empty layout,
+    bare data vector. Raises on values that would wrap (> 255) — use
+    `ser_int32_multiarray` for wide assignments."""
+    data = np.asarray(data)
+    if data.size and (data.min() < 0 or data.max() > 255):
+        raise ValueError("values do not fit uint8; use "
+                         "ser_int32_multiarray for n > 255 assignments")
+    arr = data.astype(np.uint8)
+    return (_U32.pack(0)          # layout.dim: empty array
+            + _U32.pack(0)        # layout.data_offset
+            + _U32.pack(arr.size) + arr.tobytes())
+
+
+def _des_multiarray(buf: bytes, dtype) -> np.ndarray:
+    (ndims,) = _U32.unpack_from(buf, 0)
+    off = 4
+    for _ in range(ndims):        # label(string) size(u32) stride(u32)
+        _, off = _des_string(buf, off)
+        off += 8
+    off += 4                      # data_offset
+    (ln,) = _U32.unpack_from(buf, off)
+    return np.frombuffer(buf, dtype, ln, off + 4).copy()
+
+
+def des_uint8_multiarray(buf: bytes) -> np.ndarray:
+    return _des_multiarray(buf, np.uint8)
+
+
+def ser_int32_multiarray(data) -> bytes:
+    """std_msgs/Int32MultiArray — the adapter's wide assignment wire for
+    n > 255 (`ros_bridge.assignment_to_ros(wide=True)`); uint8 would wrap
+    indices >= 256 into duplicate entries."""
+    arr = np.asarray(data, np.int32)
+    if np.any(arr != np.asarray(data)):
+        raise ValueError("assignment indices do not fit int32")
+    return (_U32.pack(0) + _U32.pack(0)
+            + _U32.pack(arr.size) + arr.astype("<i4").tobytes())
+
+
+def des_int32_multiarray(buf: bytes) -> np.ndarray:
+    return _des_multiarray(buf, "<i4").astype(np.int32)
+
+
+MSG_TYPES = {
+    "geometry_msgs/PoseStamped": des_pose_stamped,
+    "geometry_msgs/Vector3Stamped": des_vector3_stamped,
+    "aclswarm_msgs/SafetyStatus": des_safety_status,
+    "std_msgs/UInt8MultiArray": des_uint8_multiarray,
+    "std_msgs/Int32MultiArray": des_int32_multiarray,
+}
+
+
+# ---------------------------------------------------------------------------
+# reader
+# ---------------------------------------------------------------------------
+
+class BagMessage(NamedTuple):
+    topic: str
+    msgtype: str
+    time: float          # record (receive) time
+    raw: bytes           # serialized message body
+
+
+def read_bag(path) -> Iterator[BagMessage]:
+    """Iterate every message record in a rosbag v2.0 file, in file order.
+
+    Scans chunks linearly (index records are skipped), decompressing
+    `none` and `bz2` chunk encodings. Connections may appear before their
+    messages in the same chunk or in the index section — both are
+    handled."""
+    buf = Path(path).read_bytes()
+    if not buf.startswith(MAGIC):
+        raise ValueError(f"{path}: not a rosbag v2.0 file")
+    conns: dict[int, tuple[str, str]] = {}   # conn id -> (topic, type)
+
+    def register_conn(header: dict, data: bytes) -> None:
+        cid = _U32.unpack(header["conn"])[0]
+        chdr = _parse_header(data)
+        conns[cid] = (chdr["topic"].decode(), chdr["type"].decode())
+
+    # pre-scan the top-level records: standard bags keep connection
+    # records in the post-chunk index section, AFTER the messages that
+    # reference them — register those up front (no chunk decompression)
+    off = len(MAGIC)
+    while off < len(buf):
+        header, data, off = _read_record(buf, off)
+        if header["op"][0] == OP_CONNECTION:
+            register_conn(header, data)
+
+    def walk(buf: bytes, off: int, end: int) -> Iterator[BagMessage]:
+        while off < end:
+            header, data, off = _read_record(buf, off)
+            op = header["op"][0]
+            if op == OP_CONNECTION:
+                register_conn(header, data)
+            elif op == OP_MSG:
+                cid = _U32.unpack(header["conn"])[0]
+                topic, mtype = conns[cid]
+                yield BagMessage(topic, mtype, _time_from(header["time"]),
+                                 data)
+            elif op == OP_CHUNK:
+                comp = header["compression"].decode()
+                if comp == "none":
+                    inner = data
+                elif comp == "bz2":
+                    inner = bz2.decompress(data)
+                else:
+                    raise ValueError(f"unsupported chunk compression "
+                                     f"{comp!r} (none/bz2 handled)")
+                yield from walk(inner, 0, len(inner))
+            # OP_BAG_HEADER / OP_INDEX / OP_CHUNK_INFO: skip
+
+    yield from walk(buf, len(MAGIC), len(buf))
+
+
+# ---------------------------------------------------------------------------
+# writer (single uncompressed chunk — fixture/export tool)
+# ---------------------------------------------------------------------------
+
+class BagWriter:
+    """Minimal rosbag v2.0 writer: every message goes into one
+    uncompressed chunk; connections are emitted inside the chunk and
+    repeated in the index section, with the bag header's index_pos
+    patched on close."""
+
+    def __init__(self, path):
+        self.path = Path(path)
+        self._conns: dict[tuple[str, str], int] = {}
+        self._chunk = bytearray()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _conn_record(self, cid: int, topic: str, msgtype: str) -> bytes:
+        chdr = _pack_header({
+            "topic": topic.encode(),
+            "type": msgtype.encode(),
+            "md5sum": b"*",               # wildcard: reader does not check
+            "message_definition": b"",
+        })
+        hdr = _pack_header({"op": bytes([OP_CONNECTION]),
+                            "conn": _U32.pack(cid),
+                            "topic": topic.encode()})
+        return (_U32.pack(len(hdr)) + hdr
+                + _U32.pack(len(chdr)) + chdr)
+
+    def write(self, topic: str, msgtype: str, t: float, raw: bytes) -> None:
+        key = (topic, msgtype)
+        if key not in self._conns:
+            cid = self._conns[key] = len(self._conns)
+            self._chunk += self._conn_record(cid, topic, msgtype)
+        hdr = _pack_header({"op": bytes([OP_MSG]),
+                            "conn": _U32.pack(self._conns[key]),
+                            "time": _time_bytes(t)})
+        self._chunk += _U32.pack(len(hdr)) + hdr
+        self._chunk += _U32.pack(len(raw)) + raw
+
+    def close(self) -> None:
+        chunk = bytes(self._chunk)
+        chunk_hdr = _pack_header({"op": bytes([OP_CHUNK]),
+                                  "compression": b"none",
+                                  "size": _U32.pack(len(chunk))})
+        chunk_rec = (_U32.pack(len(chunk_hdr)) + chunk_hdr
+                     + _U32.pack(len(chunk)) + chunk)
+        # bag header record is padded to 4096 bytes total with ASCII space
+        index_pos = len(MAGIC) + 4096 + len(chunk_rec)
+        bh = _pack_header({"op": bytes([OP_BAG_HEADER]),
+                           "index_pos": _U64.pack(index_pos),
+                           "conn_count": _U32.pack(len(self._conns)),
+                           "chunk_count": _U32.pack(1)})
+        pad = 4096 - 4 - len(bh) - 4
+        bag_header = (_U32.pack(len(bh)) + bh + _U32.pack(pad)
+                      + b" " * pad)
+        index = b"".join(self._conn_record(cid, topic, mtype)
+                         for (topic, mtype), cid in self._conns.items())
+        self.path.write_bytes(MAGIC + bag_header + chunk_rec + index)
+
+
+# ---------------------------------------------------------------------------
+# bag -> review recording
+# ---------------------------------------------------------------------------
+
+def _veh_of(topic: str, suffix: str) -> Optional[str]:
+    parts = topic.strip("/").split("/")
+    return parts[0] if len(parts) >= 2 and "/".join(parts[1:]) == suffix \
+        else None
+
+
+def bag_to_recording(bagpath, out_npz=None, dt: float = 0.02,
+                     vehs: Optional[list[str]] = None) -> dict:
+    """Resample a hardware bag's topic streams onto the reviewer's tick
+    grid and (optionally) write a `harness.review` recording npz.
+
+    Vehicle discovery follows the reference reviewer: the `<veh>/...`
+    topic prefixes (`review_bag.py:66-67` scrapes topics;
+    `readACLBag.m:6-10` regexes them). Signals:
+
+    - ``q`` from `/<veh>/world` PoseStamped, sample-and-hold;
+    - ``ca_active`` from `/<veh>/safety/status` SafetyStatus;
+    - ``distcmd_norm`` from `/<veh>/distcmd` Vector3Stamped;
+    - assignment events from the first vehicle's `/assignment`
+      UInt8MultiArray — the reviewer subscribes exactly one
+      (`review_bag.py:95-97`); every received message marks an auctioned+
+      valid tick (hardware only ever publishes accepted assignments),
+      `reassigned` when the permutation changed.
+
+    ``dt`` defaults to 0.02 s — the reviewer's 50 Hz FSM tick
+    (`review_bag.py` `tick_rate = 50`).
+    """
+    streams: dict[str, list] = {}
+    for msg in read_bag(bagpath):
+        des = MSG_TYPES.get(msg.msgtype)
+        if des is None:
+            continue
+        streams.setdefault(msg.topic, []).append((msg.time, des(msg.raw)))
+
+    if vehs is None:
+        vehs = sorted({v for t in streams
+                       if (v := _veh_of(t, "world")) is not None})
+    if not vehs:
+        raise ValueError(f"{bagpath}: no /<veh>/world pose streams found")
+    n = len(vehs)
+
+    t0 = min(t for series in streams.values() for t, _ in series)
+    t1 = max(t for series in streams.values() for t, _ in series)
+    ticks = max(2, int(np.ceil((t1 - t0) / dt)) + 1)
+    grid = t0 + dt * np.arange(ticks)
+
+    def hold(series, default, extract=lambda v: v):
+        """Sample-and-hold a stamped series onto the tick grid (the value
+        in force at each tick; ``default`` before the first message)."""
+        default = np.asarray(default)
+        out = np.broadcast_to(default,
+                              (ticks,) + default.shape).copy()
+        if not series:
+            return out
+        times = np.asarray([t for t, _ in series])
+        # 1 us slack: stamps are ns-quantized on the wire, so a message
+        # nominally ON a tick boundary must still belong to that tick
+        idx = np.searchsorted(times, grid + 1e-6, side="right") - 1
+        vals = [extract(v) for _, v in series]
+        for k in range(ticks):
+            if idx[k] >= 0:
+                out[k] = vals[idx[k]]
+        return out
+
+    q = np.zeros((ticks, n, 3))
+    ca = np.zeros((ticks, n), bool)
+    dn = np.zeros((ticks, n))
+    for i, veh in enumerate(vehs):
+        poses = streams.get(f"/{veh}/world", [])
+        if not poses:
+            raise ValueError(f"{bagpath}: vehicle {veh} has no world poses")
+        q[:, i, :] = hold(poses, np.zeros(3), extract=lambda v: v[1])
+        ca[:, i] = hold(streams.get(f"/{veh}/safety/status", []), False,
+                        extract=lambda v: v[1])
+        dn[:, i] = hold(streams.get(f"/{veh}/distcmd", []), 0.0,
+                        extract=lambda v: float(np.linalg.norm(v[1])))
+
+    auctioned = np.zeros(ticks, bool)
+    reassigned = np.zeros(ticks, bool)
+    v2f = np.tile(np.arange(n, dtype=np.int32), (ticks, 1))
+    asn_series = streams.get(f"/{vehs[0]}/assignment", [])
+    prev = None
+    for t, perm in asn_series:
+        k = min(ticks - 1, max(0, int(round((t - t0) / dt))))
+        auctioned[k] = True
+        perm = np.asarray(perm, np.int32)
+        if prev is None or not np.array_equal(perm, prev):
+            reassigned[k] = True
+        prev = perm
+        if perm.size == n:
+            v2f[k:] = perm[None, :]
+
+    rec = {
+        "q": q,
+        "distcmd_norm": dn,
+        "ca_active": ca,
+        "reassigned": reassigned,
+        "auctioned": auctioned,
+        "assign_valid": auctioned.copy(),   # bags carry accepted ones only
+        "mode": np.zeros((ticks, n), np.int32),
+        "v2f": v2f,
+        "dt": np.asarray(dt),
+        "meta_source_bag": np.asarray(str(bagpath)),
+    }
+    if out_npz is not None:
+        np.savez_compressed(out_npz, **rec)
+    return rec
+
+
+def recording_to_bag(npz_path, bag_path, vehs: Optional[list[str]] = None,
+                     pose_every: int = 1) -> str:
+    """Export a `harness.review` npz recording as a rosbag (the writer's
+    field use-case: hand a TPU-framework rollout to ROS tooling —
+    `rosbag play` + rviz, `readACLBag.m`)."""
+    data = np.load(npz_path)
+    q = data["q"]
+    ticks, n = q.shape[0], q.shape[1]
+    dt = float(data["dt"])
+    if vehs is None:
+        vehs = [f"SQ{i + 1:02d}s" for i in range(n)]
+    ca = data["ca_active"]
+    dn = data["distcmd_norm"]
+    auctioned = data["auctioned"]
+    valid = data["assign_valid"]
+    v2f = data["v2f"]
+    with BagWriter(bag_path) as bag:
+        for k in range(0, ticks, pose_every):
+            t = k * dt
+            for i, veh in enumerate(vehs):
+                bag.write(f"/{veh}/world", "geometry_msgs/PoseStamped", t,
+                          ser_pose_stamped(t, q[k, i]))
+                bag.write(f"/{veh}/safety/status",
+                          "aclswarm_msgs/SafetyStatus", t,
+                          ser_safety_status(t, bool(ca[k, i])))
+                # the bag carries a synthesized unit-direction distcmd of
+                # the recorded magnitude (the npz keeps only the norm)
+                vec = np.array([dn[k, i], 0.0, 0.0])
+                bag.write(f"/{veh}/distcmd",
+                          "geometry_msgs/Vector3Stamped", t,
+                          ser_vector3_stamped(t, vec))
+            if bool(auctioned[k]) and bool(valid[k]):
+                if n > 255:   # uint8 would wrap indices into duplicates
+                    bag.write(f"/{vehs[0]}/assignment",
+                              "std_msgs/Int32MultiArray", t,
+                              ser_int32_multiarray(v2f[k]))
+                else:
+                    bag.write(f"/{vehs[0]}/assignment",
+                              "std_msgs/UInt8MultiArray", t,
+                              ser_uint8_multiarray(v2f[k]))
+    return str(bag_path)

@@ -57,13 +57,24 @@ class PlannerOutput:
 @partial(jax.jit, static_argnames=("cfg",))
 def _tick(swarm: SwarmState, formation: DevFormation, v2f: jnp.ndarray,
           cgains: ControlGains, sparams: SafetyParams,
-          do_assign: jnp.ndarray, first: jnp.ndarray, cfg):
+          do_assign: jnp.ndarray, first: jnp.ndarray, cfg,
+          est: Optional[jnp.ndarray] = None):
     new_v2f, valid = jax.lax.cond(
         do_assign,
-        lambda s, f, p: engine.assign(s, f, p, cfg, first=first),
+        lambda s, f, p: engine.assign(s, f, p, cfg, est, first=first),
         lambda s, f, p: (p, jnp.asarray(True)),
         swarm, formation, v2f)
-    u = control.compute(swarm, formation, new_v2f, cgains)
+    if est is None:
+        rel = None
+    else:
+        # per-vehicle relative views from the estimate tables: rel[v, w] =
+        # v's estimate of (w's position − its own) — what the reference's
+        # control law receives from its own localization node
+        # (`coordination_ros.cpp:240-250`), see `localization.relative_views`
+        n = est.shape[0]
+        own = est[jnp.arange(n), jnp.arange(n)]
+        rel = est - own[:, None, :]
+    u = control.compute(swarm, formation, new_v2f, cgains, rel=rel)
     # safety stage over the raw distcmd: saturate then the VO check — the
     # per-vehicle safety node's ca-active signal (`safety.cpp:503`),
     # computed here so the wire carries `SafetyStatus` per tick. The
@@ -95,6 +106,10 @@ class TpuPlanner:
       the commit settles; invalid auctions are skipped, keeping the old
       assignment (`auctioneer.cpp:283-292`).
     """
+
+    # capability probe for adapters: tick() takes the (n, n, 3) per-vehicle
+    # estimate table (the ShmPlannerClient's wire does not)
+    accepts_est = True
 
     def __init__(self, n: int, assignment: str = "auction",
                  assign_every: int = 120,
@@ -201,12 +216,23 @@ class TpuPlanner:
         self._central_rcvd = False
 
     # -- per-tick boundary ------------------------------------------------
-    def tick(self, estimates, vel: Optional[np.ndarray] = None
-             ) -> PlannerOutput:
+    def tick(self, estimates, vel: Optional[np.ndarray] = None,
+             est: Optional[np.ndarray] = None) -> PlannerOutput:
         """One control tick. ``estimates`` is a `VehicleEstimates` message
         (or a plain (n, 3) position array); ``vel`` the vehicles' own
         velocities (zeros when not provided — the damping term then drops,
-        as when the reference's twist feed is absent)."""
+        as when the reference's twist feed is absent).
+
+        ``est`` (optional, (n, n, 3)) is the batched per-vehicle estimate
+        table — row v = vehicle v's full `vehicle_estimates` vector from
+        its own localization flood. When present, control consumes each
+        vehicle's OWN (stale, flood-propagated) relative views and a CBAA
+        auction aligns on them — the reference coordination node's actual
+        information model (`coordination_ros.cpp:240-250` feeds `q_` from
+        `vehicle_estimates`); `estimates` should then carry the diagonal
+        (each vehicle's autopilot self-state). Without it, every consumer
+        sees the fused array — the centralized-comparison information
+        model."""
         if self.formation is None or self.killed:
             # no formation committed (`coordination_ros.cpp:102-106` zeros
             # the cmd on commit gaps) or e-stopped: zero command, hold
@@ -232,11 +258,15 @@ class TpuPlanner:
                 self._central_rcvd = False
                 adopted_central = True
             do_assign = False
+        est_j = None if est is None else jnp.asarray(est)
+        if est_j is not None and est_j.shape != (self.n, self.n, 3):
+            raise ValueError(f"est shape {est_j.shape} != "
+                             f"{(self.n, self.n, 3)}")
         u, new_v2f, valid, ca = _tick(swarm, self.formation, self.v2f,
                                       self.cgains, self.sparams,
                                       jnp.asarray(do_assign),
                                       jnp.asarray(self._await_first_accept),
-                                      self.cfg)
+                                      self.cfg, est=est_j)
         self._ticks_since_commit += 1
         # an adoption is published unconditionally (`newAssignmentCb`,
         # `coordination_ros.cpp:284-304`); a device auction publishes on

@@ -201,16 +201,27 @@ def assignment_from_ros(msg) -> np.ndarray:
     return _as_array(msg.data).astype(np.int32)
 
 
-def assignment_to_ros(perm: np.ndarray, msgs):
+def assignment_to_ros(perm: np.ndarray, msgs, wide: bool = False):
     """(n,) permutation -> std_msgs/UInt8MultiArray exactly as the
     coordination node publishes it (`newAssignmentCb`,
     `coordination_ros.cpp:293-297`: flat data, empty layout). n > 255
-    does not fit uint8 — the reference shares this wire limit; the shm
-    wire (`interop.codec`) is the int32-clean path at scale."""
+    does not fit uint8 — the reference shares this wire limit (its
+    `vehidx_t` is uint8, `utils.h:25`).
+
+    ``wide=True`` encodes an Int32MultiArray instead (same flat-data
+    convention) so the adapter carries the flagship n > 255 scale on the
+    ROS wire; consumers must opt into the widened type (the C++ reference
+    nodes decode uint8 only). The shm wire (`interop.codec`) is int32-
+    clean either way."""
     perm = np.asarray(perm)
+    if wide:
+        msg = msgs.Int32MultiArray()
+        msg.data = [int(v) for v in perm]
+        return msg
     if perm.size and int(perm.max()) > 255:
         raise ValueError("UInt8MultiArray assignment cannot carry indices "
-                         "> 255; use the shm wire for n > 256 swarms")
+                         "> 255; use wide=True (Int32MultiArray) or the "
+                         "shm wire for n > 256 swarms")
     msg = msgs.UInt8MultiArray()
     msg.data = [int(v) for v in perm]
     return msg
@@ -254,7 +265,14 @@ class ShmPlannerClient:
     the jitted planner. The ROS node's `step()` then costs one shm
     round-trip (~10 us/message on the SPSC rings) instead of a device
     dispatch. Same channels as the daemon serves (see `interop.bridge`).
+
+    The estimate frames on this wire are (n, 3) self-estimates, so the
+    per-vehicle (n, n, 3) information model cannot ride it — the adapter
+    falls back to the fused model (documented divergence, see
+    `TpuCoordinationNode`).
     """
+
+    accepts_est = False
 
     def __init__(self, n: int, ns: str = "/asw",
                  central_assignment: bool = False,
@@ -373,18 +391,30 @@ class TpuCoordinationNode:
     split: callbacks stash `newformation_`, `spin()` commits it
     (`coordination_ros.cpp:94-160`).
 
-    State feed: each vehicle's own localization flood
-    (`<veh>/vehicle_estimates`) carries a full n-vector; the batched
-    planner consumes one swarm state, so the node takes each vehicle's
-    self-estimate — entry v of vehicle v's vector, which is its autopilot
-    state (`localization_ros.cpp:101-110`), the same signal the
-    per-vehicle coordination node trusts for `q_[v]`.
+    State feed (``information_model``): each vehicle's own localization
+    flood (`<veh>/vehicle_estimates`) carries a full n-vector.
+
+    - ``"perveh"`` (default, the faithful model): the node keeps every
+      vehicle's whole vector as one (n, n, 3) table and hands it to the
+      planner, so vehicle v's distcmd is computed from v's OWN (stale,
+      flood-propagated) estimates — exactly what the reference
+      coordination node consumes (`coordination_ros.cpp:240-250`). The
+      batched state `q` is the table's diagonal (each vehicle's autopilot
+      self-state, `localization_ros.cpp:101-110`).
+    - ``"fused"``: only the self-estimates feed a shared state that every
+      consumer sees — the centralized information model (better than the
+      reference under degraded localization; NOT a like-for-like swap).
+      Forced when the planner cannot carry the table (the shm wire's
+      `ShmPlannerClient` — its estimate frames are (n, 3)).
     """
 
     def __init__(self, rospy, msgs, vehs: Optional[Sequence[str]] = None,
                  planner=None, assignment: str = "auction",
                  assign_every: int = 120,
-                 central_assignment: Optional[bool] = None):
+                 central_assignment: Optional[bool] = None,
+                 information_model: str = "perveh",
+                 wide_assignment: Optional[bool] = None,
+                 viz: bool = False):
         self.rospy = rospy
         self.msgs = msgs
         from aclswarm_tpu.core.registry import make_registry
@@ -405,11 +435,27 @@ class TpuCoordinationNode:
         central_assignment = getattr(planner, "central_assignment",
                                      central_assignment)
         self.planner = planner
+        if information_model not in ("perveh", "fused"):
+            raise ValueError(f"unknown information_model "
+                             f"{information_model!r}")
+        self._use_est = (information_model == "perveh"
+                         and getattr(planner, "accepts_est", False))
+        if information_model == "perveh" and not self._use_est:
+            rospy.logwarn("planner cannot carry per-vehicle estimate "
+                          "tables; falling back to the fused information "
+                          "model (see class docstring)")
+        # n > 255 cannot ride the reference's UInt8MultiArray wire
+        # (`utils.h:25` vehidx_t); auto-widen to Int32MultiArray
+        self.wide_assignment = (n > 255 if wide_assignment is None
+                                else bool(wide_assignment))
         self._lock = threading.Lock()
         self._pending_formation = None
         self._pending_modes: list = []
         self._pending_central: Optional[np.ndarray] = None
         self._q = np.zeros((n, 3))
+        # (n, n, 3) only when the per-vehicle model actually consumes it —
+        # at n=1000 the table is 24 MB with a 24 KB row copy per callback
+        self._est = np.zeros((n, n, 3)) if self._use_est else None
         self._seen = np.zeros(n, dtype=bool)
         self.ticks = 0
 
@@ -419,10 +465,17 @@ class TpuCoordinationNode:
                          self._mode_cb, queue_size=1)
         if central_assignment:
             rospy.logwarn("Expecting centralized assignment. Cheater!")
-            rospy.Subscriber("/central_assignment", msgs.UInt8MultiArray,
+            # the push must ride the same width as the assignment wire:
+            # uint8 wraps indices >= 256 into duplicates the permutation
+            # guard would reject on every adoption attempt
+            central_type = (msgs.Int32MultiArray if self.wide_assignment
+                            else msgs.UInt8MultiArray)
+            rospy.Subscriber("/central_assignment", central_type,
                              self._central_cb, queue_size=1)
         self._pub_cmd = []
         self._pub_asn = []
+        asn_type = (msgs.Int32MultiArray if self.wide_assignment
+                    else msgs.UInt8MultiArray)
         for i, veh in enumerate(vehs):
             rospy.Subscriber(f"/{veh}/vehicle_estimates",
                              msgs.VehicleEstimates, self._estimates_cb,
@@ -430,7 +483,17 @@ class TpuCoordinationNode:
             self._pub_cmd.append(rospy.Publisher(
                 f"/{veh}/distcmd", msgs.Vector3Stamped, queue_size=1))
             self._pub_asn.append(rospy.Publisher(
-                f"/{veh}/assignment", msgs.UInt8MultiArray, queue_size=1))
+                f"/{veh}/assignment", asn_type, queue_size=1))
+        self.viz = None
+        if viz:
+            from aclswarm_tpu.interop.viz_markers import VizMarkers
+            self.viz = VizMarkers(rospy, msgs, vehs)
+            sp = getattr(planner, "sparams", None)
+            if sp is not None:
+                lo, hi = np.asarray(sp.bounds_min), np.asarray(sp.bounds_max)
+                self.viz.publish_room_bounds(float(lo[0]), float(hi[0]),
+                                             float(lo[1]), float(hi[1]),
+                                             float(hi[2]))
 
     # -- callbacks: record only --------------------------------------------
 
@@ -454,6 +517,8 @@ class TpuCoordinationNode:
         est = estimates_from_ros(msg, n=len(self.vehs))
         with self._lock:
             self._q[vehid] = est.positions[vehid]   # self-estimate
+            if self._use_est:
+                self._est[vehid] = est.positions    # v's whole flood table
             self._seen[vehid] = True
 
     # -- the control tick --------------------------------------------------
@@ -470,13 +535,21 @@ class TpuCoordinationNode:
             central = self._pending_central
             self._pending_central = None
             q = self._q.copy()
+            est = self._est.copy() if self._use_est else None
             ready = bool(self._seen.all())
         for mode in modes:
             self.planner.handle_flightmode(mode)
         if fm is not None:
-            # commit (incl. on-demand gain solve); the reference zeroes
-            # distcmd while committing (`coordination_ros.cpp:102-106`) —
-            # here the timer simply publishes nothing during the solve
+            # the reference zeroes distcmd and stops timers before a
+            # commit so vehicles hold still through a (possibly long)
+            # on-demand gain solve (`coordination_ros.cpp:102-106`); the
+            # single-timer node publishes one explicit zero to every
+            # vehicle before blocking on the solve
+            zero = np.zeros(3)
+            stamp0 = self.rospy.Time.now()
+            for v, pub in enumerate(self._pub_cmd):
+                pub.publish(distcmd_to_ros(zero, self.msgs, stamp=stamp0,
+                                           frame_id=self.vehs[v]))
             self.planner.handle_formation(fm)
             self.rospy.loginfo("committed formation %r", fm.name)
         if central is not None:
@@ -484,16 +557,28 @@ class TpuCoordinationNode:
                 self.rospy.logwarn("rejected malformed central assignment")
         if not ready:
             return None    # not every vehicle has reported yet
-        out = self.planner.tick(q)
+        out = (self.planner.tick(q, est=est) if self._use_est
+               else self.planner.tick(q))
         stamp = self.rospy.Time.now()
         for v, pub in enumerate(self._pub_cmd):
             pub.publish(distcmd_to_ros(out.distcmd[v], self.msgs,
                                        stamp=stamp,
                                        frame_id=self.vehs[v]))
         self.ticks += 1
+        if self.viz is not None:
+            # the aligned-formation spheres need the committed formation +
+            # assignment; a planner behind a wire (ShmPlannerClient) does
+            # not expose them — arrows and meshes still draw
+            formation = getattr(self.planner, "formation", None)
+            v2f = getattr(self.planner, "v2f", None)
+            self.viz.tick(
+                q, out.distcmd,
+                None if formation is None else np.asarray(formation.points),
+                None if v2f is None else np.asarray(v2f))
         if out.assignment is None:
             return None
-        asn = assignment_to_ros(out.assignment, self.msgs)
+        asn = assignment_to_ros(out.assignment, self.msgs,
+                                wide=self.wide_assignment)
         for pub in self._pub_asn:
             pub.publish(asn)
         return m.Assignment(header=m.Header(stamp=stamp.to_sec()
@@ -518,11 +603,13 @@ def main(argv=None):  # pragma: no cover - requires a live ROS graph
         import rospy
         from aclswarm_msgs.msg import (CBAA, Formation, SafetyStatus,
                                        VehicleEstimates)
-        from geometry_msgs.msg import (Point, PointStamped, Vector3,
-                                       Vector3Stamped)
+        from geometry_msgs.msg import (Point, PointStamped, Pose,
+                                       Quaternion, Vector3, Vector3Stamped)
         from snapstack_msgs.msg import QuadFlightMode
-        from std_msgs.msg import (Float32MultiArray, Header,
-                                  MultiArrayDimension, UInt8MultiArray)
+        from std_msgs.msg import (ColorRGBA, Float32MultiArray, Header,
+                                  Int32MultiArray, MultiArrayDimension,
+                                  UInt8MultiArray)
+        from visualization_msgs.msg import Marker, MarkerArray
     except ImportError as e:
         raise SystemExit(
             f"ros_bridge.main needs a sourced ROS workspace with "
@@ -532,9 +619,10 @@ def main(argv=None):  # pragma: no cover - requires a live ROS graph
         pass
 
     for cls in (CBAA, Formation, SafetyStatus, VehicleEstimates, Point,
-                PointStamped, Vector3, Vector3Stamped, QuadFlightMode,
-                Float32MultiArray, Header, MultiArrayDimension,
-                UInt8MultiArray):
+                PointStamped, Pose, Quaternion, Vector3, Vector3Stamped,
+                QuadFlightMode, ColorRGBA, Float32MultiArray, Header,
+                Int32MultiArray, MultiArrayDimension, UInt8MultiArray,
+                Marker, MarkerArray):
         setattr(Msgs, cls.__name__, cls)
 
     import argparse
@@ -542,6 +630,17 @@ def main(argv=None):  # pragma: no cover - requires a live ROS graph
     ap.add_argument("--assignment", default="auction")
     ap.add_argument("--assign-every", type=int, default=120)
     ap.add_argument("--control-dt", type=float, default=0.01)
+    ap.add_argument("--information-model", choices=("perveh", "fused"),
+                    default="perveh",
+                    help="perveh = each vehicle's own flood table feeds "
+                         "its control (the reference model); fused = "
+                         "shared self-estimate state")
+    ap.add_argument("--wide-assignment", action="store_true", default=None,
+                    help="publish Int32MultiArray assignments (auto when "
+                         "n > 255; reference C++ nodes decode uint8 only)")
+    ap.add_argument("--viz", action="store_true",
+                    help="publish rviz MarkerArrays (viz_dist_cmd, "
+                         "viz_central_alignment, viz_mesh, room bounds)")
     ap.add_argument("--backend", choices=("inproc", "shm"),
                     default="inproc",
                     help="inproc = this node owns the device planner; "
@@ -566,7 +665,9 @@ def main(argv=None):  # pragma: no cover - requires a live ROS graph
             rospy.logwarn("central-assignment mode: the planner daemon "
                           "must also run with --central-assignment")
     run(rospy, Msgs, control_dt=args.control_dt, planner=planner,
-        assignment=args.assignment, assign_every=args.assign_every)
+        assignment=args.assignment, assign_every=args.assign_every,
+        information_model=args.information_model,
+        wide_assignment=args.wide_assignment, viz=args.viz)
     rospy.spin()
     return 0
 

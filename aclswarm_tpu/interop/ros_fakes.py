@@ -80,6 +80,17 @@ class Float32MultiArray:
         self.data: list = []
 
 
+class Int32MultiArray:
+    """std_msgs/Int32MultiArray — the wide `assignment` payload for
+    n > 255 swarms (no reference analogue: its `vehidx_t` is uint8,
+    `utils.h:25`, so the reference wire caps at 255; the adapter's
+    flag-gated widening carries the flagship scale on the same topic)."""
+
+    def __init__(self):
+        self.layout = _MultiArrayLayout()
+        self.data: list = []
+
+
 # -- geometry_msgs --------------------------------------------------------
 
 @dataclasses.dataclass
@@ -153,6 +164,72 @@ class SafetyStatus:
     def __init__(self):
         self.header = Header()
         self.collision_avoidance_active = False
+
+
+# -- visualization_msgs ---------------------------------------------------
+
+@dataclasses.dataclass
+class ColorRGBA:
+    """std_msgs/ColorRGBA."""
+
+    r: float = 0.0
+    g: float = 0.0
+    b: float = 0.0
+    a: float = 0.0
+
+
+@dataclasses.dataclass
+class Quaternion:
+    """geometry_msgs/Quaternion."""
+
+    x: float = 0.0
+    y: float = 0.0
+    z: float = 0.0
+    w: float = 0.0
+
+
+@dataclasses.dataclass
+class Pose:
+    """geometry_msgs/Pose."""
+
+    position: Point = dataclasses.field(default_factory=Point)
+    orientation: Quaternion = dataclasses.field(default_factory=Quaternion)
+
+
+class Marker:
+    """visualization_msgs/Marker — the slice the viz publishers touch
+    (`viz_commands.py:141-202`, `operator.py:273-289`). Type/action enum
+    values match the real message definition."""
+
+    ARROW = 0
+    CUBE = 1
+    SPHERE = 2
+    LINE_LIST = 5
+    MESH_RESOURCE = 10
+    ADD = 0
+    MODIFY = 0
+    DELETE = 2
+
+    def __init__(self):
+        self.header = Header()
+        self.ns = ""
+        self.id = 0
+        self.type = Marker.ARROW
+        self.action = Marker.ADD
+        self.pose = Pose()
+        self.scale = Vector3()
+        self.color = ColorRGBA()
+        self.lifetime = 0.0
+        self.points: list = []          # geometry_msgs/Point[]
+        self.mesh_resource = ""
+        self.mesh_use_embedded_materials = False
+
+
+class MarkerArray:
+    """visualization_msgs/MarkerArray."""
+
+    def __init__(self):
+        self.markers: list = []
 
 
 # -- snapstack_msgs -------------------------------------------------------
@@ -286,6 +363,7 @@ class FakeMsgs:
     Header = Header
     MultiArrayDimension = MultiArrayDimension
     UInt8MultiArray = UInt8MultiArray
+    Int32MultiArray = Int32MultiArray
     Float32MultiArray = Float32MultiArray
     Point = Point
     PointStamped = PointStamped
@@ -296,3 +374,8 @@ class FakeMsgs:
     VehicleEstimates = VehicleEstimates
     SafetyStatus = SafetyStatus
     QuadFlightMode = QuadFlightMode
+    ColorRGBA = ColorRGBA
+    Quaternion = Quaternion
+    Pose = Pose
+    Marker = Marker
+    MarkerArray = MarkerArray

@@ -134,6 +134,32 @@ CONFIGS = [
           max_accel_xy=1.0, max_accel_z=1.0, trial_timeout=1200.0,
           e_xy_thr=1.0, e_z_thr=0.3, kd=0.0005, K1_xy=0.005,
           gain_scale=0.15, assign_eps=0.01), 5, 1),
+    # the FULLY-faithful mode at the north-star scale: the reference's
+    # actual decentralized pipeline — per-agent local alignment -> CBAA
+    # max-consensus auctions over adj∘assignment (`auctioneer.cpp:50-51,
+    # 469-542`) fed by flooded-localization estimate tables
+    # (`localization_ros.cpp:152-185`) — closed loop at 1000 agents.
+    # cbaa_task_block bounds the consensus broadcast at O(n^2 B)
+    # (bit-identical; 4 GB dense would not fit alongside the flood).
+    # assign_eps is inapplicable: CBAA carries the reference's own
+    # accept-any-different + detect-and-skip semantics internally
+    # (`shouldUseAssignment`/`isValidAssignment`), so the Sinkhorn
+    # churn-breaking margin is not needed and not wired to this path.
+    # All physical/control knobs = simform1000_flooded's (each one a
+    # launch-file-parameter-class scale knob with its measured failure
+    # mode documented there; supervisor predicates untouched).
+    ("simform1000_cbaa_flooded",
+     dict(formation="simform1000", assignment="cbaa",
+          localization="flooded", flood_block=64, flood_phases=2,
+          cbaa_task_block=64,
+          colavoid_neighbors=16, chunk_ticks=100,
+          sim_l=130.0, sim_w=130.0, sim_h=3.0, sim_min_dist=3.0,
+          init_area_w=120.0, init_area_h=120.0, init_radius=1.0,
+          room_x=200.0, room_y=200.0, room_z=30.0,
+          max_vel_xy=1.0, max_vel_z=0.5,
+          max_accel_xy=1.0, max_accel_z=1.0, trial_timeout=1200.0,
+          e_xy_thr=1.0, e_z_thr=0.3, kd=0.0005, K1_xy=0.005,
+          gain_scale=0.15), 5, 1),
 ]
 
 

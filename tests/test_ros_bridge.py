@@ -340,3 +340,171 @@ class TestLoopback:
         assert node.planner.formation is not None
         g = np.asarray(node.planner.formation.gains)
         assert np.any(g != 0.0)           # real solved gains
+
+
+class TestRound5Additions:
+    """Round-5 adapter behaviors: wide Int32 assignments, the explicit
+    zero-cmd before a blocking commit, live rviz markers, and the
+    per-vehicle (faithful) information model."""
+
+    def test_assignment_wide_int32_roundtrip(self):
+        perm = np.random.default_rng(5).permutation(300).astype(np.int32)
+        ros = rb.assignment_to_ros(perm, FakeMsgs, wide=True)
+        assert isinstance(ros, FakeMsgs.Int32MultiArray)
+        np.testing.assert_array_equal(rb.assignment_from_ros(ros), perm)
+
+    def test_wide_assignment_loopback_n300(self):
+        """n=300 rides the ROS wire end-to-end: the adapter auto-widens
+        to Int32MultiArray (the reference's uint8 wire caps at 255,
+        `utils.h:25`)."""
+        n = 300
+        vehs = [f"SQ{i:03d}s" for i in range(n)]
+        ros = FakeRospy(params={"/vehs": vehs})
+        node = rb.run(ros, FakeMsgs, assign_every=5)
+        assert node.wide_assignment
+        rng = np.random.default_rng(7)
+        pts = rng.uniform(-20, 20, size=(n, 3))
+        adj = np.ones((n, n), np.uint8) - np.eye(n, dtype=np.uint8)
+        fm = m.Formation(header=m.Header(), name="big", points=pts,
+                         adjmat=adj,
+                         gains=np.zeros((3 * n, 3 * n), np.float32))
+        ros.Publisher("/formation", FakeMsgs.Formation).publish(
+            rb.formation_to_ros(fm, FakeMsgs))
+        q = pts[rng.permutation(n)]
+        est = m.VehicleEstimates(header=m.Header(), positions=q,
+                                 stamps=np.zeros(n))
+        ros_est = rb.estimates_to_ros(est, FakeMsgs)
+        for v in range(n):
+            pub = ros.Publisher(f"/{vehs[v]}/vehicle_estimates",
+                                FakeMsgs.VehicleEstimates)
+            pub.publish(ros_est)
+        out = node.step()
+        assert out is not None
+        asn = ros.pubs[f"/{vehs[0]}/assignment"].published
+        assert asn and isinstance(asn[0], FakeMsgs.Int32MultiArray)
+        perm = rb.assignment_from_ros(asn[0])
+        assert sorted(perm.tolist()) == list(range(n))
+        assert int(perm.max()) > 255      # actually exercises the width
+
+    def test_zero_cmd_precedes_commit_solve(self):
+        """On a formation commit the node publishes one explicit zero
+        distcmd to every vehicle BEFORE blocking on the (possibly long)
+        gain solve — the reference's stop-and-zero failsafe
+        (`coordination_ros.cpp:102-106`)."""
+        vehs = ["SQ01s", "SQ02s", "SQ03s", "SQ04s"]
+        ros = FakeRospy(params={"/vehs": vehs})
+        node = rb.run(ros, FakeMsgs)
+        seen_at_commit = {}
+
+        orig = node.planner.handle_formation
+
+        def spying_commit(fm):
+            for v in vehs:
+                seen_at_commit[v] = list(ros.pubs[f"/{v}/distcmd"].published)
+            return orig(fm)
+
+        node.planner.handle_formation = spying_commit
+        pts = np.array([[0.0, 0, 1], [2, 0, 1], [2, 2, 1], [0, 2, 1]])
+        adj = np.ones((4, 4), np.uint8) - np.eye(4, dtype=np.uint8)
+        fm = m.Formation(header=m.Header(), name="sq", points=pts,
+                         adjmat=adj, gains=None)     # gains=None -> solve
+        # estimates first, so the post-commit tick also publishes
+        est = m.VehicleEstimates(header=m.Header(), positions=pts + 0.5,
+                                 stamps=np.zeros(4))
+        for v in vehs:
+            ros.Publisher(f"/{v}/vehicle_estimates",
+                          FakeMsgs.VehicleEstimates).publish(
+                rb.estimates_to_ros(est, FakeMsgs))
+        ros.Publisher("/formation", FakeMsgs.Formation).publish(
+            rb.formation_to_ros(fm, FakeMsgs))
+        node.step()
+        for v in vehs:
+            msgs_before = seen_at_commit[v]
+            assert len(msgs_before) == 1      # the zero was already out
+            vec = msgs_before[0].vector
+            assert vec.x == vec.y == vec.z == 0.0
+            # and the post-solve tick published the real command after it
+            assert len(ros.pubs[f"/{v}/distcmd"].published) >= 2
+
+    def test_viz_marker_traffic(self):
+        """--viz publishes the reference viz node's MarkerArrays
+        (`viz_commands.py:36-50`): distcmd arrows, aligned-formation
+        spheres, quad meshes, and the operator's room bounds
+        (`operator.py:248-292`)."""
+        vehs = ["SQ01s", "SQ02s", "SQ03s", "SQ04s"]
+        ros = FakeRospy(params={"/vehs": vehs})
+        node = rb.run(ros, FakeMsgs, viz=True)
+        # room bounds latched at construction (planner exposes sparams)
+        room = ros.pubs["/operator/room_bounds"].published
+        assert len(room) == 1 and len(room[0].markers) == 4
+        assert all(mk.type == FakeMsgs.Marker.CUBE
+                   for mk in room[0].markers)
+
+        fm = _wire_formation(gains="zeros")
+        ros.Publisher("/formation", FakeMsgs.Formation).publish(
+            rb.formation_to_ros(fm, FakeMsgs))
+        swarm = _SwarmSide(ros, vehs, np.asarray(fm.points) * 1.5)
+        for _ in range(2):
+            swarm.publish_estimates()
+            node.step()
+            swarm.consume_distcmd()
+        arrows = ros.pubs["viz_dist_cmd"].published
+        assert arrows, "no distcmd arrow MarkerArray traffic"
+        arr = arrows[-1]
+        assert len(arr.markers) == 4
+        assert arr.markers[0].type == FakeMsgs.Marker.ARROW
+        assert arr.markers[1].header.frame_id == "SQ02s"  # vehicle frame
+        assert len(arr.markers[0].points) == 2            # origin -> 0.5u
+        spheres = ros.pubs["viz_central_alignment"].published
+        assert spheres and len(spheres[-1].markers) == 4
+        assert spheres[-1].markers[0].type == FakeMsgs.Marker.SPHERE
+        meshes = ros.pubs["viz_mesh"].published
+        assert meshes
+        assert meshes[-1].markers[0].mesh_resource.endswith("quadrotor.dae")
+
+    def test_perveh_information_model_consumes_own_tables(self):
+        """The faithful model: vehicle v's distcmd is computed from v's
+        OWN flood-propagated estimate table, not the fused swarm state —
+        biasing one vehicle's table visibly changes only the consumers of
+        that table (ADVICE r4: like-for-like coordination-layer swap)."""
+        def run_once(information_model, bias):
+            vehs = ["SQ01s", "SQ02s", "SQ03s", "SQ04s"]
+            ros = FakeRospy(params={"/vehs": vehs})
+            node = rb.run(ros, FakeMsgs,
+                          information_model=information_model)
+            fm = _wire_formation(gains="solve")
+            ros.Publisher("/formation", FakeMsgs.Formation).publish(
+                rb.formation_to_ros(fm, FakeMsgs))
+            q = np.asarray(fm.points) * 1.4
+            pubs = [ros.Publisher(f"/{v}/vehicle_estimates",
+                                  FakeMsgs.VehicleEstimates)
+                    for v in vehs]
+            for v, pub in enumerate(pubs):
+                table = q.copy()
+                if v == 0 and bias:
+                    # vehicle 0's beliefs about OTHERS are stale/shifted;
+                    # its self-estimate (the autopilot feed) stays exact
+                    table[1:] += np.array([0.8, -0.4, 0.0])
+                est = m.VehicleEstimates(header=m.Header(),
+                                         positions=table,
+                                         stamps=np.zeros(4))
+                pub.publish(rb.estimates_to_ros(est, FakeMsgs))
+            node.step()
+            out = {}
+            for v in vehs:
+                vec = ros.pubs[f"/{v}/distcmd"].published[-1].vector
+                out[v] = np.array([vec.x, vec.y, vec.z])
+            return out
+
+        clean = run_once("perveh", bias=False)
+        biased = run_once("perveh", bias=True)
+        fused = run_once("fused", bias=True)
+        # under the faithful model the bias lives in vehicle 0's own view:
+        # its command moves, the others' commands do not
+        assert not np.allclose(clean["SQ01s"], biased["SQ01s"])
+        for v in ("SQ02s", "SQ03s", "SQ04s"):
+            np.testing.assert_allclose(clean[v], biased[v], atol=1e-6)
+        # the fused model cannot see the bias at all (only self-estimates
+        # feed it) — every vehicle behaves as in the clean run
+        for v in ("SQ01s", "SQ02s", "SQ03s", "SQ04s"):
+            np.testing.assert_allclose(fused[v], clean[v], atol=1e-6)
