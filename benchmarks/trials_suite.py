@@ -144,10 +144,16 @@ CONFIGS = [
     # assign_eps is inapplicable: CBAA carries the reference's own
     # accept-any-different + detect-and-skip semantics internally
     # (`shouldUseAssignment`/`isValidAssignment`), so the Sinkhorn
-    # churn-breaking margin is not needed and not wired to this path.
-    # All physical/control knobs = simform1000_flooded's (each one a
-    # launch-file-parameter-class scale knob with its measured failure
-    # mode documented there; supervisor predicates untouched).
+    # churn-breaking margin is not needed and not wired to this path
+    # (measured: the post-dispatch CBAA churn settles by itself at
+    # ~60 s and every auction stays valid). All physical/control knobs =
+    # simform1000's (each one a launch-file-parameter-class scale knob
+    # with its measured failure mode documented there; supervisor
+    # predicates untouched) — INCLUDING keepout_repulse_vel: seed 1
+    # reproduces the SCALE_TUNING par.6 keep-out pair-trap under CBAA
+    # (first formation converges at 92 s but one trapped pair holds
+    # CA-active >= 95% from takeoff; GRIDLOCK persists 90 s ->
+    # TERMINATE at 103 s, diagnosed chunk-by-chunk).
     ("simform1000_cbaa_flooded",
      dict(formation="simform1000", assignment="cbaa",
           localization="flooded", flood_block=64, flood_phases=2,
@@ -159,7 +165,7 @@ CONFIGS = [
           max_vel_xy=1.0, max_vel_z=0.5,
           max_accel_xy=1.0, max_accel_z=1.0, trial_timeout=1200.0,
           e_xy_thr=1.0, e_z_thr=0.3, kd=0.0005, K1_xy=0.005,
-          gain_scale=0.15), 5, 1),
+          gain_scale=0.15, keepout_repulse_vel=0.3), 5, 1),
 ]
 
 
