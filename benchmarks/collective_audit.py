@@ -159,6 +159,30 @@ def main(argv=None):
         q_put, label=f"sinkhorn_assign_n{n}_dev{ndev}_staged",
         in_shardings=(row_sh,), out_shardings=rep))
 
+    # --- sharded flooded-localization tick (the L3 merge at scale) -----
+    # The one path measured below the 100 Hz bar on a single chip at
+    # n=2000 (flooded_tick 41 Hz, scale_tpu_n2000.json): estimate tables
+    # shard by owning agent, the min-age merge gathers neighbor rows over
+    # ICI (mesh.sim_state_sharding docstring). B=64 matches the flown
+    # configs. Same builders the crossover model compiles, so the audited
+    # kernel and the modeled one cannot diverge.
+    fn, fargs, in_sh, out_sh = _flood_builder(n, mesh)
+    rows.append(audit(fn, *[jax.device_put(a, s)
+                            for a, s in zip(fargs, in_sh)],
+                      label=f"flooded_tick_n{n}_dev{ndev}_b64",
+                      in_shardings=in_sh, out_shardings=out_sh))
+
+    # --- sharded blocked-CBAA consensus round --------------------------
+    # One synchronous bid round (n_iters=1, no early exit): the auction
+    # is a sequence of identical rounds, so the per-round inventory and
+    # partition ratio transfer to the whole auction (bit-identical path,
+    # round count unchanged by sharding).
+    fn, cargs, in_sh, out_sh = _cbaa_round_builder(n, mesh)
+    rows.append(audit(fn, *[jax.device_put(a, s)
+                            for a, s in zip(cargs, in_sh)],
+                      label=f"cbaa_round_n{n}_dev{ndev}_b64",
+                      in_shardings=in_sh, out_shardings=out_sh))
+
     # --- crossover cost model (round-3 weak #1) ------------------------
     # This box gives the virtual mesh ONE physical core
     # (os.cpu_count()=1), so a wall-clock sharded-vs-single crossover is
@@ -172,8 +196,20 @@ def main(argv=None):
     #   * the real chip's measured achieved FLOP/s for the same kernel
     #     (scale_tpu.json roofline fields) and public v5e ICI bandwidth.
     model = cost_model(mesh, n_list=(512, 1024, 2048, 4096))
+    flood_model = path_cost_model(
+        mesh, "flooded_tick_b64",
+        _flood_builder, n_list=(1000, 2000, 4096),
+        measured=_measured_rows("flooded_tick_n{n}_k16_b64_hz"),
+        bar_hz=100.0)
+    cbaa_model = path_cost_model(
+        mesh, "cbaa_round_b64",
+        _cbaa_round_builder, n_list=(1000, 2000),
+        measured=_measured_rows("cbaa_faithful_earlyexit_n{n}_b64_hz"),
+        bar_hz=None, per_round=True)
     out = {"n": n, "devices": ndev, "entries": rows,
-           "crossover_model": model}
+           "crossover_model": model,
+           "flood_crossover_model": flood_model,
+           "cbaa_crossover_model": cbaa_model}
     Path(args.out).parent.mkdir(parents=True, exist_ok=True)
     Path(args.out).write_text(json.dumps(out, indent=1))
     print(f"wrote {args.out}")
@@ -320,6 +356,204 @@ def cost_model(mesh, n_list=(1000, 2000, 4000, 8000)) -> dict:
                     "(os.cpu_count()=1); model built from compiled "
                     "per-device flops + HLO collective bytes + "
                     "real-chip achieved FLOP/s"}
+
+
+def _measured_rows(metric_fmt: str) -> dict:
+    """Pull measured single-chip rows from the committed scale artifacts
+    (jsonl), keyed by n: {"hz": rate, "rounds": loop rounds if recorded}."""
+    out = {}
+    for fname, n in (("scale_tpu.json", 1000),
+                     ("scale_tpu_n2000.json", 2000)):
+        p = RESULTS / fname
+        if not p.exists():
+            continue
+        want = metric_fmt.format(n=n)
+        for line in p.read_text().splitlines():
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if row.get("metric") == want:
+                out[n] = {"hz": float(row["value"]),
+                          "rounds": int(row["rounds"])
+                          if "rounds" in row else None}
+    return out
+
+
+def _flood_builder(n, mesh):
+    """The flooded-localization merge at scale knobs (B=64)."""
+    import jax
+    import jax.numpy as jnp
+
+    from aclswarm_tpu.parallel import mesh as meshlib
+    from aclswarm_tpu.sim import localization as loclib
+
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32) * 20)
+    adj = jnp.asarray((np.ones((n, n)) - np.eye(n)).astype(np.float32))
+    v2f = jnp.arange(n, dtype=jnp.int32)
+    loc = loclib.init_table(q)
+    row = meshlib.row_sharding(mesh)
+    rep = meshlib.replicated(mesh)
+    loc_sh = loclib.EstimateTable(est=row, age=row)
+
+    def flood(lc, qq, vv):
+        return loclib.tick(lc, qq, adj, vv, jnp.asarray(True),
+                           target_block=64)
+
+    args = (loc, q, v2f)
+    return flood, args, (loc_sh, row, rep), loc_sh
+
+
+def _cbaa_round_builder(n, mesh):
+    """One synchronous blocked-CBAA consensus round (B=64)."""
+    import jax.numpy as jnp
+
+    from aclswarm_tpu.assignment import cbaa
+    from aclswarm_tpu.parallel import mesh as meshlib
+
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32) * 20)
+    pts = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32) * 20)
+    adj = jnp.asarray((np.ones((n, n)) - np.eye(n)).astype(np.float32))
+    v2f = jnp.arange(n, dtype=jnp.int32)
+    row = meshlib.row_sharding(mesh)
+    rep = meshlib.replicated(mesh)
+
+    def rnd(qq, vv):
+        return cbaa.cbaa_from_state(qq, pts, adj, vv, n_iters=1,
+                                    task_block=64, early_exit=False).price
+
+    return rnd, (q, v2f), (row, rep), rep
+
+
+def path_cost_model(mesh, label, builder, n_list, measured,
+                    bar_hz=None, per_round=False) -> dict:
+    """Crossover model for one sharded path (round-4 review Missing #2:
+    the flood merge and the CBAA consensus had no modeled multi-chip
+    row — yet the flooded tick is the one metric below the 100 Hz bar
+    at n=2000).
+
+    Same methodology as `cost_model`, with one extension: these kernels
+    are HBM-bound (the n=2000 flood runs at 14 % of single-chip HBM
+    peak), so the calibration tracks BOTH the flop and bytes columns of
+    the CPU-HLO estimate against the measured rate at the smallest
+    measured n, and the compute term takes the binding resource
+    (max of the two modeled times). Collective payloads ride the
+    `cost_model` ring term at v5e ICI bandwidth.
+
+    ``per_round=True`` labels paths whose builder compiles ONE iteration
+    of a sequential consensus loop. The unit of this model is then a
+    ROUND, in both columns: single-chip round time = measured auction
+    time / measured round count (`scale.py` records `rounds` on the
+    cbaa rows), and the comm + latency-floor terms apply once per round
+    — NOT amortized over the auction. Sharding changes neither the
+    round count nor any value (bit-identical path), so the whole-
+    auction speedup equals the per-round speedup and
+    modeled_auction_hz_sharded = measured auction Hz x that speedup.
+    """
+    import jax
+
+    ndev = len(mesh.devices.ravel())
+    if not measured:
+        return {"error": "no measured single-chip rates in scale "
+                         "artifacts; run benchmarks/scale.py first"}
+    calib_n = min(measured)
+
+    def unit_time(n):
+        """Measured single-chip time of the modeled unit (tick or round)."""
+        m = measured.get(n)
+        if m is None:
+            return None
+        if per_round:
+            if not m["rounds"]:
+                return None
+            return 1.0 / m["hz"] / m["rounds"]
+        return 1.0 / m["hz"]
+
+    fn, args, _, _ = builder(calib_n, mesh)
+    f_calib, b_calib = _flops_bytes(jax.jit(fn), *args)
+    t_calib = unit_time(calib_n)
+    if t_calib is None or (f_calib <= 0.0 and b_calib <= 0.0):
+        return {"error": "calibration impossible: no measured unit time "
+                         "or backend offered no cost estimates"}
+    # a backend may omit one column; an absent column simply never binds
+    ach_f = f_calib / t_calib if f_calib > 0 else None
+    ach_b = b_calib / t_calib if b_calib > 0 else None
+
+    def model_t(f, b):
+        ts = []
+        if ach_f:
+            ts.append(f / ach_f)
+        if ach_b:
+            ts.append(b / ach_b)
+        return max(ts)
+
+    single_cache = {calib_n: (f_calib, b_calib)}
+    rows = []
+    for n in n_list:
+        fn, args, in_sh, out_sh = builder(n, mesh)
+        if n not in single_cache:
+            single_cache[n] = _flops_bytes(jax.jit(fn), *args)
+        f_single, b_single = single_cache[n]
+        t_single = model_t(f_single, b_single)
+        jsh = jax.jit(fn, in_shardings=in_sh, out_shardings=out_sh)
+        comp = jsh.lower(*args).compile()
+        ca = comp.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        dev_f = float(ca.get("flops", 0.0))
+        dev_b = float(ca.get("bytes accessed", 0.0))
+        hlo = comp.as_text()
+        cbytes = sum(_op_bytes(ls) for ls in hlo.splitlines()
+                     if any(re.search(rf"=\s*\S+\s+{c}(-start)?\(", ls)
+                            for c in COLLECTIVES))
+        t_comm = cbytes * (ndev - 1) / ndev / ICI_LINK_BPS
+        t_shard = model_t(dev_f, dev_b) + t_comm + LATENCY_FLOOR_S
+        unit = "round" if per_round else "tick"
+        m = measured.get(n)
+        row = {
+            "n": n,
+            "unit": unit,
+            "measured_hz": m["hz"] if m else None,
+            "measured_rounds": m["rounds"] if m else None,
+            "measured_unit_ms": (round(unit_time(n) * 1e3, 3)
+                                 if unit_time(n) else None),
+            "modeled_unit_single_ms": round(t_single * 1e3, 3),
+            "collective_bytes": cbytes,
+            "modeled_unit_sharded_ms": round(t_shard * 1e3, 3),
+            "modeled_speedup": round(t_single / t_shard, 2),
+        }
+        if per_round:
+            if m:
+                row["modeled_auction_hz_sharded"] = round(
+                    m["hz"] * row["modeled_speedup"], 2)
+        else:
+            row["modeled_sharded_hz"] = round(1.0 / t_shard, 1)
+            row["modeled_single_hz"] = round(1.0 / t_single, 1)
+            if bar_hz is not None:
+                row["clears_bar"] = bool(1.0 / t_shard >= bar_hz)
+        rows.append(row)
+        extra = f" (measured {m['hz']:.1f} Hz)" if m else ""
+        print(f"{label} n={n}: modeled {unit} "
+              f"{row['modeled_unit_single_ms']} ms single -> "
+              f"{row['modeled_unit_sharded_ms']} ms sharded "
+              f"({row['modeled_speedup']}x, {cbytes / 1e6:.1f} MB "
+              f"collectives){extra}")
+    out = {"devices": ndev, "label": label, "bar_hz": bar_hz,
+           "per_round": per_round, "calibration_n": calib_n,
+           "measured": measured, "rows": rows,
+           "note": "compute term = max(flop, bytes) column of the "
+                   "CPU-HLO estimate calibrated to the measured "
+                   "single-chip unit time (per ROUND for per_round "
+                   "paths — comm + latency floor charged once per "
+                   "round, not amortized over the auction); "
+                   "collectives ride the ring term at v5e ICI "
+                   "bandwidth"}
+    if bar_hz is not None:
+        out["bar_reachable_n"] = [r["n"] for r in rows
+                                  if r.get("clears_bar")]
+    return out
 
 
 if __name__ == "__main__":
