@@ -41,6 +41,21 @@ def _kernel(comm_ref, packed_ref, out_ref, *, n_chunks: int, wc: int):
     out_ref[:] = jax.lax.fori_loop(0, n_chunks, body, acc)
 
 
+def analytic_flops(n: int, w: int | None = None) -> int:
+    """Flops of one merge invocation — the analytic count XLA's
+    `cost_analysis` cannot see inside a custom call. Flops only: the
+    roofline keeps XLA's HBM figure, which covers the custom call's
+    operand traffic.
+
+    The reduction visits every (receiver, sender, target) triple once:
+    a mask-select plus a min fold — 2 ops per element of the padded
+    (N, N, W) candidate space."""
+    from aclswarm_tpu.ops._vmem import pad128
+    N = pad128(n)
+    W = pad128(n if w is None else w)
+    return 2 * N * N * W
+
+
 def flood_merge_bytes(n: int, w: int | None = None, tv: int = _TV,
                       wc: int = _WC) -> int:
     """VMEM-resident bytes of one grid step: the shared packed matrix,

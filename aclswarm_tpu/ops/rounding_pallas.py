@@ -26,6 +26,18 @@ from jax.experimental.pallas import tpu as pltpu
 NEG = -1e30  # "minus infinity" that survives f32 arithmetic without NaNs
 
 
+def analytic_flops(n: int, rounds: int = 20) -> int:
+    """Flops of one rounding invocation — analytic count for the
+    custom-call body (flops only: XLA's HBM figure covers the operand
+    traffic). Each round sweeps the padded (N, N) scores ~10 times
+    (row/col max, two first-hit argmins, the mutual mask, strike,
+    update); ``rounds`` is data-dependent (15-30 measured at n=1000 —
+    callers may pass a measured value)."""
+    from aclswarm_tpu.ops._vmem import pad128
+    N = pad128(n)
+    return 10 * N * N * rounds
+
+
 def _kernel(plan_ref, out_ref, *, nvalid: int, max_rounds: int):
     N = plan_ref.shape[0]
     R = jax.lax.broadcasted_iota(jnp.int32, (N, 1), 0)    # row ids

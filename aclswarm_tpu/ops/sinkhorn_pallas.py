@@ -28,6 +28,24 @@ from jax.experimental.pallas import tpu as pltpu
 NEG = -1e30  # "minus infinity" that survives f32 arithmetic without NaNs
 
 
+def analytic_flops(n: int, n_iters: int = 200) -> int:
+    """Flops of one kernel invocation — the analytic count XLA's
+    `cost_analysis` cannot see inside a custom call (round-4 review
+    Weak #1: the headline roofline under-reported by orders of
+    magnitude). Flops only: the roofline keeps XLA's HBM figure, which
+    already covers the custom call's operand traffic (one (N, N) load +
+    one store — intermediates live in VMEM).
+
+    Per iteration the body does two coupled logsumexp sweeps over the
+    padded (N, N) matrix: add (logK+g), max, subtract, exp, and a
+    sum-reduce — ~5 elementwise/reduce ops each, so ~10 N^2 flops per
+    iteration (exp counted as one), plus the final logK + f + g.
+    """
+    from aclswarm_tpu.ops._vmem import pad128
+    N = pad128(n)
+    return 10 * N * N * n_iters + 2 * N * N
+
+
 def _kernel(logK_ref, out_ref, *, n_iters: int, nvalid: int, log_mu: float):
     logK = logK_ref[:]                                   # (N, N) in VMEM
     N = logK.shape[0]

@@ -51,10 +51,16 @@ def main():
         # makes regressions visible beyond the single median)
         "hz_spread": sk["hz_spread"],
         # roofline position (round-3 weak #6): achieved FLOP/s + HBM GB/s
-        # from XLA's cost analysis vs v5e peaks (197 TF bf16 / 819 GB/s);
-        # Pallas bodies are opaque to the flops estimate — see
-        # benchmarks/scale.py _roofline
+        # vs v5e peaks (197 TF bf16 / 819 GB/s). Pallas bodies are opaque
+        # to XLA's flops estimate, so Pallas-routed rows merge the
+        # kernels' analytic counts and carry
+        # flops_model="xla+analytic_pallas" (benchmarks/scale.py
+        # _roofline; round-4 review Weak #1)
         "roofline": sk["roofline"],
+        # single-shot latency split into the environment's fixed
+        # per-dispatch floor vs on-device time (round-4 review Weak #4)
+        "latency_ms": round(sk["latency_ms"], 2),
+        "latency_decomposition": sk["latency_decomposition"],
     }))
 
 

@@ -1,0 +1,159 @@
+"""Flood-merge tile sweep: the n=2000 single-chip squeeze (round-4 #4).
+
+The flooded tick at n=2000 is the one metric below the 100 Hz bar
+(41 Hz, `scale_tpu_n2000.json`), and phasing stopped helping because the
+Pallas merge's shared ``packed`` block (N, W) re-streams from HBM once
+per receiver tile — N/TV grid steps x the whole stripe. At n=1000 that
+is 128 x 4 MB (tolerable next to compute); at n=2000 it is 256 x 8.4 MB
+per stripe and the kernel goes HBM-bound. The sweep measures the merge
+at alternative (TV receiver-tile, WC sender-chunk) shapes — larger TV
+cuts the reload count linearly while the (TV, WC, W) candidate
+temporary must stay inside VMEM — plus stripe widths (phases), then
+re-measures the full engine flooded tick at the winner.
+
+Run (real chip):  python benchmarks/flood_sweep.py [--n 2000]
+Appends one JSON line per variant to benchmarks/results/flood_sweep.json.
+"""
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from aclswarm_tpu.utils.timing import timing_stats
+
+RESULTS = Path(__file__).resolve().parent / "results"
+
+
+def sweep(n: int, reps: int = 3, out: str | None = None) -> list:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from aclswarm_tpu.ops._vmem import VMEM_BUDGET_BYTES
+    from aclswarm_tpu.ops.flood_pallas import (flood_merge_bytes,
+                                               flood_merge_pallas)
+
+    rng = np.random.default_rng(0)
+    rows = []
+
+    def emit(row):
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+        if out:
+            Path(out).parent.mkdir(parents=True, exist_ok=True)
+            with open(out, "a") as fh:
+                fh.write(json.dumps(row) + "\n")
+
+    comm = jnp.asarray(
+        (rng.random((n, n)) < 0.9).astype(np.float32))
+    ages = rng.integers(0, 100, size=(n, n)).astype(np.int32)
+    ids = np.arange(n, dtype=np.int32)
+
+    # chained merges (distinct inputs) amortize the ~100 ms dispatch
+    # floor; K sized so one dispatch stays well under the tunnel watchdog
+    K = 8
+    for phases in (1, 2, 4):
+        w = -(-n // phases)
+        packed_np = ((np.minimum(ages[:, :w], (1 << 15) - 1) << 16)
+                     | ids[:, None])
+        packs = jnp.asarray(                     # distinct ages: the age
+            np.stack([packed_np + (k << 16)      # field is the HIGH half
+                      for k in range(K)]))       # of the packed value
+
+        for tv, wc in itertools.product((8, 16, 32, 64), (32, 64, 128)):
+            need = flood_merge_bytes(n, w, tv, wc)
+            if need > VMEM_BUDGET_BYTES:
+                continue
+            from aclswarm_tpu.ops._vmem import pad128
+            if pad128(n) % tv or pad128(n) % wc:
+                continue
+
+            def chain(ps, tv=tv, wc=wc):
+                def body(c, pk):
+                    r = flood_merge_pallas(pk, comm, tv=tv, wc=wc)
+                    return c + r.sum(), None
+                return lax.scan(body, jnp.int32(0), ps)[0]
+
+            try:
+                jfn = jax.jit(chain)
+                stats = timing_stats(jfn, packs, per=K, reps=reps)
+            except Exception as e:       # Mosaic may reject a shape
+                emit({"metric": f"flood_merge_n{n}_w{w}_tv{tv}_wc{wc}",
+                      "error": str(e)[:200]})
+                continue
+            dt = stats["median_s"]
+            emit({"metric": f"flood_merge_n{n}_w{w}_tv{tv}_wc{wc}",
+                  "value": round(dt * 1e3, 3), "unit": "ms/stripe-merge",
+                  "phases": phases,
+                  "full_merge_ms": round(dt * phases * 1e3, 3),
+                  "vmem_mb": round(need / 2**20, 1),
+                  "spread_s": [round(stats["min_s"], 6),
+                               round(stats["max_s"], 6)]})
+    return rows
+
+
+def tick_with(n: int, phases: int, reps: int, ticks: int = 60,
+              out: str | None = None) -> dict:
+    """Full engine flooded tick at the chosen phasing (the metric that
+    must clear the bar) — same shape as scale.py's flooded rows."""
+    import jax
+    import jax.numpy as jnp
+
+    from aclswarm_tpu import sim
+    from aclswarm_tpu.core.types import (ControlGains, SafetyParams,
+                                         make_formation)
+
+    rng = np.random.default_rng(0)
+    pts = rng.normal(size=(n, 3)).astype(np.float32) * 20
+    adj = (np.ones((n, n)) - np.eye(n)).astype(np.float32)
+    gains = (rng.normal(size=(n, n, 3, 3)) * 0.01).astype(np.float32)
+    f = make_formation(jnp.asarray(pts), jnp.asarray(adj),
+                       jnp.asarray(gains))
+    sp = SafetyParams(bounds_min=jnp.asarray([-100.0, -100.0, 0.0]),
+                      bounds_max=jnp.asarray([100.0, 100.0, 20.0]))
+    st = sim.init_state(
+        rng.normal(size=(n, 3)).astype(np.float32) * 20 + [0, 0, 2],
+        localization=True)
+    cfg = sim.SimConfig(assignment="none", localization="flooded",
+                        flood_block=64, colavoid_neighbors=16,
+                        flood_phases=phases)
+    roll = jax.jit(lambda s: sim.rollout(s, f, ControlGains(), sp, cfg,
+                                         ticks)[0])
+    stats = timing_stats(roll, st, per=ticks, reps=reps)
+    dt = stats["median_s"]
+    row = {"metric": f"flooded_tick_n{n}_k16_b64_phased{phases}_hz",
+           "value": round(1.0 / dt, 3), "unit": "Hz",
+           "vs_baseline": round(1.0 / dt / 100.0, 2),
+           "spread_s": [round(stats["min_s"], 6),
+                        round(stats["max_s"], 6)]}
+    print(json.dumps(row), flush=True)
+    if out:
+        with open(out, "a") as fh:
+            fh.write(json.dumps(row) + "\n")
+    return row
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=2000)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--out", default=str(RESULTS / "flood_sweep.json"))
+    ap.add_argument("--tick-phases", type=int, default=None,
+                    help="also measure the full flooded tick at this "
+                         "phasing")
+    args = ap.parse_args(argv)
+    sweep(args.n, args.reps, args.out)
+    if args.tick_phases:
+        tick_with(args.n, args.tick_phases, args.reps, out=args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,30 +55,40 @@ V5E_PEAK_BF16_FLOPS = 197e12
 V5E_HBM_BPS = 819e9
 
 
-def _roofline(jfn, arg, dt: float, per: int = 1) -> dict:
+def _roofline(jfn, arg, dt: float, per: int = 1,
+              pallas_flops: float = 0.0) -> dict:
     """Achieved FLOP/s + HBM GB/s from XLA's compiled cost analysis.
 
     ``jfn`` must be the jitted callable that was timed, ``arg`` its input,
     ``dt`` the measured per-instance seconds, ``per`` the instances per
     call (chained scans). Uses `Compiled.cost_analysis()` — XLA's static
-    estimate of flops and bytes accessed (custom-call/Pallas bodies are
-    opaque to it, so kernels routed through Pallas under-report flops;
-    the HBM number still covers their operand traffic). Returns {} where
-    the backend offers no analysis."""
+    estimate of flops and bytes accessed. Custom-call/Pallas bodies are
+    OPAQUE to that estimate (round-4 review Weak #1: the headline row
+    published 0.1 GFLOP/s for a kernel doing ~10^9 flops), so callers on
+    a Pallas-routed path pass ``pallas_flops`` — the per-instance
+    analytic count from the kernel's own `analytic_flops` — which is
+    ADDED to the XLA figure; rows where that happened carry
+    `flops_model: "xla+analytic_pallas"`. The HBM number stays XLA's:
+    it already covers custom-call operand traffic (and VMEM-resident
+    kernels move nothing else). Returns {} where the backend offers no
+    analysis."""
     try:
         ca = jfn.lower(arg).compile().cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
-        flops = float(ca.get("flops", 0.0)) / per
+        flops = float(ca.get("flops", 0.0)) / per + float(pallas_flops)
         byts = float(ca.get("bytes accessed", 0.0)) / per
         if flops <= 0.0 and byts <= 0.0:
             return {}
-        return {"flops_per_instance": round(flops),
-                "achieved_gflops_s": round(flops / dt / 1e9, 1),
-                "hbm_gb_s": round(byts / dt / 1e9, 1),
-                "mxu_frac_bf16peak": round(
-                    flops / dt / V5E_PEAK_BF16_FLOPS, 5),
-                "hbm_frac_peak": round(byts / dt / V5E_HBM_BPS, 4)}
+        row = {"flops_per_instance": round(flops),
+               "achieved_gflops_s": round(flops / dt / 1e9, 1),
+               "hbm_gb_s": round(byts / dt / 1e9, 1),
+               "mxu_frac_bf16peak": round(
+                   flops / dt / V5E_PEAK_BF16_FLOPS, 5),
+               "hbm_frac_peak": round(byts / dt / V5E_HBM_BPS, 4)}
+        if pallas_flops > 0.0:
+            row["flops_model"] = "xla+analytic_pallas"
+        return row
     except Exception:
         return {}
 
@@ -109,20 +119,51 @@ def sinkhorn_throughput(n: int, K: int, reps: int, n_iters: int = 50,
     jchain = jax.jit(chain)
     dt = _median_time(jchain, qs, K, reps)
     spread = dict(_LAST_SPREAD)
-    roofline = _roofline(jchain, qs, dt, K)
+    # analytic flop counts for the Pallas-routed stages (opaque to XLA's
+    # cost analysis): engaged exactly when the auto-routing engages them
+    pallas_flops = 0.0
+    if sinkhorn._resolve_impl("auto", jnp.float32, n) == "pallas":
+        from aclswarm_tpu.ops import rounding_pallas, sinkhorn_pallas
+        pallas_flops = (sinkhorn_pallas.analytic_flops(n, n_iters)
+                        + rounding_pallas.analytic_flops(n))
+    roofline = _roofline(jchain, qs, dt, K, pallas_flops=pallas_flops)
 
     f1 = jax.jit(
         lambda q: sinkhorn.sinkhorn_assign(q, p, n_iters=n_iters).row_to_col)
     latency = _median_time(f1, qs[0], 1, reps)
     latency_spread = dict(_LAST_SPREAD)
     _LAST_SPREAD.clear()
+    # decompose the single-shot latency (round-4 review Weak #4): time a
+    # TRIVIAL jitted dispatch through the same launch+readback path — that
+    # is the environment's fixed per-executable floor (tunnel + scheduling
+    # + readback, ~100 ms here); the remainder is on-device time, cross-
+    # checkable against the chained per-instance figure (which amortizes
+    # the floor over K instances)
+    triv = jax.jit(lambda q: q.sum())
+    floor = _median_time(triv, qs[0], 1, reps)
+    _LAST_SPREAD.clear()
+    # the floor is a DIFFERENT executable through a tunnel with +-20 ms
+    # jitter, so latency - floor is noise-dominated (can even go
+    # negative); the robust on-device figure is the chained per-instance
+    # time, and the residual is reported as-is for honesty
+    decomposition = {
+        "launch_floor_ms": round(floor * 1e3, 2),
+        "on_device_per_instance_ms": round(dt * 1e3, 3),
+        "residual_vs_floor_ms": round((latency - floor) * 1e3, 2),
+        "note": "single-shot latency ~= per-dispatch floor (a trivial "
+                "kernel through the same tunnel + readback path costs "
+                "the same) + on-device compute; on-device is taken from "
+                "the chained (floor-amortized) per-instance time — the "
+                "residual column shows the direct subtraction, which "
+                "carries the tunnel's +-20 ms jitter",
+    }
     v = np.asarray(f1(qs[0]))
     cost = np.asarray(geometry.cdist(qs[0], p))
     opt = cost[np.arange(n), lapjv(cost)].sum()
     subopt = float(cost[np.arange(n), v].sum() / opt - 1.0)
     return {"hz": 1.0 / dt, "latency_ms": latency * 1000.0,
             "subopt": subopt, "chain_k": K, "n_iters": n_iters,
-            "roofline": roofline,
+            "roofline": roofline, "latency_decomposition": decomposition,
             "hz_spread": ([round(1.0 / spread["max_s"], 1),
                            round(1.0 / spread["min_s"], 1)]
                           if spread else None),
@@ -224,11 +265,25 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
         rng.normal(size=(n, 3)).astype(np.float32) * 20 + [0, 0, 2],
         localization=True)
     ticks_f = 20 if quick else 100
+    # analytic flops for the Pallas merge when the auto-routing engages
+    # it (opaque to cost_analysis; see _roofline). Per TICK: the bulk
+    # flood merges every `flood_every`=2 ticks; the roundtick metric
+    # merges every tick; phased2 does a half-width stripe every tick.
+    from aclswarm_tpu.ops import flood_pallas as fpal
+    from aclswarm_tpu.sim import localization as loclib
+
+    def _merge_flops(w=None):
+        if loclib._merge_impl(n, w) != "pallas":
+            return 0.0
+        return float(fpal.analytic_flops(n, w))
+
     froll = jax.jit(lambda s: sim.rollout(s, f, ControlGains(), sp,
                                           flood_cfg, ticks_f)[0])
     dt = _median_time(froll, st_loc, ticks_f, reps)
     emit(f"flooded_tick_n{n}{ca_tag}{btag}_hz", 1.0 / dt, "Hz",
-         baseline=100.0, **_roofline(froll, st_loc, dt, ticks_f))
+         baseline=100.0,
+         **_roofline(froll, st_loc, dt, ticks_f,
+                     pallas_flops=_merge_flops() / 2))
 
     # the WORST tick of the bulk flood (every 2nd tick does the whole
     # O(n^3) merge; the average above hides the spike): flood_every=1
@@ -240,7 +295,8 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
                                           spike_cfg, ticks_f)[0])
     dt = _median_time(sroll, st_loc, ticks_f, reps)
     emit(f"flooded_roundtick_n{n}{ca_tag}{btag}_hz", 1.0 / dt, "Hz",
-         baseline=100.0, **_roofline(sroll, st_loc, dt, ticks_f))
+         baseline=100.0, **_roofline(sroll, st_loc, dt, ticks_f,
+                                     pallas_flops=_merge_flops()))
 
     # phased flood (flood_phases=2): the merge's target axis spreads over
     # the 50 Hz window, so EVERY tick carries half a merge and none
@@ -252,7 +308,9 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
                                           ph_cfg, ticks_f)[0])
     dt = _median_time(proll, st_loc, ticks_f, reps)
     emit(f"flooded_tick_n{n}{ca_tag}{btag}_phased2_hz", 1.0 / dt, "Hz",
-         baseline=100.0, **_roofline(proll, st_loc, dt, ticks_f))
+         baseline=100.0,
+         **_roofline(proll, st_loc, dt, ticks_f,
+                     pallas_flops=_merge_flops(w=(n + 1) // 2)))
 
     from aclswarm_tpu.assignment import cbaa as cbaalib
     from aclswarm_tpu.core import perm as permutil
@@ -318,9 +376,11 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
          chain_k=K, spread_s=sk["chain_spread_s"],
          **(sk["roofline"] or {}))
     # single-shot latency (includes this environment's fixed per-launch
-    # tunnel overhead — see module docstring; honest but pessimistic)
+    # tunnel overhead — see module docstring; honest but pessimistic),
+    # with the floor/on-device decomposition attached
     emit(f"sinkhorn_assign_n{n}_latency_ms", sk["latency_ms"], "ms",
-         spread_s=sk["latency_spread_s"])
+         spread_s=sk["latency_spread_s"],
+         decomposition=sk["latency_decomposition"])
     emit(f"sinkhorn_assign_n{n}_subopt", sk["subopt"], "ratio")
 
     # --- sharded assignment over the device mesh (agent-axis GSPMD) ---
