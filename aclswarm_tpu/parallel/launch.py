@@ -1,0 +1,135 @@
+"""Multi-host launch program: one process per host, same program.
+
+The reference brings a fleet up by ssh-ing `start.sh` onto every machine
+and letting ROS wire the processes together
+(`aclswarm_sim/scripts/start.sh:126-160`, `remote_start.sh`). The
+TPU-native analogue is the JAX multi-controller model: every host runs
+THIS program, `jax.distributed` performs the handshake
+(`aclswarm_tpu.parallel.multihost.initialize`), and the agent-axis mesh
+then spans all hosts' devices — intra-host collectives ride ICI,
+cross-host segments ride DCN. `scripts/pod_up.sh` is the bring-up
+wrapper (the `remote_start.sh` analogue).
+
+What one run does: initialize the runtime, build the global agent mesh,
+construct a seeded faithful-stack problem (flooded localization +
+blocked CBAA — the same shape the driver's `dryrun_multichip` checks),
+roll the sharded engine a few ticks, and print one JSON line per
+process with a position digest. The digest is a pure function of the
+global computation, so EQUAL DIGESTS ACROSS PROCESSES certify that the
+multi-controller run agreed — the smoke every bring-up should end with.
+
+Run (per host; pod_up.sh generates these):
+    python -m aclswarm_tpu.parallel.launch \
+        --coordinator <host0>:9920 --num-processes 4 --process-id $i \
+        --n 256 --ticks 20
+On a TPU pod slice, omit the coordinator flags — `jax.distributed`
+auto-detects from the TPU environment.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _put_global(tree, shardings):
+    """Materialize a host-replicated pytree as global sharded arrays.
+
+    Every process holds the same seeded numpy arrays; each contributes
+    the shards it addresses (`jax.make_array_from_callback` slices the
+    same global array identically on every host)."""
+    import jax
+    import numpy as np
+
+    def put(x, sh):
+        if x is None:        # matched absent leaves (e.g. loc=None)
+            return None
+        x = np.asarray(x)
+        return jax.make_array_from_callback(
+            x.shape, sh, lambda idx: x[idx])
+
+    return jax.tree.map(put, tree, shardings,
+                        is_leaf=lambda x: x is None)
+
+
+def run(n: int, ticks: int, seed: int = 0) -> dict:
+    """The post-handshake smoke: sharded faithful-stack rollout."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from aclswarm_tpu import sim
+    from aclswarm_tpu.core.types import (ControlGains, SafetyParams,
+                                         make_formation)
+    from aclswarm_tpu.parallel import mesh as meshlib
+
+    mesh = meshlib.make_mesh(n_agents=n)
+    ndev = len(mesh.devices.ravel())
+    rng = np.random.default_rng(seed)
+    ang = np.linspace(0, 2 * np.pi, n, endpoint=False)
+    r0 = 3.0 * np.sqrt(max(n, 8) / 8.0)
+    points = np.stack([r0 * np.cos(ang), r0 * np.sin(ang),
+                       np.zeros(n)], 1)
+    adj = np.ones((n, n)) - np.eye(n)
+    gains = rng.normal(size=(n, n, 3, 3)) * 0.01
+    formation = make_formation(points, adj, gains)
+    sparams = SafetyParams(
+        bounds_min=jnp.asarray([-500.0, -500.0, 0.0]),
+        bounds_max=jnp.asarray([500.0, 500.0, 10.0]))
+    block = max(1, min(64, n // 2))
+    cfg = sim.SimConfig(assignment="cbaa", assign_every=max(1, ticks // 2),
+                        localization="flooded", flood_block=block,
+                        cbaa_task_block=block, colavoid_neighbors=16,
+                        flight_fsm=False)
+    state = sim.init_state(rng.normal(size=(n, 3)) * 4.0 + [0, 0, 2.0],
+                           localization=True)
+
+    shardings = meshlib.sim_state_sharding(mesh, localization=True)
+    rep = meshlib.replicated(mesh)
+    with mesh:
+        state = _put_global(state, shardings)
+        step = jax.jit(
+            lambda s: sim.step(s, formation, ControlGains(), sparams,
+                               cfg)[0],
+            in_shardings=(shardings,), out_shardings=shardings)
+        for _ in range(ticks):
+            state = step(state)
+        digest = jax.jit(lambda s: s.swarm.q.sum(),
+                         out_shardings=rep)(state)
+        digest = float(jax.block_until_ready(digest))
+    return {"process": jax.process_index(),
+            "processes": jax.process_count(),
+            "global_devices": ndev,
+            "n": n, "ticks": ticks,
+            "digest": round(digest, 6)}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=256)
+    ap.add_argument("--ticks", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port of process 0 (omit on TPU pods — "
+                         "auto-detected)")
+    ap.add_argument("--num-processes", type=int, default=None)
+    ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (local demo / CI)")
+    args = ap.parse_args(argv)
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    from aclswarm_tpu.parallel import multihost
+    multi = multihost.initialize(coordinator_address=args.coordinator,
+                                 num_processes=args.num_processes,
+                                 process_id=args.process_id)
+    report = run(args.n, args.ticks, args.seed)
+    report["multiprocess"] = bool(multi)
+    print(json.dumps(report), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,6 +45,7 @@ same reason (`vehicle_tracker.cpp:31-45` merges element-wise).
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 from jax import lax
 
@@ -57,8 +58,12 @@ from aclswarm_tpu.core import perm as permutil
 # estimates older than ~5.5 min of 100 Hz ticks compare equal — far
 # beyond every staleness horizon in the system (information either
 # refreshes at 50 Hz or is the startup census). Requires n < 2^16.
-AGE_CAP = jnp.int32((1 << 15) - 1)
-_PACK_SENTINEL = jnp.int32(2**31 - 1)
+# np scalars, not jnp: creating a jax array at import time initializes
+# the XLA backend, which breaks `jax.distributed.initialize` for anyone
+# importing this module first (`parallel.launch`'s multi-host handshake
+# must run before any backend touch)
+AGE_CAP = np.int32((1 << 15) - 1)
+_PACK_SENTINEL = np.int32(2**31 - 1)
 
 
 @struct.dataclass

@@ -25,6 +25,7 @@ from pathlib import Path
 import numpy as np
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))  # scale import
 
 from aclswarm_tpu.utils.timing import timing_stats
 
@@ -102,33 +103,30 @@ def sweep(n: int, reps: int = 3, out: str | None = None) -> list:
 def tick_with(n: int, phases: int, reps: int, ticks: int = 60,
               out: str | None = None) -> dict:
     """Full engine flooded tick at the chosen phasing (the metric that
-    must clear the bar) — same shape as scale.py's flooded rows."""
+    must clear the bar) — the SAME problem builder as scale.py's
+    flooded rows (`scale.build_bench_problem`), so this row is an
+    apples-to-apples re-measurement under the same metric name."""
     import jax
-    import jax.numpy as jnp
 
     from aclswarm_tpu import sim
-    from aclswarm_tpu.core.types import (ControlGains, SafetyParams,
-                                         make_formation)
+    from aclswarm_tpu.core.types import ControlGains
+    from scale import build_bench_problem
 
     rng = np.random.default_rng(0)
-    pts = rng.normal(size=(n, 3)).astype(np.float32) * 20
-    adj = (np.ones((n, n)) - np.eye(n)).astype(np.float32)
-    gains = (rng.normal(size=(n, n, 3, 3)) * 0.01).astype(np.float32)
-    f = make_formation(jnp.asarray(pts), jnp.asarray(adj),
-                       jnp.asarray(gains))
-    sp = SafetyParams(bounds_min=jnp.asarray([-100.0, -100.0, 0.0]),
-                      bounds_max=jnp.asarray([100.0, 100.0, 20.0]))
+    f, sp, _, k_ca, B = build_bench_problem(n, rng)
     st = sim.init_state(
         rng.normal(size=(n, 3)).astype(np.float32) * 20 + [0, 0, 2],
         localization=True)
     cfg = sim.SimConfig(assignment="none", localization="flooded",
-                        flood_block=64, colavoid_neighbors=16,
+                        flood_block=B, colavoid_neighbors=k_ca,
                         flood_phases=phases)
     roll = jax.jit(lambda s: sim.rollout(s, f, ControlGains(), sp, cfg,
                                          ticks)[0])
     stats = timing_stats(roll, st, per=ticks, reps=reps)
     dt = stats["median_s"]
-    row = {"metric": f"flooded_tick_n{n}_k16_b64_phased{phases}_hz",
+    ca_tag = f"_k{k_ca}" if k_ca is not None else ""
+    btag = f"_b{B}" if B else ""
+    row = {"metric": f"flooded_tick_n{n}{ca_tag}{btag}_phased{phases}_hz",
            "value": round(1.0 / dt, 3), "unit": "Hz",
            "vs_baseline": round(1.0 / dt / 100.0, 2),
            "spread_s": [round(stats["min_s"], 6),

@@ -93,6 +93,36 @@ def _roofline(jfn, arg, dt: float, per: int = 1,
         return {}
 
 
+def build_bench_problem(n: int, rng=None):
+    """One source of truth for the engine-benchmark problem: random
+    formation + gains + airborne state at the standard scale knobs.
+    Used by `bench_all`'s control/flooded rows AND
+    `benchmarks/flood_sweep.py`'s re-measurement, so the sweep's rows
+    stay apples-to-apples with the committed scale artifacts. Returns
+    (formation, sparams, state, k_ca, B) — k_ca the avoidance pruning,
+    B the flood block, both part of the metric names (`_k{k}_b{B}`).
+    Draw order matters: callers sharing an rng rely on pts, adjacency,
+    gains, state being sampled in this order."""
+    import jax.numpy as jnp
+
+    from aclswarm_tpu import sim
+    from aclswarm_tpu.core.types import SafetyParams, make_formation
+
+    rng = np.random.default_rng(0) if rng is None else rng
+    pts = rng.normal(size=(n, 3)).astype(np.float32) * 20
+    adj = (np.ones((n, n)) - np.eye(n)).astype(np.float32)
+    gains = (rng.normal(size=(n, n, 3, 3)) * 0.01).astype(np.float32)
+    f = make_formation(jnp.asarray(pts), jnp.asarray(adj),
+                       jnp.asarray(gains))
+    sp = SafetyParams(bounds_min=jnp.asarray([-100.0, -100.0, 0.0]),
+                      bounds_max=jnp.asarray([100.0, 100.0, 20.0]))
+    st = sim.init_state(
+        rng.normal(size=(n, 3)).astype(np.float32) * 20 + [0, 0, 2])
+    k_ca = 16 if n > 64 else None
+    B = 64 if n > 128 else None
+    return f, sp, st, k_ca, B
+
+
 def sinkhorn_throughput(n: int, K: int, reps: int, n_iters: int = 50,
                         seed: int = 0) -> dict:
     """The headline measurement, shared with the repo-root `bench.py`
@@ -217,16 +247,7 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
                 fh.write(json.dumps(row) + "\n")
 
     # --- full 100 Hz control tick at scale (chained rollout) ---
-    pts = rng.normal(size=(n, 3)).astype(np.float32) * 20
-    adj = (np.ones((n, n)) - np.eye(n)).astype(np.float32)
-    gains = (rng.normal(size=(n, n, 3, 3)) * 0.01).astype(np.float32)
-    f = make_formation(jnp.asarray(pts), jnp.asarray(adj),
-                       jnp.asarray(gains))
-    sp = SafetyParams(bounds_min=jnp.asarray([-100.0, -100.0, 0.0]),
-                      bounds_max=jnp.asarray([100.0, 100.0, 20.0]))
-    st = sim.init_state(
-        rng.normal(size=(n, 3)).astype(np.float32) * 20 + [0, 0, 2])
-    k_ca = 16 if n > 64 else None
+    f, sp, st, k_ca, B = build_bench_problem(n, rng)
     cfg = sim.SimConfig(assignment="none", colavoid_neighbors=k_ca)
     ticks = 50 if quick else 200
     roll = jax.jit(lambda s: sim.rollout(s, f, ControlGains(), sp, cfg,
@@ -256,8 +277,8 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
     # model (flooded localization, blocked merge) and the decentralized
     # CBAA auction (blocked consensus) at the SAME n as the north star.
     # Block sizes keep peak memory O(n^2 B) — the dense (n, n, n) forms
-    # need 4 GB at n=1000 and cannot run on one chip. ---
-    B = 64 if n > 128 else None
+    # need 4 GB at n=1000 and cannot run on one chip. B comes from
+    # build_bench_problem (shared with flood_sweep's re-measurements). ---
     btag = f"_b{B}" if B else ""
     flood_cfg = sim.SimConfig(assignment="none", localization="flooded",
                               flood_block=B, colavoid_neighbors=k_ca)

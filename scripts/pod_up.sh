@@ -1,0 +1,74 @@
+#!/bin/bash
+# Multi-host bring-up — the `remote_start.sh` analogue for the JAX
+# multi-controller model. The reference ssh-launches a vehicle stack per
+# machine and lets ROS discover the processes
+# (`aclswarm/scripts/remote_start.sh`, `start.sh:126-160`); here every
+# host runs the SAME program (`aclswarm_tpu.parallel.launch`),
+# `jax.distributed` performs the handshake, and the agent mesh spans all
+# hosts' devices. The run ends with one JSON digest line per host; equal
+# digests certify the multi-controller run agreed.
+#
+# Usage:
+#   scripts/pod_up.sh --local-demo K [-n N] [--ticks T]
+#       K local CPU processes on this machine (CI / laptop demo; the
+#       exact path tests/test_multihost.py exercises)
+#   scripts/pod_up.sh --hosts "host0 host1 ..." [-n N] [--ticks T]
+#       ssh bring-up: process 0 on the first host is the coordinator
+#       (port $PORT); remaining hosts join. Assumes the repo at the same
+#       path everywhere (the reference's remote_start.sh makes the same
+#       assumption about the catkin workspace).
+#   On a TPU pod slice, skip this script: run
+#       python -m aclswarm_tpu.parallel.launch
+#   under the pod runtime on every worker — jax.distributed
+#   auto-detects the topology.
+set -euo pipefail
+
+N=256
+TICKS=20
+PORT=9920
+HOSTS=""
+DEMO=0
+
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --local-demo) DEMO=$2; shift 2 ;;
+    --hosts) HOSTS=$2; shift 2 ;;
+    -n) N=$2; shift 2 ;;
+    --ticks) TICKS=$2; shift 2 ;;
+    --port) PORT=$2; shift 2 ;;
+    *) echo "usage: $0 --local-demo K | --hosts \"h0 h1 ...\" [-n N] [--ticks T] [--port P]"; exit 1 ;;
+  esac
+done
+
+cd "$(dirname "$0")/.."
+REPO=$(pwd)
+
+if [[ $DEMO -gt 0 ]]; then
+  echo "local demo: $DEMO CPU processes, n=$N, coordinator 127.0.0.1:$PORT"
+  pids=()
+  for ((i = DEMO - 1; i >= 0; i--)); do
+    python -m aclswarm_tpu.parallel.launch --cpu \
+      --coordinator "127.0.0.1:$PORT" --num-processes "$DEMO" \
+      --process-id "$i" --n "$N" --ticks "$TICKS" &
+    pids+=($!)
+  done
+  rc=0
+  for p in "${pids[@]}"; do wait "$p" || rc=1; done
+  exit $rc
+fi
+
+[[ -n "$HOSTS" ]] || { echo "need --local-demo K or --hosts"; exit 1; }
+read -r -a harr <<< "$HOSTS"
+NPROC=${#harr[@]}
+COORD="${harr[0]}:$PORT"
+echo "pod bring-up: $NPROC hosts, coordinator $COORD, n=$N"
+pids=()
+for ((i = 0; i < NPROC; i++)); do
+  ssh "${harr[$i]}" "cd $REPO && python -m aclswarm_tpu.parallel.launch \
+    --coordinator $COORD --num-processes $NPROC --process-id $i \
+    --n $N --ticks $TICKS" &
+  pids+=($!)
+done
+rc=0
+for p in "${pids[@]}"; do wait "$p" || rc=1; done
+exit $rc
