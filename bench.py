@@ -54,7 +54,7 @@ def main():
         # vs v5e peaks (197 TF bf16 / 819 GB/s). Pallas bodies are opaque
         # to XLA's flops estimate, so Pallas-routed rows merge the
         # kernels' analytic counts and carry
-        # flops_model="xla+analytic_pallas" (benchmarks/scale.py
+        # flops_model="xla+analytic" (benchmarks/scale.py
         # _roofline; round-4 review Weak #1)
         "roofline": sk["roofline"],
         # single-shot latency split into the environment's fixed

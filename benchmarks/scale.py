@@ -68,7 +68,7 @@ def _roofline(jfn, arg, dt: float, per: int = 1,
     a Pallas-routed path pass ``pallas_flops`` — the per-instance
     analytic count from the kernel's own `analytic_flops` — which is
     ADDED to the XLA figure; rows where that happened carry
-    `flops_model: "xla+analytic_pallas"`. The HBM number stays XLA's:
+    `flops_model: "xla+analytic"`. The HBM number stays XLA's:
     it already covers custom-call operand traffic (and VMEM-resident
     kernels move nothing else). Returns {} where the backend offers no
     analysis."""
@@ -87,7 +87,7 @@ def _roofline(jfn, arg, dt: float, per: int = 1,
                    flops / dt / V5E_PEAK_BF16_FLOPS, 5),
                "hbm_frac_peak": round(byts / dt / V5E_HBM_BPS, 4)}
         if pallas_flops > 0.0:
-            row["flops_model"] = "xla+analytic_pallas"
+            row["flops_model"] = "xla+analytic"
         return row
     except Exception:
         return {}
@@ -286,16 +286,18 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
         rng.normal(size=(n, 3)).astype(np.float32) * 20 + [0, 0, 2],
         localization=True)
     ticks_f = 20 if quick else 100
-    # analytic flops for the Pallas merge when the auto-routing engages
-    # it (opaque to cost_analysis; see _roofline). Per TICK: the bulk
-    # flood merges every `flood_every`=2 ticks; the roundtick metric
-    # merges every tick; phased2 does a half-width stripe every tick.
+    # analytic flops for the flood merge — needed for BOTH impls:
+    # the Pallas body is opaque to cost_analysis, and the blocked-XLA
+    # path's lax.map body is statically counted once (not x n/B trips),
+    # so both under-report the same O(n^2 w) reduction (measured at
+    # n=2000: XLA reported 3.3e8 where the reduction does ~8e9; the
+    # analytic figure over-counts the XLA path by its one statically-
+    # counted block, ~3%). Per TICK: the bulk flood merges every
+    # `flood_every`=2 ticks; the roundtick metric merges every tick;
+    # phased2 does a half-width stripe every tick.
     from aclswarm_tpu.ops import flood_pallas as fpal
-    from aclswarm_tpu.sim import localization as loclib
 
     def _merge_flops(w=None):
-        if loclib._merge_impl(n, w) != "pallas":
-            return 0.0
         return float(fpal.analytic_flops(n, w))
 
     froll = jax.jit(lambda s: sim.rollout(s, f, ControlGains(), sp,
