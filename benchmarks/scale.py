@@ -62,16 +62,18 @@ def _roofline(jfn, arg, dt: float, per: int = 1,
     ``jfn`` must be the jitted callable that was timed, ``arg`` its input,
     ``dt`` the measured per-instance seconds, ``per`` the instances per
     call (chained scans). Uses `Compiled.cost_analysis()` — XLA's static
-    estimate of flops and bytes accessed. Custom-call/Pallas bodies are
-    OPAQUE to that estimate (round-4 review Weak #1: the headline row
-    published 0.1 GFLOP/s for a kernel doing ~10^9 flops), so callers on
-    a Pallas-routed path pass ``pallas_flops`` — the per-instance
-    analytic count from the kernel's own `analytic_flops` — which is
-    ADDED to the XLA figure; rows where that happened carry
-    `flops_model: "xla+analytic"`. The HBM number stays XLA's:
-    it already covers custom-call operand traffic (and VMEM-resident
-    kernels move nothing else). Returns {} where the backend offers no
-    analysis."""
+    estimate of flops and bytes accessed. That estimate under-reports
+    iterative kernels on EVERY routing (round-4 review Weak #1: the
+    headline row published 0.1 GFLOP/s for ~10^9 flops): Pallas bodies
+    are opaque custom calls, and XLA scan/while loop bodies are counted
+    once rather than per trip. Callers therefore pass ``pallas_flops`` —
+    the per-instance analytic count from the kernel's `analytic_flops`,
+    regardless of which impl the routing picked — and it is ADDED to the
+    XLA figure; rows carrying it are tagged
+    `flops_model: "xla+analytic"` (the tag marks the counting model, NOT
+    that the Pallas kernel ran). The HBM number stays XLA's: it covers
+    custom-call operand traffic (and VMEM-resident kernels move nothing
+    else). Returns {} where the backend offers no analysis."""
     try:
         ca = jfn.lower(arg).compile().cost_analysis()
         if isinstance(ca, (list, tuple)):
@@ -149,13 +151,14 @@ def sinkhorn_throughput(n: int, K: int, reps: int, n_iters: int = 50,
     jchain = jax.jit(chain)
     dt = _median_time(jchain, qs, K, reps)
     spread = dict(_LAST_SPREAD)
-    # analytic flop counts for the Pallas-routed stages (opaque to XLA's
-    # cost analysis): engaged exactly when the auto-routing engages them
-    pallas_flops = 0.0
-    if sinkhorn._resolve_impl("auto", jnp.float32, n) == "pallas":
-        from aclswarm_tpu.ops import rounding_pallas, sinkhorn_pallas
-        pallas_flops = (sinkhorn_pallas.analytic_flops(n, n_iters)
-                        + rounding_pallas.analytic_flops(n))
+    # analytic flop counts for the iteration + rounding stages — needed
+    # for BOTH impls: the Pallas bodies are opaque to cost_analysis, and
+    # the XLA path's scan/while loop bodies are statically counted ONCE
+    # (not x n_iters / x rounds), the same under-report class (over-
+    # counts the XLA path by its one statically-counted body, ~2%)
+    from aclswarm_tpu.ops import rounding_pallas, sinkhorn_pallas
+    pallas_flops = (sinkhorn_pallas.analytic_flops(n, n_iters)
+                    + rounding_pallas.analytic_flops(n))
     roofline = _roofline(jchain, qs, dt, K, pallas_flops=pallas_flops)
 
     f1 = jax.jit(
