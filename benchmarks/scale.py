@@ -418,7 +418,7 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
         mesh = meshlib.make_mesh(n_agents=n)
         ndev = len(mesh.devices.ravel())
         qs = jnp.asarray(rng.normal(size=(K, n, 3)).astype(np.float32) * 20)
-        p = jnp.asarray(pts)
+        p = f.points          # the shared bench problem's formation
         row_t = NamedSharding(mesh, P(None, meshlib.AGENT_AXIS))
         rep = meshlib.replicated(mesh)
 
