@@ -508,3 +508,72 @@ class TestRound5Additions:
         # feed it) — every vehicle behaves as in the clean run
         for v in ("SQ01s", "SQ02s", "SQ03s", "SQ04s"):
             np.testing.assert_allclose(fused[v], clean[v], atol=1e-6)
+
+    def test_cbaa_with_perveh_tables_over_ros(self):
+        """The fully-faithful mode on the ROS wire: decentralized CBAA
+        auctions aligning on each vehicle's OWN estimate table (the
+        round-5 perveh information model feeds `engine.assign`'s est
+        path), closed-loop to convergence."""
+        vehs = ["SQ01s", "SQ02s", "SQ03s", "SQ04s"]
+        ros = FakeRospy(params={"/vehs": vehs})
+        node = rb.run(ros, FakeMsgs, assignment="cbaa", assign_every=25)
+        assert node._use_est
+        fm = _wire_formation(gains="solve")
+        rng = np.random.default_rng(13)
+        q0 = np.asarray(fm.points)[rng.permutation(4)] \
+            + rng.normal(scale=0.05, size=(4, 3)) + [2.0, -1.0, 0.0]
+        swarm = _SwarmSide(ros, vehs, q0)
+        ros.Publisher("/formation", FakeMsgs.Formation).publish(
+            rb.formation_to_ros(fm, FakeMsgs))
+        got = None
+        for _ in range(800):
+            swarm.publish_estimates()
+            got = node.step() or got
+            swarm.consume_distcmd()
+        assert got is not None, "no CBAA assignment published"
+        assert sorted(got.perm.tolist()) == list(range(4))
+        last = ros.pubs["/SQ01s/distcmd"].published[-1].vector
+        assert np.linalg.norm([last.x, last.y, last.z]) < 0.3
+        from scipy.spatial.distance import pdist
+        np.testing.assert_allclose(np.sort(pdist(swarm.q)),
+                                   np.sort(pdist(np.asarray(fm.points))),
+                                   atol=0.25)
+
+    def test_cbaa_auction_consumes_est_tables(self):
+        """The est path is observable in the AUCTION itself (not just the
+        control law): a vehicle whose table disagrees with ground truth
+        changes the CBAA outcome vs the truth-fed auction."""
+        from aclswarm_tpu.interop.planner import TpuPlanner
+        n = 4
+        pts = np.array([[0.0, 0, 1], [4, 0, 1], [4, 4, 1], [0, 4, 1]])
+        adj = np.ones((n, n)) - np.eye(n)
+        planner = TpuPlanner(n, assignment="cbaa", assign_every=1)
+        planner.handle_formation(m.Formation(
+            header=m.Header(), name="sq", points=pts, adjmat=adj,
+            gains=np.zeros((3 * n, 3 * n), np.float32)))
+        # both v0 and v1 are nearest to formation point 0, v0 closer —
+        # a CONTESTED task, so v0's bid strength decides the outcome
+        # (uncontested geometries are provably robust to one agent's
+        # table: the consensus hands every agent its unopposed task
+        # regardless of its price — which is CBAA working as designed)
+        q = np.array([[0.2, 0.2, 1.0], [0.9, 0.9, 1.0],
+                      [4.0, 4.0, 1.0], [0.0, 4.0, 1.0]])
+        truth_tbl = np.broadcast_to(q, (n, n, 3)).copy()
+        out_truth = planner.tick(q, est=truth_tbl)
+        # reset and rerun with vehicle 0 holding a NON-RIGID distortion
+        # (rigid transforms would be absorbed by its local alignment):
+        # it believes the others sit 10x away, so its aligned formation
+        # lands far from it, its 1/(dist) bids collapse, and the
+        # consensus outcome (a valid permutation under truth) must
+        # change — mild distortions are absorbed by the other agents'
+        # bids, which is itself the consensus working as designed
+        planner.v2f = np.arange(n)
+        planner._ticks_since_commit = 0
+        planner._await_first_accept = True
+        est = truth_tbl.copy()
+        est[0, 1:] = est[0, 1:] * 10.0
+        out_biased = planner.tick(q, est=est)
+        # under truth v0 wins the contested point; with its collapsed
+        # bids v1 takes it and v0 is pushed to point 1
+        np.testing.assert_array_equal(out_truth.assignment, [0, 1, 2, 3])
+        np.testing.assert_array_equal(out_biased.assignment, [1, 0, 2, 3])
