@@ -185,11 +185,24 @@ def collision_avoidance(q: jnp.ndarray, vel_des: jnp.ndarray,
     qij = q[None, :, :] - q[:, None, :]           # (i, j, 3): j relative to i
     dxy = jnp.linalg.norm(qij[..., :2], axis=-1)
     active = (dxy <= params.d_avoid_thresh) & ~jnp.eye(n, dtype=bool)
+    # opt-in cylinder half-height (`SafetyParams.colavoid_dz_ignore`): when
+    # set, vertically-clear neighbors cast no sector; <= 0 keeps the
+    # reference's infinite planar column (the arithmetic form keeps the
+    # knob a traced leaf — no retrace between on/off)
+    dz_ok = (jnp.abs(qij[..., 2]) <= params.colavoid_dz_ignore) \
+        | (params.colavoid_dz_ignore <= 0.0)
+    active = active & dz_ok
 
     if max_neighbors is not None and max_neighbors < n - 1:
         k = max_neighbors
-        # k nearest others (self excluded via +inf)
-        d_masked = jnp.where(jnp.eye(n, dtype=bool), jnp.inf, dxy)
+        # k nearest ACTIVE others (inactive -> +inf, which also excludes
+        # self). Ranking must follow the activation mask, not raw planar
+        # distance: with `colavoid_dz_ignore` set, a vertically-clear
+        # (inactive) vehicle can be planar-closer than a level obstacle
+        # and would otherwise consume a top-k slot, silently dropping a
+        # real sector — selection keyed on raw dxy was only sound while
+        # activation itself was a monotone function of dxy
+        d_masked = jnp.where(active, dxy, jnp.inf)
         idx = _smallest_k_indices(d_masked, k)                # (n, k)
         qij_k = jnp.take_along_axis(qij[..., :2], idx[:, :, None], axis=1)
         active_k = jnp.take_along_axis(active, idx, axis=1)   # (n, k)

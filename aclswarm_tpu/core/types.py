@@ -110,6 +110,17 @@ class SafetyParams:
     # deepest violator, until the keep-out is clear again; normal VO
     # resumes beyond r_keep_out. Still reported as ca-active.
     keepout_repulse_vel: float = 0.0
+    # OPT-IN divergence (0.0 = off = reference semantics): the reference's
+    # VO is strictly PLANAR (`safety.cpp:433-445` builds 2D sectors from
+    # xy distance only), so a vehicle blocks another even when they are
+    # metres apart vertically — the non-degenerate half of the
+    # SCALE_TUNING §6/§7 traps (a converged vehicle sector-blocks a
+    # transiter flying above/below it). A positive value stops treating
+    # neighbors with |dz| > this threshold as obstacles: the keep-out
+    # becomes a cylinder of half-height dz instead of an infinite column.
+    # Size it to the airframe's vertical interaction range (downwash);
+    # vehicles within the threshold keep full reference VO semantics.
+    colavoid_dz_ignore: float = 0.0
 
 
 def gains_to_flat(gains: jnp.ndarray) -> jnp.ndarray:

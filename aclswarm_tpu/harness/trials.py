@@ -112,6 +112,11 @@ class TrialConfig:
     # cylinders (None/0 = reference semantics — such pairs can deadlock,
     # docs/SCALE_TUNING.md par.6)
     keepout_repulse_vel: Optional[float] = None
+    # opt-in z-aware avoidance (`SafetyParams.colavoid_dz_ignore`):
+    # vertically-clear neighbors (|dz| above this) cast no VO sector
+    # (None/0 = the reference's infinite planar keep-out column — the
+    # non-degenerate trap half, docs/SCALE_TUNING.md §6/§7)
+    colavoid_dz_ignore: Optional[float] = None
     trial_timeout: Optional[float] = None
     # scale-control deadbands (`cntrl/e_xy_thr` / `cntrl/e_z_thr`,
     # reference `coordination.launch:36-37` — launch-file tunables, not
@@ -217,7 +222,8 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
         bounds_min=jnp.asarray([-cfg.room_x, -cfg.room_y, 0.0]),
         bounds_max=jnp.asarray([cfg.room_x, cfg.room_y, cfg.room_z]),
         **_overrides("max_vel_xy", "max_vel_z", "max_accel_xy",
-                     "max_accel_z", "keepout_repulse_vel"))
+                     "max_accel_z", "keepout_repulse_vel",
+                     "colavoid_dz_ignore"))
     trial_timeout = (TRIAL_TIMEOUT if cfg.trial_timeout is None
                      else cfg.trial_timeout)
 

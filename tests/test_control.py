@@ -256,6 +256,66 @@ class TestColAvoid:
         np.testing.assert_allclose(np.asarray(out), vel)
         assert not np.any(np.asarray(mod))
 
+    def test_dz_ignore_unblocks_vertically_clear_neighbors(self):
+        """Opt-in z-aware avoidance (`SafetyParams.colavoid_dz_ignore`):
+        the reference's planar VO blocks regardless of vertical
+        separation (the non-degenerate half of the SCALE_TUNING §6/§7
+        traps); the knob turns the infinite keep-out column into a
+        cylinder — vertically clear neighbors cast no sector, near-level
+        ones keep full reference semantics."""
+        p = self._params()
+        # neighbor dead ahead but 2 m below the commanded vehicle
+        q = np.array([[0.0, 0, 3.0], [0.8, 0, 1.0]])
+        vel = np.array([[0.5, 0, 0], [0.0, 0, 0]])
+        # reference semantics: planar distance 0.8 < threshold => blocked
+        out, mod = control.collision_avoidance(jnp.asarray(q),
+                                               jnp.asarray(vel), p)
+        assert bool(mod[0])
+        # knob on, |dz|=2 > 1.5: no sector, command passes through
+        pz = p.replace(colavoid_dz_ignore=1.5)
+        out, mod = control.collision_avoidance(jnp.asarray(q),
+                                               jnp.asarray(vel), pz)
+        np.testing.assert_allclose(np.asarray(out), vel)
+        assert not np.any(np.asarray(mod))
+        # knob on but |dz|=1.0 <= 1.5: still reference-blocked
+        qnear = np.array([[0.0, 0, 2.0], [0.8, 0, 1.0]])
+        out, mod = control.collision_avoidance(jnp.asarray(qnear),
+                                               jnp.asarray(vel), pz)
+        assert bool(mod[0])
+        # the keep-out repulse honors the same cylinder: a z-separated
+        # planar "violation" no longer triggers radial separation
+        pzr = pz.replace(keepout_repulse_vel=0.4)
+        qviol = np.array([[0.0, 0, 3.0], [0.4, 0, 1.0]])
+        out, mod = control.collision_avoidance(jnp.asarray(qviol),
+                                               jnp.asarray(vel), pzr)
+        np.testing.assert_allclose(np.asarray(out), vel)
+        assert not np.any(np.asarray(mod))
+
+    def test_dz_ignore_pruned_path_keeps_level_obstacles(self):
+        """Top-k pruning must rank only ACTIVE neighbors: with the dz
+        knob on, a crowd of vertically-clear (inactive) vehicles that
+        are planar-closer than a level obstacle must not consume the
+        top-k slots and drop its sector (review r5: selection keyed on
+        raw planar distance was only sound while activation was a
+        monotone function of it)."""
+        p = self._params().replace(colavoid_dz_ignore=1.0)
+        # agent 0 at origin commanding +x; agents 1-4 vertically clear
+        # (|dz|=2) and planar-close (0.3 m); agent 5 LEVEL, dead ahead
+        # inside the threshold
+        q = np.array([[0.0, 0.0, 3.0],
+                      [0.3, 0.0, 1.0], [-0.3, 0.0, 1.0],
+                      [0.0, 0.3, 1.0], [0.0, -0.3, 1.0],
+                      [0.8, 0.0, 3.0]])
+        vel = np.zeros((6, 3)); vel[0, 0] = 0.5
+        out, mod = control.collision_avoidance(
+            jnp.asarray(q), jnp.asarray(vel), p, max_neighbors=4)
+        assert bool(mod[0]), "level obstacle dropped by dz-excluded crowd"
+        # and identical to the dense (exact) result
+        out_d, mod_d = control.collision_avoidance(
+            jnp.asarray(q), jnp.asarray(vel), p)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out_d))
+        np.testing.assert_array_equal(np.asarray(mod), np.asarray(mod_d))
+
     def test_heading_exactly_pi_still_avoided(self):
         # INTENTIONAL divergence from the reference: its linearized strict
         # zone test can never flag psi == ±pi (safety.cpp:487-493), letting a
