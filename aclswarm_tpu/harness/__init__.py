@@ -1,9 +1,23 @@
 """Trial harness: formation library, random formation generator, supervisor
-oracle, Monte-Carlo trial driver (SURVEY.md §7 layer 7)."""
+oracle, Monte-Carlo trial driver, recording review incl. rosbag ingestion
+(SURVEY.md §7 layer 7)."""
 from aclswarm_tpu.harness.formations import (FormationSpec, load_formation,
                                              load_group)
 from aclswarm_tpu.harness.supervisor import (TrialFSM, TrialResult,
                                              TrialState, evaluate)
 
 __all__ = ["FormationSpec", "load_formation", "load_group", "TrialResult",
-           "TrialFSM", "TrialState", "evaluate"]
+           "TrialFSM", "TrialState", "evaluate", "review", "rosbag1"]
+
+
+def __getattr__(name):
+    # lazy submodule access for the heavier tools (review pulls the FSM
+    # stack; rosbag1 is pure stdlib+numpy) without import-time cost
+    if name in ("review", "rosbag1"):
+        import importlib
+        return importlib.import_module(f"aclswarm_tpu.harness.{name}")
+    raise AttributeError(name)
+
+
+def __dir__():
+    return sorted(list(globals()) + ["review", "rosbag1"])
