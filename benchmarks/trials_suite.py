@@ -215,15 +215,31 @@ EXPECTED_PCT = {
 
 
 def run_config(name: str, overrides: dict, m: int, seed: int = 1) -> dict:
+    # trials append to a TEMP file which atomically replaces the
+    # committed CSV only after the config finishes — a crashed or wedged
+    # run (observed: the device tunnel can hang before trial 0 ends)
+    # must never destroy committed evidence
     out = RESULTS / f"trials_{name}.csv"
-    out.unlink(missing_ok=True)
-    cfg = triallib.TrialConfig(trials=m, seed=seed, out=str(out),
+    tmp = RESULTS / f".trials_{name}.csv.tmp"
+    tmp.unlink(missing_ok=True)
+    cfg = triallib.TrialConfig(trials=m, seed=seed, out=str(tmp),
                                verbose=True, **overrides)
     t0 = time.time()
     stats = triallib.run_trials(cfg)
+    if tmp.exists():
+        tmp.replace(out)
+    else:
+        # zero completed trials (e.g. every trial timed out in a
+        # degraded environment): keep whatever committed evidence
+        # exists — the summary row records the 0 % honestly, and
+        # deleting the prior CSV here would be exactly the evidence
+        # loss this path exists to prevent
+        stats["csv_kept_from_prior_run"] = out.exists()
     stats["wall_s"] = round(time.time() - t0, 1)
     stats["config"] = {k: v for k, v in dataclasses.asdict(cfg).items()
                        if k not in ("out", "verbose")}
+    # the recorded config must name the committed artifact, not the temp
+    stats["config"]["csv"] = out.name
     return stats
 
 
