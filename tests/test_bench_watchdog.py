@@ -1,0 +1,45 @@
+"""bench.py watchdog: a wedged device tunnel must yield ONE diagnostic
+JSON line and exit 2 (never a silent hang that burns the driver's
+budget), and a measurement finishing at the timer boundary must not
+race a second line in."""
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = str(Path(__file__).resolve().parents[1])
+
+
+def test_wedge_emits_single_diagnostic_line():
+    code = (
+        "import bench, threading, time\n"
+        "bench.WATCHDOG_S = 0.5\n"
+        "t = threading.Timer(bench.WATCHDOG_S, bench._watchdog)\n"
+        "t.daemon = True; t.start()\n"
+        "time.sleep(10)\n"       # simulate the hung measurement
+    )
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=30)
+    assert r.returncode == 2
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1
+    d = json.loads(lines[0])
+    assert d["metric"] == "sinkhorn_assign_n1000_hz"
+    assert "error" in d and d["value"] == 0.0
+
+
+def test_boundary_finish_suppresses_watchdog():
+    code = (
+        "import bench, threading, time, json\n"
+        "bench.WATCHDOG_S = 0.2\n"
+        "t = threading.Timer(bench.WATCHDOG_S, bench._watchdog)\n"
+        "t.daemon = True\n"
+        "bench._done.set()\n"    # main finished exactly at the boundary
+        "t.start(); time.sleep(1)\n"
+        "print(json.dumps({'ok': True}))\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=30)
+    assert r.returncode == 0
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1 and json.loads(lines[0])["ok"]
