@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import bz2
 import struct
+import warnings
 from pathlib import Path
 from typing import Iterator, NamedTuple, Optional
 
@@ -346,23 +347,47 @@ def _veh_of(topic: str, suffix: str) -> Optional[str]:
         else None
 
 
+# real-flight bags recorded by the reference's bag_record.sh throttle the
+# high-rate streams: `review_bag.py:90` subscribes safety/status_throttle,
+# and distcmd is recorded as distcmd_throttle — the reader accepts either
+# name per vehicle (unthrottled first: it is the denser signal)
+SAFETY_SUFFIXES = ("safety/status", "safety/status_throttle")
+DISTCMD_SUFFIXES = ("distcmd", "distcmd_throttle")
+# topic suffixes that mark a prefix as a real *vehicle* (anchor tags like
+# /Tag01/world publish poses only): assignment + the FSM signal streams
+_VEHICLE_EVIDENCE = SAFETY_SUFFIXES + DISTCMD_SUFFIXES + ("assignment",)
+
+
 def bag_to_recording(bagpath, out_npz=None, dt: float = 0.02,
                      vehs: Optional[list[str]] = None) -> dict:
     """Resample a hardware bag's topic streams onto the reviewer's tick
     grid and (optionally) write a `harness.review` recording npz.
 
-    Vehicle discovery follows the reference reviewer: the `<veh>/...`
-    topic prefixes (`review_bag.py:66-67` scrapes topics;
-    `readACLBag.m:6-10` regexes them). Signals:
+    Vehicle discovery starts from the `<veh>/world` topic prefixes
+    (`review_bag.py:66-67` scrapes topics; `readACLBag.m:6-10` regexes
+    them) but keeps only prefixes that also carry vehicle traffic
+    (assignment/safety/distcmd, throttled or not): real bags recorded by
+    `bag_record.sh` include the anchor-tag poses `/Tag01/world` /
+    `/Tag02/world`, which would otherwise inflate ``n`` and break the
+    ``perm.size == n`` assignment check. Pose-only bags (no vehicle
+    traffic at all) fall back to every world prefix. Signals:
 
     - ``q`` from `/<veh>/world` PoseStamped, sample-and-hold;
-    - ``ca_active`` from `/<veh>/safety/status` SafetyStatus;
-    - ``distcmd_norm`` from `/<veh>/distcmd` Vector3Stamped;
+    - ``ca_active`` from `/<veh>/safety/status` (or the real-flight
+      recording's `status_throttle`) SafetyStatus;
+    - ``distcmd_norm`` from `/<veh>/distcmd` (or `distcmd_throttle`)
+      Vector3Stamped;
     - assignment events from the first vehicle's `/assignment`
       UInt8MultiArray — the reviewer subscribes exactly one
       (`review_bag.py:95-97`); every received message marks an auctioned+
       valid tick (hardware only ever publishes accepted assignments),
       `reassigned` when the permutation changed.
+
+    A discovered vehicle with no safety or no distcmd stream triggers a
+    `UserWarning` instead of a silent default — defaults (ca_active
+    False, distcmd 0) make the review FSM blind to gridlock and
+    instantly "converged" for that vehicle, which is a wrong verdict, not
+    a neutral one.
 
     ``dt`` defaults to 0.02 s — the reviewer's 50 Hz FSM tick
     (`review_bag.py` `tick_rate = 50`).
@@ -375,11 +400,30 @@ def bag_to_recording(bagpath, out_npz=None, dt: float = 0.02,
         streams.setdefault(msg.topic, []).append((msg.time, des(msg.raw)))
 
     if vehs is None:
-        vehs = sorted({v for t in streams
-                       if (v := _veh_of(t, "world")) is not None})
+        worlds = {v for t in streams
+                  if (v := _veh_of(t, "world")) is not None}
+        evidence = {v for t in streams for sfx in _VEHICLE_EVIDENCE
+                    if (v := _veh_of(t, sfx)) is not None}
+        if worlds & evidence:
+            vehs = sorted(worlds & evidence)
+            dropped = sorted(worlds - evidence)
+            if dropped:
+                warnings.warn(
+                    f"{bagpath}: ignoring pose-only topic prefixes "
+                    f"{dropped} (anchor tags / non-vehicle frames — no "
+                    "assignment/safety/distcmd traffic)")
+        else:
+            vehs = sorted(worlds)   # pose-only bag: nothing to intersect
     if not vehs:
         raise ValueError(f"{bagpath}: no /<veh>/world pose streams found")
     n = len(vehs)
+
+    def _veh_stream(veh: str, suffixes: tuple[str, ...]) -> Optional[list]:
+        for sfx in suffixes:
+            series = streams.get(f"/{veh}/{sfx}")
+            if series:
+                return series
+        return None
 
     t0 = min(t for series in streams.values() for t, _ in series)
     t1 = max(t for series in streams.values() for t, _ in series)
@@ -412,9 +456,21 @@ def bag_to_recording(bagpath, out_npz=None, dt: float = 0.02,
         if not poses:
             raise ValueError(f"{bagpath}: vehicle {veh} has no world poses")
         q[:, i, :] = hold(poses, np.zeros(3), extract=lambda v: v[1])
-        ca[:, i] = hold(streams.get(f"/{veh}/safety/status", []), False,
-                        extract=lambda v: v[1])
-        dn[:, i] = hold(streams.get(f"/{veh}/distcmd", []), 0.0,
+        safety = _veh_stream(veh, SAFETY_SUFFIXES)
+        if safety is None:
+            warnings.warn(
+                f"{bagpath}: vehicle {veh} has no safety status stream "
+                f"({' or '.join(SAFETY_SUFFIXES)}); ca_active defaults to "
+                "False — the review FSM cannot detect gridlock for it")
+        ca[:, i] = hold(safety or [], False, extract=lambda v: v[1])
+        distcmd = _veh_stream(veh, DISTCMD_SUFFIXES)
+        if distcmd is None:
+            warnings.warn(
+                f"{bagpath}: vehicle {veh} has no distcmd stream "
+                f"({' or '.join(DISTCMD_SUFFIXES)}); |distcmd| defaults "
+                "to 0 — the convergence predicate sees it as instantly "
+                "converged")
+        dn[:, i] = hold(distcmd or [], 0.0,
                         extract=lambda v: float(np.linalg.norm(v[1])))
 
     auctioned = np.zeros(ticks, bool)
@@ -422,6 +478,7 @@ def bag_to_recording(bagpath, out_npz=None, dt: float = 0.02,
     v2f = np.tile(np.arange(n, dtype=np.int32), (ticks, 1))
     asn_series = streams.get(f"/{vehs[0]}/assignment", [])
     prev = None
+    size_warned = False
     for t, perm in asn_series:
         k = min(ticks - 1, max(0, int(round((t - t0) / dt))))
         auctioned[k] = True
@@ -431,6 +488,18 @@ def bag_to_recording(bagpath, out_npz=None, dt: float = 0.02,
         prev = perm
         if perm.size == n:
             v2f[k:] = perm[None, :]
+        elif not size_warned:
+            # cross-check on vehicle discovery: a real vehicle whose
+            # signal topics were all lost is indistinguishable from an
+            # anchor tag by topic shape, but the recorded assignment
+            # permutations carry the true fleet size
+            warnings.warn(
+                f"{bagpath}: assignment permutations have size "
+                f"{perm.size} but {n} vehicles were discovered — "
+                "v2f is left at identity; if a real vehicle's "
+                "safety/distcmd/assignment streams are missing from the "
+                "bag, pass vehs=[...] explicitly")
+            size_warned = True
 
     rec = {
         "q": q,
@@ -480,13 +549,19 @@ def recording_to_bag(npz_path, bag_path, vehs: Optional[list[str]] = None,
                 bag.write(f"/{veh}/distcmd",
                           "geometry_msgs/Vector3Stamped", t,
                           ser_vector3_stamped(t, vec))
-            if bool(auctioned[k]) and bool(valid[k]):
-                if n > 255:   # uint8 would wrap indices into duplicates
-                    bag.write(f"/{vehs[0]}/assignment",
-                              "std_msgs/Int32MultiArray", t,
-                              ser_int32_multiarray(v2f[k]))
-                else:
-                    bag.write(f"/{vehs[0]}/assignment",
-                              "std_msgs/UInt8MultiArray", t,
-                              ser_uint8_multiarray(v2f[k]))
+        # assignment events are sparse and carry the trial's auction
+        # history: export EVERY accepted one at its true tick, independent
+        # of the pose decimation (with pose_every > 1, events on
+        # non-exported ticks would otherwise vanish from the bag)
+        for k in np.flatnonzero(np.asarray(auctioned, bool)
+                                & np.asarray(valid, bool)):
+            t = int(k) * dt
+            if n > 255:   # uint8 would wrap indices into duplicates
+                bag.write(f"/{vehs[0]}/assignment",
+                          "std_msgs/Int32MultiArray", t,
+                          ser_int32_multiarray(v2f[k]))
+            else:
+                bag.write(f"/{vehs[0]}/assignment",
+                          "std_msgs/UInt8MultiArray", t,
+                          ser_uint8_multiarray(v2f[k]))
     return str(bag_path)

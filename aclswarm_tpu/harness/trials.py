@@ -45,7 +45,9 @@ from aclswarm_tpu.core.types import ControlGains, SafetyParams, make_formation
 from aclswarm_tpu.harness import formations as formlib
 from aclswarm_tpu.harness import formgen
 from aclswarm_tpu.harness.formations import FormationSpec
-from aclswarm_tpu.harness.supervisor import TRIAL_TIMEOUT, NAMES, TrialFSM
+from aclswarm_tpu.harness.supervisor import (BUFFER_SECONDS, TRIAL_TIMEOUT,
+                                             NAMES, SummaryTrialFSM,
+                                             TrialFSM)
 
 
 @dataclasses.dataclass
@@ -58,6 +60,12 @@ class TrialConfig:
     trials: int = 1                 # Monte-Carlo trial count (trials.sh -m)
     seed: int = 1                   # trial t runs with seed+t (trial.sh:31)
     out: str = "trials.csv"         # CSV results path (append, reference-style)
+    # trials per device launch: > 1 vmaps the rollout over a trial axis
+    # (same shape n, one seed per trial) with on-device metric reduction —
+    # requires chunk_ticks % assign_every == 0 so the batch shares the
+    # auction phase (docs/BATCHED_TRIALS.md); 1 = the serial reference
+    # driver (tick-exact supervisor, full per-tick metrics)
+    batch: int = 1
     # engine knobs (SimConfig mirror)
     assignment: str = "auction"     # auction | sinkhorn | cbaa
     # doubleint (the honest second-order default: `SysDynam.m`'s closed
@@ -196,6 +204,62 @@ def _gains_for(spec: FormationSpec,
                                            max_nonedges=max_nonedges))
 
 
+def _trial_overrides(cfg: TrialConfig, *fields) -> dict:
+    """Optional scale knobs: None = keep the reference default."""
+    return {k: getattr(cfg, k) for k in fields
+            if getattr(cfg, k) is not None}
+
+
+def _trial_sparams(cfg: TrialConfig) -> SafetyParams:
+    """Room bounds + the launch-file-class scale knobs (shared by the
+    serial and batched drivers — they must stay byte-identical)."""
+    import jax.numpy as jnp
+
+    return SafetyParams(
+        bounds_min=jnp.asarray([-cfg.room_x, -cfg.room_y, 0.0]),
+        bounds_max=jnp.asarray([cfg.room_x, cfg.room_y, cfg.room_z]),
+        **_trial_overrides(cfg, "max_vel_xy", "max_vel_z", "max_accel_xy",
+                           "max_accel_z", "keepout_repulse_vel",
+                           "colavoid_dz_ignore"))
+
+
+def _trial_cgains(cfg: TrialConfig) -> ControlGains:
+    return ControlGains(**_trial_overrides(
+        cfg, "e_xy_thr", "e_z_thr", "kd", "K1_xy", "K2_xy", "K1_z", "K2_z"))
+
+
+def _engine_kw(cfg: TrialConfig) -> dict:
+    """The TrialConfig -> SimConfig mirror (minus `assignment`)."""
+    return dict(control_dt=cfg.control_dt, assign_every=cfg.assign_every,
+                dynamics=cfg.dynamics, tau=cfg.tau,
+                localization=cfg.localization,
+                flood_block=cfg.flood_block,
+                flood_phases=cfg.flood_phases,
+                colavoid_neighbors=cfg.colavoid_neighbors,
+                assign_eps=cfg.assign_eps,
+                cbaa_task_block=cfg.cbaa_task_block,
+                flight_fsm=True)
+
+
+def _dispatch_gains(cfg: TrialConfig, spec: FormationSpec,
+                    n: int) -> np.ndarray:
+    """On-dispatch gain design with the padded-constraint bucket rule:
+    fc graphs have exactly zero non-edges (a 1-slot bucket avoids padding
+    n-4 dead constraint slots into the solve); random simformN graphs
+    remove at most n-4 edges, a static bound that lets Monte-Carlo seeds
+    share one compiled solver."""
+    if not _SIMFORM.match(cfg.formation):
+        bucket = None
+    elif cfg.sim_fc:
+        bucket = 1
+    else:
+        bucket = max(n - 4, 1)
+    g = _gains_for(spec, bucket)
+    if cfg.gain_scale is not None:
+        g = g * cfg.gain_scale
+    return g
+
+
 def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
     """One seeded trial: ground start -> takeoff -> cycle through the
     group's formations -> COMPLETE/TERMINATE. Returns the finished FSM."""
@@ -213,17 +277,7 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
         rng, n, cfg.init_area_w, cfg.init_area_h, 0.0,
         min_dist=2 * cfg.init_radius)
 
-    def _overrides(*fields):
-        """Optional scale knobs: None = keep the reference default."""
-        return {k: getattr(cfg, k) for k in fields
-                if getattr(cfg, k) is not None}
-
-    sparams = SafetyParams(
-        bounds_min=jnp.asarray([-cfg.room_x, -cfg.room_y, 0.0]),
-        bounds_max=jnp.asarray([cfg.room_x, cfg.room_y, cfg.room_z]),
-        **_overrides("max_vel_xy", "max_vel_z", "max_accel_xy",
-                     "max_accel_z", "keepout_repulse_vel",
-                     "colavoid_dz_ignore"))
+    sparams = _trial_sparams(cfg)
     trial_timeout = (TRIAL_TIMEOUT if cfg.trial_timeout is None
                      else cfg.trial_timeout)
 
@@ -232,15 +286,7 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
     for spec in specs:
         formlib.check_feasible(spec, float(sparams.r_keep_out))
 
-    engine_kw = dict(control_dt=cfg.control_dt, assign_every=cfg.assign_every,
-                     dynamics=cfg.dynamics, tau=cfg.tau,
-                     localization=cfg.localization,
-                     flood_block=cfg.flood_block,
-                     flood_phases=cfg.flood_phases,
-                     colavoid_neighbors=cfg.colavoid_neighbors,
-                     assign_eps=cfg.assign_eps,
-                     cbaa_task_block=cfg.cbaa_task_block,
-                     flight_fsm=True)
+    engine_kw = _engine_kw(cfg)
     hover_cfg = sim.SimConfig(assignment="none", **engine_kw)
     fly_cfg = sim.SimConfig(assignment=cfg.assignment, **engine_kw)
 
@@ -253,8 +299,7 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
                            localization=cfg.localization == "flooded")
     fsm = TrialFSM(n, len(specs), takeoff_alt=sparams.takeoff_alt,
                    dt=cfg.control_dt, trial_timeout=trial_timeout)
-    cgains = ControlGains(**_overrides(
-        "e_xy_thr", "e_z_thr", "kd", "K1_xy", "K2_xy", "K1_z", "K2_z"))
+    cgains = _trial_cgains(cfg)
 
     cur_formation, cur_cfg = hover_formation, hover_cfg
     pending_go = False
@@ -309,18 +354,7 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
         if pending_dispatch is not None and not fsm.done:
             spec = specs[pending_dispatch]
             if pending_dispatch not in gains_cache:
-                # fc graphs have exactly zero non-edges: a 1-slot bucket
-                # avoids padding n-4 dead constraint slots into the solve
-                if not _SIMFORM.match(cfg.formation):
-                    bucket = None
-                elif cfg.sim_fc:
-                    bucket = 1
-                else:
-                    bucket = max(n - 4, 1)
-                g = _gains_for(spec, bucket)
-                if cfg.gain_scale is not None:
-                    g = g * cfg.gain_scale
-                gains_cache[pending_dispatch] = g
+                gains_cache[pending_dispatch] = _dispatch_gains(cfg, spec, n)
             cur_formation = make_formation(spec.points, spec.adjmat,
                                            gains_cache[pending_dispatch])
             cur_cfg = fly_cfg
@@ -354,6 +388,196 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
                       formation=cfg.formation,
                       trial_timeout=trial_timeout)
     return fsm
+
+
+def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
+                    ) -> list[SummaryTrialFSM]:
+    """B seeded trials in ONE batched rollout per chunk (the trial-axis
+    scaling move): per chunk the device runs every trial's next
+    `chunk_ticks` ticks in a single vmapped scan with donated carries and
+    returns O(B * chunk) supervisor summary bits plus O(B * n) cumulative
+    distances (`aclswarm_tpu.sim.summary`) — one host sync per chunk for
+    the whole batch, instead of per trial per chunk with the full
+    (chunk, n) metric stack.
+
+    Per-trial lifecycle actions (CMD_GO, formation commits) stay at chunk
+    boundaries exactly as in `run_trial`; commits rewrite that trial's row
+    of the batched formation/state on device. Dispatch-aligned auction
+    phase (`chunk_ticks % assign_every == 0`, enforced) keeps the
+    decimation conditionals shared across the batch, so the compiled
+    program still auctions every `assign_every` ticks, not every tick.
+
+    Trial lengths vary by seed, and a wave runs until its slowest trial
+    finishes — finished trials would burn device compute as dead rows. The
+    driver therefore COMPACTS the batch when at least half the rows are
+    done, gathering the live rows into the next power-of-two batch size
+    (16 -> 8 -> 4 -> 2 -> 1). Power-of-two buckets bound recompilation to
+    log2(B) shapes, all reused across waves. Compaction is a pure row
+    gather of the carries; per-trial results are unaffected (the B >= 8
+    parity test crosses several compaction points).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from aclswarm_tpu import sim
+    from aclswarm_tpu.sim import summary as sumlib
+
+    if cfg.record_dir is not None:
+        raise ValueError("record_dir needs the per-tick metric stack; run "
+                         "with batch=1 to record rollouts")
+    chunk = cfg.chunk_ticks
+    if chunk % cfg.assign_every:
+        raise ValueError(
+            f"batched trials require chunk_ticks ({chunk}) to be a "
+            f"multiple of assign_every ({cfg.assign_every}) so all trials "
+            "share the auction phase (docs/BATCHED_TRIALS.md)")
+    B = len(trial_indices)
+    flooded = cfg.localization == "flooded"
+
+    specs_per, q0s = [], []
+    for t in trial_indices:
+        seed = cfg.seed + t
+        rng = np.random.default_rng(seed)
+        specs = _formations_for_trial(cfg, seed)
+        specs_per.append(specs)
+        q0s.append(formgen.sample_cylinder_points(
+            rng, specs[0].n, cfg.init_area_w, cfg.init_area_h, 0.0,
+            min_dist=2 * cfg.init_radius))
+    n = specs_per[0][0].n
+    n_forms = len(specs_per[0])
+    if any(s[0].n != n or len(s) != n_forms for s in specs_per):
+        raise ValueError("batched trials need a uniform formation shape "
+                         "across the batch")
+
+    sparams = _trial_sparams(cfg)
+    trial_timeout = (TRIAL_TIMEOUT if cfg.trial_timeout is None
+                     else cfg.trial_timeout)
+    for specs in specs_per:
+        for spec in specs:
+            formlib.check_feasible(spec, float(sparams.r_keep_out))
+
+    fly_cfg = sim.SimConfig(assignment=cfg.assignment, **_engine_kw(cfg))
+    if flooded and cfg.assign_every % fly_cfg.flood_every:
+        raise ValueError("batched flooded trials require assign_every to "
+                         "be a multiple of flood_every (shared flood "
+                         "phase)")
+
+    states = [sim.init_state(q0, flying=False, localization=flooded)
+              for q0 in q0s]
+    bstate = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+    # pre-dispatch: auctions off per trial (the batch shares ONE compiled
+    # config, so the serial driver's assignment='none' hover config
+    # becomes this dynamic gate)
+    bstate = bstate.replace(assign_enabled=jnp.zeros((B,), bool))
+    dtype = bstate.swarm.q.dtype
+
+    # pre-dispatch formation rows: first-formation points, empty graph,
+    # zero gains -> zero control, exactly the serial hover formation
+    pts0 = jnp.asarray(
+        np.stack([np.asarray(s[0].points) for s in specs_per]), dtype)
+    bform = jax.vmap(make_formation)(
+        pts0, jnp.zeros((B, n, n), dtype),
+        jnp.zeros((B, n, n, 3, 3), dtype))
+
+    cgains = _trial_cgains(cfg)
+    dt = cfg.control_dt
+    window = max(1, int(round(BUFFER_SECONDS / dt)))
+    takeoff_alt = jnp.asarray(float(sparams.takeoff_alt), dtype)
+    fsms = [SummaryTrialFSM(n, n_forms,
+                            takeoff_alt=float(sparams.takeoff_alt), dt=dt,
+                            trial_timeout=trial_timeout)
+            for _ in range(B)]
+    all_fsms = list(fsms)       # original trial order, for the return
+    scarry = sumlib.init_carry(n, window, dtype=dtype, batch=B)
+    gains_cache: list[dict] = [dict() for _ in range(B)]
+    pending_go = [False] * B
+    pending_dispatch: list[Optional[int]] = [None] * B
+    max_ticks = int(trial_timeout / dt) + 10 * chunk
+    joy_vel = jnp.zeros((chunk, B, n, 3), dtype)
+    joy_yawrate = jnp.zeros((chunk, B, n), dtype)
+    joy_active = jnp.zeros((chunk, B, n), bool)
+
+    for _ in range(max_ticks // chunk + 1):
+        if all(f.done for f in fsms):
+            break
+        # compact: once half the rows are dead weight, gather the live
+        # trials into the next power-of-two batch (bounded recompiles)
+        live = [i for i, f in enumerate(fsms) if not f.done]
+        if len(fsms) > 1 and len(live) <= len(fsms) // 2:
+            new_b = 1
+            while new_b < len(live):
+                new_b *= 2
+            fillers = [i for i, f in enumerate(fsms) if f.done]
+            keep = sorted(live + fillers[:new_b - len(live)])
+            idx = jnp.asarray(keep)
+            bstate = jax.tree.map(lambda x: x[idx], bstate)
+            bform = jax.tree.map(lambda x: x[idx], bform)
+            scarry = jax.tree.map(lambda x: x[idx], scarry)
+            fsms = [fsms[k] for k in keep]
+            specs_per = [specs_per[k] for k in keep]
+            gains_cache = [gains_cache[k] for k in keep]
+            pending_go = [pending_go[k] for k in keep]
+            pending_dispatch = [pending_dispatch[k] for k in keep]
+        bc = len(fsms)
+        if joy_vel.shape[1] != bc:
+            joy_vel = jnp.zeros((chunk, bc, n, 3), dtype)
+            joy_yawrate = jnp.zeros((chunk, bc, n), dtype)
+            joy_active = jnp.zeros((chunk, bc, n), bool)
+        cmd = np.zeros((chunk, bc), np.int32)
+        for b in range(bc):
+            if pending_go[b]:
+                cmd[0, b] = sim.vehicle.CMD_GO
+                pending_go[b] = False
+        inputs = sim.ExternalInputs(cmd=jnp.asarray(cmd), joy_vel=joy_vel,
+                                    joy_yawrate=joy_yawrate,
+                                    joy_active=joy_active)
+        bstate, scarry, summ = sumlib.batched_rollout_summary(
+            bstate, scarry, bform, cgains, sparams, fly_cfg, chunk,
+            inputs, 0, window=window, takeoff_alt=takeoff_alt)
+
+        # the chunk's ONLY host sync: O(B*chunk) bools + (B, n) distances
+        conv = np.asarray(summ.conv_all)
+        grid = np.asarray(summ.grid_any)
+        toff = np.asarray(summ.taken_off)
+        auc_ok = np.asarray(summ.auctioned) & np.asarray(summ.assign_valid)
+        reass = np.asarray(summ.reassigned)
+        cum = np.asarray(summ.cumdist)
+
+        for b, fsm in enumerate(fsms):
+            if fsm.done:
+                continue
+            acts = fsm.process_chunk(conv[b], grid[b], toff[b], auc_ok[b],
+                                     reass[b])
+            fsm.observe_cumdist(cum[b])
+            for act in acts:
+                if act == "takeoff":
+                    pending_go[b] = True
+                elif act == "dispatch":
+                    pending_dispatch[b] = fsm.curr_formation_idx
+
+        # formation commits take effect at the chunk boundary (the serial
+        # driver's dispatch latency), rewriting one batch row on device
+        for b, fsm in enumerate(fsms):
+            idx = pending_dispatch[b]
+            pending_dispatch[b] = None
+            if idx is None or fsm.done:
+                continue
+            spec = specs_per[b][idx]
+            if idx not in gains_cache[b]:
+                gains_cache[b][idx] = _dispatch_gains(cfg, spec, n)
+            f_new = make_formation(
+                jnp.asarray(spec.points, dtype),
+                jnp.asarray(spec.adjmat, dtype),
+                jnp.asarray(gains_cache[b][idx], dtype))
+            bform = jax.tree.map(
+                lambda L, x: L.at[b].set(x.astype(L.dtype)), bform, f_new)
+            bstate = bstate.replace(
+                v2f=bstate.v2f.at[b].set(permutil.identity(n)),
+                tick=bstate.tick.at[b].set(0),
+                first_auction=bstate.first_auction.at[b].set(True),
+                assign_enabled=bstate.assign_enabled.at[b].set(True))
+            fsm.formation_dispatched()
+    return all_fsms
 
 
 def analyze(data: np.ndarray, n: int, m: int) -> dict:
@@ -406,22 +630,35 @@ def print_analysis(stats: dict) -> None:
 
 def run_trials(cfg: TrialConfig) -> dict:
     """The `trials.sh` loop: K seeded trials, append completed rows to the
-    CSV, print the `analyze_simtrials` summary. Returns the stats dict."""
+    CSV, print the `analyze_simtrials` summary. Returns the stats dict.
+    With ``cfg.batch > 1`` the trials run in waves of `batch` through the
+    vmapped rollout (`run_trial_batch`); rows are appended as each trial
+    (serial) or wave (batched) finishes, so a crash mid-run keeps the
+    evidence gathered so far — CSV order is trial order either way."""
     rows = []
     n = None
-    for t in range(cfg.trials):
-        fsm = run_trial(cfg, t)
+
+    def _log_and_append(t, fsm):
+        nonlocal n
         n = fsm.n
-        status = NAMES[fsm.state]
         if cfg.verbose:
             times = ", ".join(f"{x:.2f}" for x in fsm.times)
-            print(f"trial {t} (seed {cfg.seed + t}): {status}"
+            print(f"trial {t} (seed {cfg.seed + t}): {NAMES[fsm.state]}"
                   f" [conv times: {times}]", flush=True)
         if fsm.completed:
             row = fsm.csv_row(t)
             rows.append(row)
             with open(cfg.out, "a", newline="") as f:
                 csv.writer(f).writerow(row)
+
+    if cfg.batch > 1:
+        for start in range(0, cfg.trials, cfg.batch):
+            idxs = list(range(start, min(start + cfg.batch, cfg.trials)))
+            for t, fsm in zip(idxs, run_trial_batch(cfg, idxs)):
+                _log_and_append(t, fsm)
+    else:
+        for t in range(cfg.trials):
+            _log_and_append(t, run_trial(cfg, t))
     if rows:
         stats = analyze(np.asarray(rows, dtype=np.float64), n, cfg.trials)
     else:

@@ -3,8 +3,14 @@ from aclswarm_tpu.parallel.mesh import (AGENT_AXIS, formation_sharding,
                                         make_mesh, replicated, row_sharding,
                                         shard_problem, sim_state_sharding)
 from aclswarm_tpu.parallel import multihost
-from aclswarm_tpu.parallel.rollout import sharded_rollout_fn, sharded_step_fn
+from aclswarm_tpu.parallel.rollout import (batched_formation_sharding,
+                                           batched_rollout_fn,
+                                           batched_sim_state_sharding,
+                                           sharded_rollout_fn,
+                                           sharded_step_fn)
 
 __all__ = ["AGENT_AXIS", "make_mesh", "row_sharding", "replicated",
            "sim_state_sharding", "formation_sharding", "shard_problem",
-           "sharded_step_fn", "sharded_rollout_fn", "multihost"]
+           "sharded_step_fn", "sharded_rollout_fn", "batched_rollout_fn",
+           "batched_sim_state_sharding", "batched_formation_sharding",
+           "multihost"]

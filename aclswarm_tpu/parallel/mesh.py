@@ -74,7 +74,7 @@ def sim_state_sharding(mesh: Mesh, localization: bool = False
         v2f=row, tick=rep,
         flight=sim.FlightState(mode=row, ticks_in_mode=row,
                                initial_alt=row, takeoff_alt=row),
-        loc=loc, first_auction=rep)
+        loc=loc, first_auction=rep, assign_enabled=rep)
 
 
 def formation_sharding(mesh: Mesh) -> Formation:

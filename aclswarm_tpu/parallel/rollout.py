@@ -12,9 +12,11 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from aclswarm_tpu import sim
 from aclswarm_tpu.parallel import mesh as meshlib
+from aclswarm_tpu.sim import engine as _engine
 
 
 def _loc_in_sharding(cfg, localization):
@@ -52,5 +54,48 @@ def sharded_rollout_fn(mesh, formation_sharded, gains, sparams, cfg,
     def roll(state):
         return sim.rollout(state, formation_sharded, gains, sparams, cfg,
                            n_ticks)
+
+    return roll
+
+
+def _prepend_batch_axis(sharding: NamedSharding) -> NamedSharding:
+    """Lift a per-trial sharding to a trial-batched array: the new leading
+    batch axis replicates, the agent axis keeps its mesh placement."""
+    return NamedSharding(sharding.mesh, P(*((None,) + tuple(sharding.spec))))
+
+
+def batched_sim_state_sharding(mesh, localization: bool = False):
+    """Sharding pytree for a trial-batched `SimState` (leaves (B, ...)):
+    batch axis replicated, per-agent axes row-sharded as in
+    `mesh.sim_state_sharding`."""
+    return jax.tree.map(
+        _prepend_batch_axis,
+        meshlib.sim_state_sharding(mesh, localization=localization),
+        is_leaf=lambda x: isinstance(x, NamedSharding))
+
+
+def batched_formation_sharding(mesh):
+    """Sharding pytree for a (B, ...)-stacked `Formation`."""
+    return jax.tree.map(
+        _prepend_batch_axis, meshlib.formation_sharding(mesh),
+        is_leaf=lambda x: isinstance(x, NamedSharding))
+
+
+def batched_rollout_fn(mesh, formation_batched, gains, sparams, cfg,
+                       n_ticks: int, localization: bool | None = None):
+    """Build a jitted rollout combining BOTH scaling axes: vmap over the
+    trial batch (outer, replicated — trials are independent) and GSPMD
+    sharding over the agent axis (inner — the collectives of
+    `sharded_step_fn` now carry a batch dimension). The returned callable
+    maps a (B, ...)-batched state to (final state, time-major batched
+    `StepMetrics`), one compiled program per chunk for B x n_ticks ticks.
+    """
+    st_sh = batched_sim_state_sharding(
+        mesh, localization=_loc_in_sharding(cfg, localization))
+
+    @partial(jax.jit, in_shardings=(st_sh,), donate_argnums=(0,))
+    def roll(state):
+        return _engine.batched_scan(state, formation_batched, gains,
+                                    sparams, cfg, n_ticks)
 
     return roll

@@ -1,10 +1,11 @@
 """On-device closed-loop swarm simulation (SURVEY.md §7 layer 5)."""
 from aclswarm_tpu.sim import localization, vehicle
 from aclswarm_tpu.sim.engine import (SimConfig, SimState, StepMetrics,
-                                     init_state, rollout, step)
+                                     batched_rollout, init_state, rollout,
+                                     step)
 from aclswarm_tpu.sim.localization import EstimateTable
 from aclswarm_tpu.sim.vehicle import ExternalInputs, FlightState
 
 __all__ = ["SimConfig", "SimState", "StepMetrics", "init_state", "rollout",
-           "step", "vehicle", "ExternalInputs", "FlightState",
-           "localization", "EstimateTable"]
+           "batched_rollout", "step", "vehicle", "ExternalInputs",
+           "FlightState", "localization", "EstimateTable"]

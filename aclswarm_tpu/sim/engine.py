@@ -134,6 +134,13 @@ class SimState:
     # auctions; cleared by the first valid one.
     first_auction: jnp.ndarray = struct.field(
         default_factory=lambda: jnp.asarray(True))
+    # () bool: dynamic master switch for the auto-auction. The serial trial
+    # driver swaps in an assignment='none' SimConfig for the pre-dispatch
+    # hover phase; a *batched* rollout shares one compiled config across B
+    # trials in different lifecycle phases, so the per-trial gate must be
+    # data, not compile-time structure (see `batched_rollout`).
+    assign_enabled: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.asarray(True))
 
 
 @struct.dataclass
@@ -237,13 +244,27 @@ def assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
 
 def step(state: SimState, formation: Formation, gains: ControlGains,
          sparams: SafetyParams, cfg: SimConfig,
-         inputs: ExternalInputs | None = None
+         inputs: ExternalInputs | None = None,
+         shared_tick: jnp.ndarray | None = None
          ) -> tuple[SimState, StepMetrics]:
-    """One 100 Hz control tick for the whole swarm (§3.3 pipeline)."""
+    """One 100 Hz control tick for the whole swarm (§3.3 pipeline).
+
+    ``shared_tick`` (optional, scalar) replaces ``state.tick`` as the
+    source of the decimation phase (auto-auction period, flood cadence).
+    A batched rollout vmaps this function over a trial axis; a predicate
+    derived from the *batched* tick would turn every `lax.cond` into a
+    both-branches `select` — the auction would then run every tick
+    instead of every `assign_every`. Passing the tick as an unbatched
+    scalar keeps the conditionals real. Only valid when every trial's
+    tick is congruent to ``shared_tick`` modulo `assign_every` and
+    `flood_every` — the batched driver guarantees this by aligning
+    dispatches to chunk boundaries with `chunk_ticks % assign_every == 0`.
+    """
     swarm, goal, v2f, fs = state.swarm, state.goal, state.v2f, state.flight
     n = swarm.q.shape[0]
     if inputs is None:
         inputs = ExternalInputs.none(n, swarm.q.dtype)
+    tick_src = state.tick if shared_tick is None else shared_tick
 
     # --- operator flight-mode broadcast (`safety.cpp:101-121`) ---
     if cfg.flight_fsm:
@@ -258,11 +279,11 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
                              "init_state(..., localization=True)")
         if cfg.flood_phases == 1:
             loc = loclib.tick(loc, swarm.q, formation.adjmat, v2f,
-                              (state.tick % cfg.flood_every) == 0,
+                              (tick_src % cfg.flood_every) == 0,
                               target_block=cfg.flood_block)
         else:
             loc = loclib.tick_phased(loc, swarm.q, formation.adjmat, v2f,
-                                     state.tick, cfg.flood_every,
+                                     tick_src, cfg.flood_every,
                                      cfg.flood_phases,
                                      target_block=cfg.flood_block)
         est = loc.est
@@ -274,22 +295,31 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
     # --- auto-auction (decimated onto its own period, §2.5) ---
     # auctions only run once the fleet is airborne: the reference only
     # starts auctioning after the formation is committed in flight
-    # (`coordination_ros.cpp:136-153`)
-    do_assign = (state.tick % cfg.assign_every) == 0
+    # (`coordination_ros.cpp:136-153`). The airborne/enabled gates are
+    # applied *outside* the cond as a select on its result, so the cond
+    # predicate stays a pure function of the (shareable) tick — under the
+    # batched vmap a per-trial predicate would force both branches to run
+    # every tick. Gated-off ticks discard the candidate, bit-identical to
+    # never running it.
+    do_assign = (tick_src % cfg.assign_every) == 0
+    gate = state.assign_enabled
     if cfg.flight_fsm:
-        do_assign = do_assign & jnp.all(flying)
+        gate = gate & jnp.all(flying)
     if cfg.assignment == "none":
         new_v2f, valid = v2f, jnp.asarray(True)
+        take = jnp.asarray(False)
     else:
-        new_v2f, valid = lax.cond(
+        cand_v2f, cand_valid = lax.cond(
             do_assign,
             lambda s, f, p, e: assign(s, f, p, cfg, e,
                                       first=state.first_auction),
             lambda s, f, p, e: (p, jnp.asarray(True)),
             swarm, formation, v2f, est)
-    reassigned = do_assign & jnp.any(new_v2f != v2f)
-    auctioned = (do_assign if cfg.assignment != "none"
-                 else jnp.asarray(False))
+        take = do_assign & gate
+        new_v2f = jnp.where(take, cand_v2f, v2f)
+        valid = jnp.where(take, cand_valid, True)
+    reassigned = take & jnp.any(new_v2f != v2f)
+    auctioned = take
     first_auction = state.first_auction & ~(auctioned & valid)
     v2f = new_v2f
 
@@ -340,7 +370,8 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
 
     new_state = SimState(swarm=swarm, goal=goal, v2f=v2f,
                          tick=state.tick + 1, flight=fs, loc=loc,
-                         first_auction=first_auction)
+                         first_auction=first_auction,
+                         assign_enabled=state.assign_enabled)
     return new_state, StepMetrics(distcmd_norm=distcmd_norm, ca_active=ca,
                                   assign_valid=valid, reassigned=reassigned,
                                   auctioned=auctioned, q=swarm.q,
@@ -364,3 +395,70 @@ def rollout(state: SimState, formation: Formation, gains: ControlGains,
         return step(s, formation, gains, sparams, cfg, x)
 
     return lax.scan(body, state, inputs, length=n_ticks)
+
+
+def batched_scan(state: SimState, formation: Formation, gains: ControlGains,
+                 sparams: SafetyParams, cfg: SimConfig, n_ticks: int,
+                 inputs: ExternalInputs | None = None, tick0=0
+                 ) -> tuple[SimState, StepMetrics]:
+    """The un-jitted body of `batched_rollout` (reused by the fused
+    rollout+summary program in `aclswarm_tpu.sim.summary`)."""
+    ticks = jnp.arange(n_ticks, dtype=jnp.int32) \
+        + jnp.asarray(tick0, jnp.int32)
+
+    if inputs is None:
+        def body(s, t):
+            vstep = jax.vmap(
+                lambda st, f: step(st, f, gains, sparams, cfg, None,
+                                   shared_tick=t),
+                in_axes=(0, 0))
+            return vstep(s, formation)
+
+        return lax.scan(body, state, ticks, length=n_ticks)
+
+    def body(s, x):
+        t, inp = x
+        vstep = jax.vmap(
+            lambda st, f, i: step(st, f, gains, sparams, cfg, i,
+                                  shared_tick=t),
+            in_axes=(0, 0, 0))
+        return vstep(s, formation, inp)
+
+    return lax.scan(body, state, (ticks, inputs), length=n_ticks)
+
+
+@partial(jax.jit, static_argnames=("n_ticks", "cfg"), donate_argnums=(0,))
+def batched_rollout(state: SimState, formation: Formation,
+                    gains: ControlGains, sparams: SafetyParams,
+                    cfg: SimConfig, n_ticks: int,
+                    inputs: ExternalInputs | None = None, tick0=0
+                    ) -> tuple[SimState, StepMetrics]:
+    """Roll **B independent trials** forward ``n_ticks`` ticks in ONE
+    compiled scan — the trial axis analogue of the agent-axis sharding.
+
+    Batch-axis conventions (axis 0 = trials everywhere except time):
+
+    - ``state``: a `SimState` whose every leaf carries a leading ``(B,)``
+      axis (build per-trial states with `init_state` and
+      ``jax.tree.map(lambda *xs: jnp.stack(xs), *states)``). The carry is
+      donated: chunked drivers update the batch in place.
+    - ``formation``: leaves stacked ``(B, ...)`` — trials may fly
+      *different* formations of the same shape ``n`` (the Monte-Carlo
+      `simformN` case: one seed per trial).
+    - ``gains``/``sparams``/``cfg``: shared across the batch (scalar
+      control gains and compile-time config are per-*config*, not
+      per-trial).
+    - ``inputs``: time-stacked then batch-stacked, leaves
+      ``(n_ticks, B, ...)``; None = no external inputs for any trial.
+    - ``tick0``: the shared decimation phase of the batch's first tick
+      (see `step`'s ``shared_tick``). Trials must agree on their tick
+      modulo `assign_every`/`flood_every`; the batched trials driver
+      guarantees it by aligning dispatch events to chunk boundaries.
+
+    Returns the final batched state and `StepMetrics` with leaves
+    ``(n_ticks, B, ...)`` — bit-identical per trial to B serial
+    `rollout` calls with the same seeds (tested in
+    `tests/test_batched.py`).
+    """
+    return batched_scan(state, formation, gains, sparams, cfg, n_ticks,
+                        inputs, tick0)

@@ -1,0 +1,189 @@
+"""On-device supervisor observables: O(1)-per-tick summaries of a rollout.
+
+The trials harness historically moved the full `StepMetrics` stack to the
+host every chunk — ``q: (ticks, n, 3)`` plus six more per-tick arrays,
+~720 MB of host transfer per n=1000 trial — and re-derived the supervisor
+predicates (`aclswarm_tpu.harness.supervisor`) tick by tick in Python.
+Everything the trial FSM actually *branches on* is a per-tick scalar:
+
+- convergence: every vehicle's trailing 1 s mean ``|distcmd| <`` 1 m/s
+  (`supervisor.py:61,297-316`) — here the windowed means are reduced on
+  device to one ``all(...)`` bool per tick (`ChunkSummary.conv_all`);
+- gridlock: any vehicle's trailing 1 s CA-duty ``> 0.95``
+  (`supervisor.py:62,318-337`) -> `grid_any`;
+- takeoff: all ``|z - takeoff_alt| <`` 0.05 m (`supervisor.py:285-291`)
+  -> `taken_off`;
+- assignment events: already per-tick scalars, passed through.
+
+The supervisor's ring buffers hold *consecutive* ticks (they are pushed
+every tick a predicate is evaluated and cleared on state transitions), so
+a buffer-of-W mean equals the trailing-W-tick mean whenever the buffer is
+full — the host FSM keeps the push counters (cheap integers) and consults
+the device bools only when its buffer would have been full. Cross-chunk
+window continuity is carried in `SummaryCarry` (the last W-1 samples),
+which never visits the host.
+
+The one per-vehicle metric in the reference CSV — EWMA-smoothed planar
+distance (`supervisor.py:452-478`) — is integrated on device in the same
+carry and read back as an ``(n,)`` *cumulative* total per chunk, O(n) per
+chunk instead of O(ticks * n).
+
+`summarize_chunk` is pure JAX over a single trial's time-major metrics;
+`batched_rollout_summary` fuses the batched rollout (`engine
+.batched_rollout`) with a vmapped summary reduction into one jitted
+program, so per chunk the host receives O(B * ticks) bools + O(B * n)
+distance totals (+ an optional decimated pose trace) for the whole batch.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from aclswarm_tpu.sim import engine, vehicle
+from aclswarm_tpu.sim.engine import StepMetrics
+
+# supervisor thresholds (single source: `harness.supervisor` mirrors the
+# reference `supervisor.py:60-62,83`; duplicated here as module constants
+# so the device code does not import the numpy-side harness)
+ZERO_POS_THR = 0.05
+ORIG_ZERO_VEL_THR = 1.00
+AVG_ACTIVE_CA_THR = 0.95
+EWMA_ALPHA = 0.98
+
+
+@struct.dataclass
+class SummaryCarry:
+    """Cross-chunk reduction state (device-resident; never synced)."""
+
+    dn_hist: jnp.ndarray   # (W-1, n) trailing |distcmd| before this chunk
+    ca_hist: jnp.ndarray   # (W-1, n) trailing CA-active (float)
+    fx: jnp.ndarray        # (n,) EWMA-filtered x
+    fy: jnp.ndarray        # (n,) EWMA-filtered y
+    cumdist: jnp.ndarray   # (n,) accumulated filtered planar distance
+    inited: jnp.ndarray    # () bool: EWMA filter seeded?
+
+
+@struct.dataclass
+class ChunkSummary:
+    """Per-chunk supervisor observables (host-facing, O(ticks) scalars)."""
+
+    conv_all: jnp.ndarray      # (T,) all vehicles' trailing-W mean dn < thr
+    grid_any: jnp.ndarray      # (T,) any vehicle's trailing-W CA duty > thr
+    taken_off: jnp.ndarray     # (T,) all |z - takeoff_alt| < ZERO_POS_THR
+    all_flying: jnp.ndarray    # (T,) every vehicle in FLYING mode
+    auctioned: jnp.ndarray     # (T,) pass-through from StepMetrics
+    assign_valid: jnp.ndarray  # (T,)
+    reassigned: jnp.ndarray    # (T,)
+    cumdist: jnp.ndarray       # (n,) EWMA planar distance, trial-cumulative
+    q_dec: jnp.ndarray | None  # (ceil(T/pose_every), n, 3) or None
+
+
+def init_carry(n: int, window: int, dtype=jnp.float32,
+               batch: int | None = None) -> SummaryCarry:
+    """Fresh reduction state for a trial (or ``batch`` trials)."""
+    lead = () if batch is None else (batch,)
+    return SummaryCarry(
+        dn_hist=jnp.zeros(lead + (window - 1, n), dtype),
+        ca_hist=jnp.zeros(lead + (window - 1, n), dtype),
+        fx=jnp.zeros(lead + (n,), dtype),
+        fy=jnp.zeros(lead + (n,), dtype),
+        cumdist=jnp.zeros(lead + (n,), dtype),
+        inited=jnp.zeros(lead, bool))
+
+
+def _trailing_window_mean(x: jnp.ndarray, hist: jnp.ndarray, window: int
+                          ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Mean over the trailing ``window`` ticks for each tick of the chunk.
+
+    ``x`` is (T, n), ``hist`` the (W-1, n) samples preceding the chunk.
+    Returns ((T, n) means, new (W-1, n) hist). Ticks whose window reaches
+    back before the trial start average in the zero-initialized history —
+    the host FSM never consults those ticks (its push counters gate
+    full-buffer semantics exactly).
+    """
+    ext = jnp.concatenate([hist, x], axis=0)            # (W-1+T, n)
+    csum = jnp.cumsum(ext, axis=0)
+    csum = jnp.concatenate([jnp.zeros_like(csum[:1]), csum], axis=0)
+    means = (csum[window:] - csum[:-window]) / window   # (T, n)
+    new_hist = ext[ext.shape[0] - (window - 1):] if window > 1 \
+        else ext[:0]
+    return means, new_hist
+
+
+def _ewma_distance(q: jnp.ndarray, carry: SummaryCarry
+                   ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                              jnp.ndarray]:
+    """EWMA position filter + planar path length (`supervisor.py:452-478`),
+    advanced over the chunk. Runs continuously from the trial's first tick
+    (the host reads cumulative totals at chunk boundaries and differences
+    them over its logging windows)."""
+    def body(c, xy):
+        fx, fy, dist, inited = c
+        nx = jnp.where(inited, EWMA_ALPHA * fx + (1 - EWMA_ALPHA) * xy[0],
+                       xy[0])
+        ny = jnp.where(inited, EWMA_ALPHA * fy + (1 - EWMA_ALPHA) * xy[1],
+                       xy[1])
+        dist = dist + jnp.where(inited, jnp.hypot(nx - fx, ny - fy), 0.0)
+        return (nx, ny, dist, jnp.asarray(True)), None
+
+    (fx, fy, dist, inited), _ = lax.scan(
+        body, (carry.fx, carry.fy, carry.cumdist, carry.inited),
+        (q[:, :, 0], q[:, :, 1]))
+    return fx, fy, dist, inited
+
+
+def summarize_chunk(metrics: StepMetrics, carry: SummaryCarry,
+                    window: int, takeoff_alt, pose_every: int = 0
+                    ) -> tuple[ChunkSummary, SummaryCarry]:
+    """Reduce one trial's time-major (T, ...) `StepMetrics` to per-tick
+    supervisor scalars + cumulative distance. Pure JAX — call inside the
+    rollout's jit (the (T, n) intermediates then never reach the host) or
+    standalone on recorded metrics (the parity tests do)."""
+    dn = metrics.distcmd_norm
+    ca = metrics.ca_active.astype(dn.dtype)
+    dn_mean, dn_hist = _trailing_window_mean(dn, carry.dn_hist, window)
+    ca_mean, ca_hist = _trailing_window_mean(ca, carry.ca_hist, window)
+    fx, fy, cumdist, inited = _ewma_distance(metrics.q, carry)
+
+    summary = ChunkSummary(
+        conv_all=jnp.all(dn_mean < ORIG_ZERO_VEL_THR, axis=1),
+        grid_any=jnp.any(ca_mean > AVG_ACTIVE_CA_THR, axis=1),
+        taken_off=jnp.all(
+            jnp.abs(metrics.q[:, :, 2] - takeoff_alt) < ZERO_POS_THR,
+            axis=1),
+        all_flying=jnp.all(metrics.mode == vehicle.FLYING, axis=1),
+        auctioned=metrics.auctioned,
+        assign_valid=metrics.assign_valid,
+        reassigned=metrics.reassigned,
+        cumdist=cumdist,
+        q_dec=metrics.q[::pose_every] if pose_every else None)
+    new_carry = SummaryCarry(dn_hist=dn_hist, ca_hist=ca_hist,
+                             fx=fx, fy=fy, cumdist=cumdist, inited=inited)
+    return summary, new_carry
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "n_ticks", "window", "pose_every"),
+         donate_argnums=(0, 1))
+def batched_rollout_summary(state, carry: SummaryCarry, formation, gains,
+                            sparams, cfg, n_ticks: int, inputs=None,
+                            tick0=0, *, window: int, takeoff_alt,
+                            pose_every: int = 0):
+    """One device launch for B trials x ``n_ticks`` ticks: the batched
+    scan (`engine.batched_rollout` semantics, donated carries) fused with
+    the vmapped supervisor reduction. Returns ``(state, carry, summary)``
+    where the summary's per-tick leaves are batch-major ``(B, T)`` and
+    ``cumdist`` is ``(B, n)`` — the only arrays a trials driver needs to
+    sync per chunk."""
+    state, metrics = engine.batched_scan(state, formation, gains, sparams,
+                                         cfg, n_ticks, inputs, tick0)
+    # metrics leaves are (T, B, ...): map the per-trial reducer over axis 1
+    summary, carry = jax.vmap(
+        lambda m, c: summarize_chunk(m, c, window, takeoff_alt,
+                                     pose_every),
+        in_axes=(1, 0))(metrics, carry)
+    return state, carry, summary

@@ -28,6 +28,7 @@ Methodology (pinned after round-1 variance, see VERDICT r1 weak #9):
 """
 import json
 import os
+import subprocess
 import sys
 import threading
 from pathlib import Path
@@ -42,30 +43,68 @@ K = 400
 # emits a diagnostic line — keeping the one-JSON-line contract — and
 # hard-exits. Normal runs finish in ~3-4 min incl. first compile.
 WATCHDOG_S = 900.0
+# a wedged tunnel blocks jax.devices() itself, so before arming the main
+# measurement the backend is probed in a THROWAWAY subprocess with a
+# short budget: a wedge costs PROBE_TIMEOUT_S, not the full 900 s
+PROBE_TIMEOUT_S = 120.0
+_PROBE_CODE = "import jax; jax.devices(); print('ok')"
 
 
 _done = threading.Event()   # set by main before printing: closes the
 #                             boundary race where cancel() cannot stop an
 #                             already-fired Timer callback
+_done_lock = threading.Lock()   # makes check-and-exit vs. set atomic: a
+#                                 timer firing at the measurement boundary
+#                                 either sees _done set (no-op) or wins
+#                                 the lock before main can set it — never
+#                                 a second line after a result line
 
 
-def _watchdog():
-    if _done.is_set():
-        return              # the measurement finished at the boundary
+def _error_line(msg: str) -> None:
     print(json.dumps({
         "metric": f"sinkhorn_assign_n{N}_hz",
         "value": 0.0,
         "unit": "Hz",
         "vs_baseline": 0.0,
-        "error": f"bench did not complete within {WATCHDOG_S:.0f} s — "
-                 "device backend unreachable (tunnel wedge?); see "
-                 "benchmarks/results/scale_tpu.json for the committed "
-                 "measurement",
+        "error": msg,
     }), flush=True)
-    os._exit(2)
+
+
+def _watchdog():
+    with _done_lock:
+        if _done.is_set():
+            return          # the measurement finished at the boundary
+        _error_line(f"bench did not complete within {WATCHDOG_S:.0f} s — "
+                    "device backend unreachable (tunnel wedge?); see "
+                    "benchmarks/results/scale_tpu.json for the committed "
+                    "measurement")
+        os._exit(2)
+
+
+def _probe_device(timeout_s: float | None = None) -> bool:
+    """True iff a subprocess can enumerate jax devices within the budget.
+    Run as a separate process because a wedged device tunnel hangs the
+    *calling* process inside jax.devices() uncancellably."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            capture_output=True, text=True,
+            timeout=PROBE_TIMEOUT_S if timeout_s is None else timeout_s,
+            cwd=str(Path(__file__).resolve().parent))
+        return r.returncode == 0 and "ok" in r.stdout
+    except (subprocess.TimeoutExpired, OSError):
+        return False
 
 
 def main():
+    if not _probe_device():
+        _error_line(f"device backend probe did not answer within "
+                    f"{PROBE_TIMEOUT_S:.0f} s (tunnel wedge?) — skipping "
+                    "the measurement instead of burning the "
+                    f"{WATCHDOG_S:.0f} s budget; see "
+                    "benchmarks/results/scale_tpu.json for the committed "
+                    "measurement")
+        return 2
     timer = threading.Timer(WATCHDOG_S, _watchdog)
     timer.daemon = True
     timer.start()
@@ -74,7 +113,8 @@ def main():
     from scale import sinkhorn_throughput
 
     sk = sinkhorn_throughput(N, K, reps=5)
-    _done.set()
+    with _done_lock:        # measurement done: from here the watchdog
+        _done.set()         # can no longer claim the output line
     timer.cancel()
     print(json.dumps({
         "metric": f"sinkhorn_assign_n{N}_hz",
@@ -97,7 +137,8 @@ def main():
         "latency_ms": round(sk["latency_ms"], 2),
         "latency_decomposition": sk["latency_decomposition"],
     }))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -214,7 +214,8 @@ EXPECTED_PCT = {
 }
 
 
-def run_config(name: str, overrides: dict, m: int, seed: int = 1) -> dict:
+def run_config(name: str, overrides: dict, m: int, seed: int = 1,
+               batch: int = 1) -> dict:
     # trials append to a TEMP file which atomically replaces the
     # committed CSV only after the config finishes — a crashed or wedged
     # run (observed: the device tunnel can hang before trial 0 ends)
@@ -222,6 +223,16 @@ def run_config(name: str, overrides: dict, m: int, seed: int = 1) -> dict:
     out = RESULTS / f"trials_{name}.csv"
     tmp = RESULTS / f".trials_{name}.csv.tmp"
     tmp.unlink(missing_ok=True)
+    overrides = dict(overrides)
+    if batch > 1:
+        # the batched rollout shares the auction phase across trials, so
+        # the FSM action latency (chunk) must align to the auction period
+        # (docs/BATCHED_TRIALS.md); bump the chunk up to the next multiple
+        ae = overrides.get("assign_every",
+                           triallib.TrialConfig.assign_every)
+        ct = overrides.get("chunk_ticks", triallib.TrialConfig.chunk_ticks)
+        overrides["chunk_ticks"] = ct if ct % ae == 0 else -(-ct // ae) * ae
+        overrides["batch"] = min(batch, m)
     cfg = triallib.TrialConfig(trials=m, seed=seed, out=str(tmp),
                                verbose=True, **overrides)
     t0 = time.time()
@@ -236,6 +247,10 @@ def run_config(name: str, overrides: dict, m: int, seed: int = 1) -> dict:
         # loss this path exists to prevent
         stats["csv_kept_from_prior_run"] = out.exists()
     stats["wall_s"] = round(time.time() - t0, 1)
+    # batch size + per-trial wall clock: the batched-rollout win (or the
+    # serial baseline) stays visible in the committed summary
+    stats["batch"] = getattr(cfg, "batch", 1)
+    stats["wall_s_per_trial"] = round(stats["wall_s"] / max(m, 1), 2)
     stats["config"] = {k: v for k, v in dataclasses.asdict(cfg).items()
                        if k not in ("out", "verbose")}
     # the recorded config must name the committed artifact, not the temp
@@ -249,6 +264,10 @@ def main(argv=None):
                     help="1-2 trials per config (smoke)")
     ap.add_argument("--only", default=None, help="run a single config")
     ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=1,
+                    help="trials per device launch (> 1 uses the vmapped "
+                         "batched rollout; chunk_ticks auto-aligns to "
+                         "assign_every)")
     args = ap.parse_args(argv)
 
     import jax
@@ -263,7 +282,8 @@ def main(argv=None):
             continue
         n_trials = mq if args.quick else m
         print(f"=== {name} (m={n_trials}) ===", flush=True)
-        stats = run_config(name, overrides, n_trials, args.seed)
+        stats = run_config(name, overrides, n_trials, args.seed,
+                           batch=args.batch)
         summary["configs"][name] = stats
         print(json.dumps({k: v for k, v in stats.items()
                           if k != "config"}), flush=True)

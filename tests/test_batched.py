@@ -1,0 +1,364 @@
+"""Batched multi-trial rollout engine tests.
+
+Pins the three tentpole claims of the batched trials harness:
+
+1. `sim.batched_rollout` (vmap over the trial axis, shared decimation
+   phase) is BIT-IDENTICAL to B serial `sim.rollout` calls with the same
+   seeds, for every assignment mode and both information models;
+2. the on-device supervisor summaries (`sim.summary`) equal host
+   recomputation over the full per-tick trace;
+3. the batched trials driver (`harness.trials.run_trial_batch` +
+   `supervisor.SummaryTrialFSM`) reaches tick-identical FSM decisions to
+   the serial reference driver.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from aclswarm_tpu import sim
+from aclswarm_tpu.core.types import ControlGains, SafetyParams, make_formation
+from aclswarm_tpu.harness import supervisor, trials
+from aclswarm_tpu.harness.supervisor import NAMES, SummaryTrialFSM, TrialFSM
+from aclswarm_tpu.sim import summary as sumlib
+
+
+def _batch_problem(B, n, seed=0, flying=True, localization=False):
+    rng = np.random.default_rng(seed)
+    adj = np.ones((n, n)) - np.eye(n)
+    forms, states = [], []
+    for _ in range(B):
+        pts = rng.normal(size=(n, 3)) * 5
+        gains = rng.normal(size=(n, n, 3, 3)) * 0.01
+        forms.append(make_formation(jnp.asarray(pts), jnp.asarray(adj),
+                                    jnp.asarray(gains)))
+        states.append(sim.init_state(
+            rng.normal(size=(n, 3)) * 5 + np.array([0, 0, 2.0]),
+            flying=flying, localization=localization))
+    sp = SafetyParams(bounds_min=jnp.asarray([-50.0, -50.0, 0.0]),
+                     bounds_max=jnp.asarray([50.0, 50.0, 20.0]))
+    bstate = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+    bform = jax.tree.map(lambda *xs: jnp.stack(xs), *forms)
+    return states, forms, bstate, bform, sp
+
+
+METRIC_FIELDS = ("distcmd_norm", "ca_active", "assign_valid", "reassigned",
+                 "auctioned", "q", "mode", "v2f")
+
+
+def _assert_bit_identical(mets, bm, finals, bf):
+    for b in range(len(mets)):
+        for name in METRIC_FIELDS:
+            a = np.asarray(getattr(mets[b], name))
+            bb = np.asarray(getattr(bm, name))[:, b]
+            assert np.array_equal(a, bb), (b, name)
+        np.testing.assert_array_equal(np.asarray(finals[b].swarm.q),
+                                      np.asarray(bf.swarm.q)[b])
+        np.testing.assert_array_equal(np.asarray(finals[b].swarm.vel),
+                                      np.asarray(bf.swarm.vel)[b])
+        np.testing.assert_array_equal(np.asarray(finals[b].v2f),
+                                      np.asarray(bf.v2f)[b])
+
+
+@pytest.mark.parametrize("assignment", ["auction", "sinkhorn", "cbaa"])
+def test_batched_rollout_bit_parity_truth(assignment):
+    """vmap over trials == B serial rollouts, bit for bit (ground-truth
+    information model, all three assignment paths)."""
+    B, n, T = 3, 6, 130
+    states, forms, bstate, bform, sp = _batch_problem(B, n, seed=1)
+    cfg = sim.SimConfig(assignment=assignment, assign_every=60,
+                        flight_fsm=True)
+    finals, mets = [], []
+    for s, f in zip(states, forms):
+        fs, m = sim.rollout(s, f, ControlGains(), sp, cfg, T)
+        finals.append(fs)
+        mets.append(m)
+    bf, bm = sim.batched_rollout(bstate, bform, ControlGains(), sp, cfg, T)
+    _assert_bit_identical(mets, bm, finals, bf)
+
+
+def test_batched_rollout_bit_parity_flooded():
+    """Same parity with the flooded localization model (CBAA consumes the
+    estimate tables; the flood cond keys off the shared tick)."""
+    B, n, T = 2, 6, 130
+    states, forms, bstate, bform, sp = _batch_problem(
+        B, n, seed=2, localization=True)
+    cfg = sim.SimConfig(assignment="cbaa", assign_every=60,
+                        localization="flooded", flight_fsm=True)
+    finals, mets = [], []
+    for s, f in zip(states, forms):
+        fs, m = sim.rollout(s, f, ControlGains(), sp, cfg, T)
+        finals.append(fs)
+        mets.append(m)
+    bf, bm = sim.batched_rollout(bstate, bform, ControlGains(), sp, cfg, T)
+    _assert_bit_identical(mets, bm, finals, bf)
+    for b in range(B):
+        np.testing.assert_array_equal(np.asarray(finals[b].loc.est),
+                                      np.asarray(bf.loc.est)[b])
+        np.testing.assert_array_equal(np.asarray(finals[b].loc.age),
+                                      np.asarray(bf.loc.age)[b])
+
+
+def test_assign_enabled_gate_holds_assignment():
+    """assign_enabled=False freezes v2f and suppresses auction events —
+    the batched driver's pre-dispatch hover gate."""
+    _, forms, bstate, bform, sp = _batch_problem(2, 6, seed=3)
+    cfg = sim.SimConfig(assignment="auction", assign_every=30)
+    bstate = bstate.replace(
+        assign_enabled=jnp.asarray([True, False]))
+    v2f0 = np.asarray(bstate.v2f).copy()
+    bf, bm = sim.batched_rollout(bstate, bform, ControlGains(), sp, cfg, 90)
+    auct = np.asarray(bm.auctioned)
+    assert auct[:, 0].any()            # enabled trial auctions normally
+    assert not auct[:, 1].any()        # gated trial never auctions
+    assert not np.asarray(bm.reassigned)[:, 1].any()
+    np.testing.assert_array_equal(np.asarray(bf.v2f)[1], v2f0[1])
+
+
+def test_summary_matches_host_recompute():
+    """On-device supervisor summaries == host recomputation on the full
+    per-tick trace: windowed predicates, takeoff, EWMA distance, and the
+    chunk-carry continuity across chunk boundaries."""
+    B, n, T, W = 2, 6, 150, 20
+    states, forms, bstate, bform, sp = _batch_problem(B, n, seed=4)
+    cfg = sim.SimConfig(assignment="auction", assign_every=50)
+    _, bm = sim.batched_rollout(bstate, bform, ControlGains(), sp, cfg, T)
+
+    # chunked device reduction (two chunks exercise the carry)
+    chunk = 75
+    carry = sumlib.init_carry(n, W, dtype=bm.q.dtype, batch=B)
+    chunks = []
+    for c0 in range(0, T, chunk):
+        part = jax.tree.map(lambda x: jnp.moveaxis(x[c0:c0 + chunk], 1, 0),
+                            bm)
+        summ, carry = jax.vmap(
+            lambda m, c: sumlib.summarize_chunk(m, c, W, 1.0,
+                                                pose_every=5))(part, carry)
+        chunks.append(summ)
+
+    for b in range(B):
+        dn = np.asarray(bm.distcmd_norm)[:, b]
+        ca = np.asarray(bm.ca_active)[:, b].astype(float)
+        q = np.asarray(bm.q)[:, b]
+        conv = np.concatenate([np.asarray(c.conv_all[b]) for c in chunks])
+        grid = np.concatenate([np.asarray(c.grid_any[b]) for c in chunks])
+        toff = np.concatenate([np.asarray(c.taken_off[b]) for c in chunks])
+        rm_dn = supervisor.rolling_mean(dn, W)
+        rm_ca = supervisor.rolling_mean(ca, W)
+        full = ~np.isnan(rm_dn).any(axis=1)      # full-window ticks only
+        np.testing.assert_array_equal(
+            conv[full], np.all(rm_dn[full] < 1.0, axis=1))
+        np.testing.assert_array_equal(
+            grid[full], np.any(rm_ca[full] > 0.95, axis=1))
+        np.testing.assert_array_equal(
+            toff, np.all(np.abs(q[:, :, 2] - 1.0) < 0.05, axis=1))
+        # trial-cumulative EWMA distance at the final chunk boundary
+        np.testing.assert_allclose(
+            np.asarray(chunks[-1].cumdist[b]),
+            supervisor.distance_traveled(q), rtol=1e-9, atol=1e-12)
+        # decimated pose trace: every pose_every-th tick of each chunk
+        qd = np.concatenate([np.asarray(c.q_dec[b]) for c in chunks])
+        np.testing.assert_array_equal(
+            qd, np.concatenate([q[:chunk][::5], q[chunk:][::5]]))
+
+
+# --------------------------------------------------------------------------
+# SummaryTrialFSM == TrialFSM on synthetic signal traces (incl. gridlock)
+# --------------------------------------------------------------------------
+
+def _drive_serial(fsm: TrialFSM, q, dn, ca, events, chunk):
+    """The serial driver's FSM loop (`trials.run_trial`): per-tick steps,
+    chunk-latency actions, post-dispatch event suppression and the
+    formation_just_received injection."""
+    T = q.shape[0]
+    just_received = False
+    pending = False
+    for c0 in range(0, T, chunk):
+        if fsm.done:
+            break
+        suppress = False
+        if pending:                 # dispatch applied at chunk boundary
+            just_received = True
+            pending = False
+        for t in range(c0, min(c0 + chunk, T)):
+            event = bool(events[t])
+            if just_received and bool(events[t]):
+                event = True
+                just_received = False
+            event = event and not suppress
+            action = fsm.step(q[t], dn[t], ca[t], event)
+            if action == "dispatch":
+                suppress = True
+                pending = True
+            if fsm.done:
+                break
+
+
+def _drive_summary(fsm: SummaryTrialFSM, q, dn, ca, events, chunk, W):
+    """The batched driver's loop: per-chunk summary arrays only."""
+    T = q.shape[0]
+    rm_dn = supervisor.rolling_mean(dn, W)
+    rm_ca = supervisor.rolling_mean(ca.astype(float), W)
+    conv = np.all(np.nan_to_num(rm_dn, nan=np.inf) < 1.0, axis=1)
+    grid = np.any(np.nan_to_num(rm_ca, nan=0.0) > 0.95, axis=1)
+    toff = np.all(np.abs(q[:, :, 2] - 1.0) < 0.05, axis=1)
+    # continuous EWMA cumulative distance (what the device integrates)
+    fx, fy = q[0, :, 0].copy(), q[0, :, 1].copy()
+    cum = np.zeros((T, q.shape[1]))
+    run = np.zeros(q.shape[1])
+    for t in range(1, T):
+        nx = 0.98 * fx + 0.02 * q[t, :, 0]
+        ny = 0.98 * fy + 0.02 * q[t, :, 1]
+        run += np.hypot(nx - fx, ny - fy)
+        fx, fy = nx, ny
+        cum[t] = run
+    pending = False
+    for c0 in range(0, T, chunk):
+        if fsm.done:
+            break
+        if pending:
+            fsm.formation_dispatched()
+            pending = False
+        e1 = min(c0 + chunk, T)
+        acts = fsm.process_chunk(conv[c0:e1], grid[c0:e1], toff[c0:e1],
+                                 events[c0:e1], events[c0:e1])
+        fsm.observe_cumdist(cum[e1 - 1])
+        if "dispatch" in acts:
+            pending = True
+
+
+def _synthetic_trial(T=4200, n=3, dt=0.1, gridlock=False):
+    """Takeoff ramp -> auctions every 12 ticks -> (optional long CA burst
+    = a gridlock episode) -> convergence -> second formation -> done."""
+    q = np.zeros((T, n, 3))
+    z = np.clip(np.arange(T) * 0.02, 0.0, 1.0)
+    q[:, :, 2] = z[:, None]
+    q[:, :, 0] = np.linspace(0, 4, T)[:, None] + np.arange(n)[None, :]
+    dn = np.full((T, n), 3.0)
+    dn[900:] = 0.1          # converges once flying
+    dn[1500:2200] = 3.0     # second formation starts unconverged
+    dn[2200:] = 0.1
+    ca = np.zeros((T, n), bool)
+    if gridlock:
+        dn[900:] = 3.0      # never converges while the CA burst runs
+        ca[700:1800, 0] = True
+        dn[1900:] = 0.1
+    events = np.zeros(T, bool)
+    events[::12] = True
+    return q, dn, ca, events
+
+
+@pytest.mark.parametrize("gridlock", [False, True])
+def test_summary_fsm_matches_trial_fsm(gridlock):
+    """Tick-identical lifecycle decisions from per-chunk summaries vs the
+    per-tick reference FSM — including the gridlock episode accounting."""
+    dt, chunk = 0.1, 60
+    W = max(1, int(round(supervisor.BUFFER_SECONDS / dt)))
+    q, dn, ca, events = _synthetic_trial(dt=dt, gridlock=gridlock)
+    a = TrialFSM(3, 2, takeoff_alt=1.0, dt=dt)
+    b = SummaryTrialFSM(3, 2, takeoff_alt=1.0, dt=dt)
+    _drive_serial(a, q, dn, ca, events, chunk)
+    _drive_summary(b, q, dn, ca, events, chunk, W)
+    assert NAMES[a.state] == NAMES[b.state]
+    assert a.curr_formation_idx == b.curr_formation_idx
+    np.testing.assert_allclose(b.times, a.times, rtol=0, atol=1e-9)
+    np.testing.assert_allclose(b.time_avoidance, a.time_avoidance,
+                               rtol=0, atol=1e-9)
+    assert b.assignments == a.assignments
+    assert b.tick_count == a.tick_count
+    if gridlock:
+        assert a.time_avoidance and a.time_avoidance[0] > 0
+    # dist: chunk-boundary quantization + continuous filter (documented)
+    np.testing.assert_allclose(b.dist, a.dist, rtol=0.25, atol=0.5)
+
+
+def test_summary_fsm_trial_timeout():
+    """The 600 s watchdog fires on the same tick in both FSMs."""
+    dt, chunk = 0.1, 60
+    W = max(1, int(round(supervisor.BUFFER_SECONDS / dt)))
+    q, dn, ca, events = _synthetic_trial(T=7000, dt=dt)
+    dn[:] = 3.0             # never converges -> watchdog
+    a = TrialFSM(3, 2, takeoff_alt=1.0, dt=dt)
+    b = SummaryTrialFSM(3, 2, takeoff_alt=1.0, dt=dt)
+    _drive_serial(a, q, dn, ca, events, chunk)
+    _drive_summary(b, q, dn, ca, events, chunk, W)
+    assert a.state == supervisor.TrialState.TERMINATE
+    assert b.state == supervisor.TrialState.TERMINATE
+    assert b.tick_count == a.tick_count
+    np.testing.assert_allclose(b.times, a.times, rtol=0, atol=1e-9)
+
+
+# --------------------------------------------------------------------------
+# end-to-end: batched trials driver vs the serial reference driver
+# --------------------------------------------------------------------------
+
+def _fsm_outcomes(fsm):
+    return (NAMES[fsm.state], [round(t, 6) for t in fsm.times],
+            list(fsm.assignments), [round(t, 6) for t in fsm.time_avoidance])
+
+
+def test_batched_driver_matches_serial(tmp_path):
+    """Two simform8 trials through `run_trial_batch` reach the same FSM
+    outcomes (states, convergence times, assignment counts, gridlock
+    episodes) as the serial driver, and the CSV machinery works."""
+    base = dict(formation="simform8", trials=2, seed=1, chunk_ticks=120,
+                verbose=False)
+    cfg_s = trials.TrialConfig(out=str(tmp_path / "s.csv"), **base)
+    serial = [trials.run_trial(cfg_s, t) for t in range(2)]
+    cfg_b = trials.TrialConfig(out=str(tmp_path / "b.csv"), batch=2, **base)
+    batched = trials.run_trial_batch(cfg_b, [0, 1])
+    for s, b in zip(serial, batched):
+        assert _fsm_outcomes(s) == _fsm_outcomes(b)
+        # distance is chunk-quantized in batched mode (documented)
+        np.testing.assert_allclose(b.dist, s.dist, rtol=0.25, atol=0.5)
+    # the run_trials wrapper writes reference-schema rows in trial order
+    stats = trials.run_trials(cfg_b)
+    assert stats["trials_completed"] == sum(b.completed for b in batched)
+
+
+def test_batched_driver_requires_aligned_chunk():
+    cfg = trials.TrialConfig(formation="simform8", trials=2, batch=2,
+                             chunk_ticks=50, verbose=False)
+    with pytest.raises(ValueError, match="multiple of assign_every"):
+        trials.run_trial_batch(cfg, [0, 1])
+
+
+def test_batched_driver_rejects_record_dir(tmp_path):
+    cfg = trials.TrialConfig(formation="simform8", trials=2, batch=2,
+                             chunk_ticks=120, verbose=False,
+                             record_dir=str(tmp_path))
+    with pytest.raises(ValueError, match="record_dir"):
+        trials.run_trial_batch(cfg, [0, 1])
+
+
+@pytest.mark.slow
+def test_batched_wave_b8_matches_serial(tmp_path):
+    """A full B=8 wave (the production batch shape class) against eight
+    serial trials — FSM outcome parity at batch scale."""
+    base = dict(formation="simform8", trials=8, seed=3, chunk_ticks=120,
+                verbose=False)
+    cfg_s = trials.TrialConfig(out=str(tmp_path / "s.csv"), **base)
+    serial = [trials.run_trial(cfg_s, t) for t in range(8)]
+    cfg_b = trials.TrialConfig(out=str(tmp_path / "b.csv"), batch=8, **base)
+    batched = trials.run_trial_batch(cfg_b, list(range(8)))
+    for s, b in zip(serial, batched):
+        assert _fsm_outcomes(s) == _fsm_outcomes(b)
+
+
+@pytest.mark.slow
+def test_batched_rollout_bit_parity_b8():
+    """Bit parity at B=8 (the wave size the benchmark artifact uses is
+    16; 8 keeps the slow tier tractable on the 1-core CI box)."""
+    B, n, T = 8, 6, 130
+    states, forms, bstate, bform, sp = _batch_problem(B, n, seed=7)
+    cfg = sim.SimConfig(assignment="sinkhorn", assign_every=60)
+    finals, mets = [], []
+    for s, f in zip(states, forms):
+        fs, m = sim.rollout(s, f, ControlGains(), sp, cfg, T)
+        finals.append(fs)
+        mets.append(m)
+    bf, bm = sim.batched_rollout(bstate, bform, ControlGains(), sp, cfg, T)
+    _assert_bit_identical(mets, bm, finals, bf)

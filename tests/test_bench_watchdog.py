@@ -28,6 +28,38 @@ def test_wedge_emits_single_diagnostic_line():
     assert "error" in d and d["value"] == 0.0
 
 
+def test_probe_timeout_emits_error_line_fast():
+    """A wedged tunnel (simulated: a probe that sleeps forever) must
+    yield the structured error line via the cheap PRE-measurement probe
+    — exit 2 within the probe budget, not after 900 s."""
+    code = (
+        "import bench, sys\n"
+        "bench.PROBE_TIMEOUT_S = 0.5\n"
+        "bench._PROBE_CODE = 'import time; time.sleep(30)'\n"
+        "sys.exit(bench.main())\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 2
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1
+    d = json.loads(lines[0])
+    assert d["metric"] == "sinkhorn_assign_n1000_hz"
+    assert "probe" in d["error"] and d["value"] == 0.0
+
+
+def test_probe_accepts_healthy_backend():
+    """The probe itself passes on a working (CPU) backend."""
+    code = (
+        "import bench\n"
+        "bench._PROBE_CODE = \"print('ok')\"\n"
+        "print('PROBE', bench._probe_device(timeout_s=30))\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=60)
+    assert "PROBE True" in r.stdout
+
+
 def test_boundary_finish_suppresses_watchdog():
     code = (
         "import bench, threading, time, json\n"

@@ -87,6 +87,41 @@ class TestShardedStep:
         assert bool(jnp.all(jnp.isfinite(final.swarm.q)))
         assert metrics.distcmd_norm.shape == (50, n)
 
+    def test_batched_sharded_rollout_matches_unsharded(self):
+        """Both scaling axes composed: trial-vmap (batch replicated)
+        outside agent-axis GSPMD sharding — same values as the unsharded
+        batched rollout."""
+        B, n, T = 2, 16, 40
+        probs = [ring_problem(n, seed=10 + b) for b in range(B)]
+        formation = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                 *[p[0] for p in probs])
+        sparams = probs[0][1]
+        state = jax.tree.map(lambda *xs: jnp.stack(xs),
+                             *[p[2] for p in probs])
+        cfg = sim.SimConfig(assignment="auction", assign_every=20)
+        gains = ControlGains()
+        mesh = parallel.make_mesh()
+        st_sh = parallel.batched_sim_state_sharding(mesh)
+        f_sh = parallel.batched_formation_sharding(mesh)
+        state_sh = jax.device_put(state, st_sh)
+        formation_sh = jax.device_put(formation, f_sh)
+        # both rollouts donate their state carry, and device_put may alias
+        # the replicated leaves — give the reference its own buffers
+        ref_final, ref_metrics = sim.batched_rollout(
+            jax.tree.map(jnp.copy, state), formation, gains, sparams,
+            cfg, T)
+        roll = parallel.batched_rollout_fn(mesh, formation_sh, gains,
+                                           sparams, cfg, T)
+        out_final, out_metrics = roll(state_sh)
+        np.testing.assert_allclose(np.asarray(out_final.swarm.q),
+                                   np.asarray(ref_final.swarm.q),
+                                   atol=1e-12)
+        np.testing.assert_array_equal(np.asarray(out_final.v2f),
+                                      np.asarray(ref_final.v2f))
+        np.testing.assert_allclose(np.asarray(out_metrics.distcmd_norm),
+                                   np.asarray(ref_metrics.distcmd_norm),
+                                   atol=1e-12)
+
     def test_uneven_agents_pick_dividing_mesh(self):
         # n = 12 on 8 devices: jit shardings need even division, so the mesh
         # drops to the largest dividing device count (6) — whole agents per

@@ -6,6 +6,7 @@ import bz2
 import struct
 
 import numpy as np
+import pytest
 
 from aclswarm_tpu.harness import review, rosbag1
 from aclswarm_tpu.harness.supervisor import NAMES
@@ -218,3 +219,143 @@ class TestReviewFixes:
         import pytest
         with pytest.raises(ValueError):
             rosbag1.ser_uint8_multiarray(np.arange(300))
+
+
+def _write_throttled_bag(path, T=200, n=3, dt=0.02, with_tags=False,
+                         missing_safety_veh=None):
+    """A real-flight-shaped bag: `bag_record.sh` records the throttled
+    signal topics (`status_throttle` / `distcmd_throttle`) and the
+    anchor-tag poses `/Tag01/world` / `/Tag02/world`."""
+    vehs = [f"SQ{i + 1:02d}s" for i in range(n)]
+    with rosbag1.BagWriter(path) as bag:
+        for k in range(T):
+            tk = 50.0 + k * dt
+            for i, veh in enumerate(vehs):
+                bag.write(f"/{veh}/world", "geometry_msgs/PoseStamped",
+                          tk, rosbag1.ser_pose_stamped(tk, [i, 0.0, 1.0]))
+                if veh != missing_safety_veh:
+                    bag.write(f"/{veh}/safety/status_throttle",
+                              "aclswarm_msgs/SafetyStatus", tk,
+                              rosbag1.ser_safety_status(tk, i == 0))
+                bag.write(f"/{veh}/distcmd_throttle",
+                          "geometry_msgs/Vector3Stamped", tk,
+                          rosbag1.ser_vector3_stamped(tk, [1.5, 0, 0]))
+            if with_tags:
+                for tag in ("Tag01", "Tag02"):
+                    bag.write(f"/{tag}/world",
+                              "geometry_msgs/PoseStamped", tk,
+                              rosbag1.ser_pose_stamped(tk, [9.0, 9.0, 0.0]))
+            if k % 50 == 0:
+                bag.write(f"/{vehs[0]}/assignment",
+                          "std_msgs/UInt8MultiArray", tk,
+                          rosbag1.ser_uint8_multiarray(
+                              np.arange(n, dtype=np.uint8)))
+    return str(path)
+
+
+class TestRealFlightBagFixes:
+    """ADVICE r5: the reader must score *real* hardware bags, whose topic
+    names and anchor-tag traffic differ from the synthetic fixtures."""
+
+    def test_throttled_topic_names_resolve(self, tmp_path):
+        """/safety/status_throttle and /distcmd_throttle (bag_record.sh
+        names; review_bag.py:90 subscribes the former) must feed the
+        signals instead of silently defaulting to converged-and-blind."""
+        bag = _write_throttled_bag(tmp_path / "hw.bag")
+        rec = rosbag1.bag_to_recording(bag)
+        assert rec["q"].shape[1] == 3
+        assert rec["ca_active"][10:, 0].all()        # throttled safety
+        assert not rec["ca_active"][:, 1].any()
+        assert np.all(rec["distcmd_norm"][10:] > 1.0)  # throttled distcmd
+
+    def test_anchor_tags_do_not_inflate_n(self, tmp_path):
+        """/Tag01/world-style anchor topics carry poses only — they must
+        not be discovered as vehicles (n would inflate and the
+        perm.size == n check would reject every assignment)."""
+        bag = _write_throttled_bag(tmp_path / "tags.bag", with_tags=True)
+        with pytest.warns(UserWarning, match="Tag01"):
+            rec = rosbag1.bag_to_recording(bag)
+        assert rec["q"].shape[1] == 3
+        # assignments still resolve against the un-inflated n
+        assert rec["auctioned"].any()
+        k = int(np.argmax(rec["auctioned"]))
+        np.testing.assert_array_equal(rec["v2f"][k], np.arange(3))
+
+    def test_missing_stream_warns_not_silent(self, tmp_path):
+        """A vehicle with no safety stream gets a UserWarning — defaults
+        make the FSM blind to gridlock, which is a wrong verdict."""
+        bag = _write_throttled_bag(tmp_path / "gap.bag",
+                                   missing_safety_veh="SQ02s")
+        with pytest.warns(UserWarning, match="SQ02s has no safety"):
+            rec = rosbag1.bag_to_recording(bag)
+        assert not rec["ca_active"][:, 1].any()      # default, but loud
+
+    def test_assignment_size_mismatch_warns(self, tmp_path):
+        """A real vehicle whose signal streams were ALL lost looks like
+        an anchor tag to discovery — the recorded assignment permutation
+        length is the cross-check, and the mismatch must be loud."""
+        path = tmp_path / "lost.bag"
+        vehs = ["SQ01s", "SQ02s", "SQ03s"]
+        with rosbag1.BagWriter(path) as bag:
+            for k in range(80):
+                tk = k * 0.02
+                for i, veh in enumerate(vehs):
+                    bag.write(f"/{veh}/world", "geometry_msgs/PoseStamped",
+                              tk, rosbag1.ser_pose_stamped(tk, [i, 0, 1.0]))
+                    if veh != "SQ03s":      # SQ03s lost every signal topic
+                        bag.write(f"/{veh}/distcmd",
+                                  "geometry_msgs/Vector3Stamped", tk,
+                                  rosbag1.ser_vector3_stamped(tk, [1, 0, 0]))
+                if k == 40:                 # fleet-size-3 assignment
+                    bag.write("/SQ01s/assignment",
+                              "std_msgs/UInt8MultiArray", tk,
+                              rosbag1.ser_uint8_multiarray([2, 0, 1]))
+        with pytest.warns(UserWarning, match="assignment permutations"):
+            rec = rosbag1.bag_to_recording(path)
+        assert rec["q"].shape[1] == 2       # SQ03s dropped (documented)
+        # explicit vehs override recovers the full fleet
+        rec = rosbag1.bag_to_recording(path, vehs=vehs)
+        assert rec["q"].shape[1] == 3
+        k = int(np.argmax(rec["auctioned"]))
+        np.testing.assert_array_equal(rec["v2f"][k], [2, 0, 1])
+
+    def test_pose_only_bag_still_reads(self, tmp_path):
+        """No vehicle traffic at all (synthetic pose-only fixtures): fall
+        back to world-prefix discovery instead of an empty vehicle set."""
+        path = tmp_path / "poses.bag"
+        with rosbag1.BagWriter(path) as bag:
+            for k in range(60):
+                tk = k * 0.02
+                bag.write("/SQ01s/world", "geometry_msgs/PoseStamped",
+                          tk, rosbag1.ser_pose_stamped(tk, [0, 0, 1.0]))
+        rec = rosbag1.bag_to_recording(path)
+        assert rec["q"].shape[1] == 1
+
+    def test_decimated_export_keeps_assignment_events(self, tmp_path):
+        """recording_to_bag(pose_every=4): auctioned events on ticks not
+        divisible by 4 must still land in the exported bag."""
+        n, ticks = 4, 40
+        auction_ticks = [3, 17, 30]                  # none divisible by 4
+        rec = {
+            "q": np.zeros((ticks, n, 3)),
+            "distcmd_norm": np.zeros((ticks, n)),
+            "ca_active": np.zeros((ticks, n), bool),
+            "reassigned": np.zeros(ticks, bool),
+            "auctioned": np.zeros(ticks, bool),
+            "assign_valid": np.ones(ticks, bool),
+            "mode": np.zeros((ticks, n), np.int32),
+            "v2f": np.tile(np.arange(n, dtype=np.int32), (ticks, 1)),
+            "dt": np.asarray(0.02),
+        }
+        for k in auction_ticks:
+            rec["auctioned"][k] = True
+            rec["reassigned"][k] = True
+        npz = tmp_path / "dec.npz"
+        np.savez_compressed(npz, **rec)
+        bag = rosbag1.recording_to_bag(npz, tmp_path / "dec.bag",
+                                       vehs=VEHS, pose_every=4)
+        msgs = [m for m in rosbag1.read_bag(bag)
+                if m.topic.endswith("/assignment")]
+        assert len(msgs) == len(auction_ticks)
+        got = sorted(round(m.time / 0.02) for m in msgs)
+        assert got == auction_ticks

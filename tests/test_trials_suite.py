@@ -76,6 +76,33 @@ def test_zero_completion_keeps_committed_csv(tmp_path, monkeypatch):
     assert stats["csv_kept_from_prior_run"] is True
 
 
+def test_batch_and_wall_clock_recorded(tmp_path, monkeypatch):
+    """--batch wires TrialConfig.batch (capped at m) with the chunk
+    auto-aligned to the auction period, and the summary row records the
+    batch size + per-trial wall clock."""
+    mod = _load(tmp_path, monkeypatch)
+    seen = {}
+
+    def fake_run_trials(cfg):
+        seen["batch"] = cfg.batch
+        seen["chunk"] = cfg.chunk_ticks
+        seen["assign_every"] = cfg.assign_every
+        with open(cfg.out, "a") as fh:
+            fh.write("0,1.0\n")
+        return {"completion_pct": 100.0, "trials_completed": 3,
+                "trials": cfg.trials}
+
+    monkeypatch.setattr(mod.triallib, "run_trials", fake_run_trials)
+    stats = mod.run_config("x", dict(formation="swarm6_3d"), 3, batch=8)
+    assert seen["batch"] == 3                       # capped at m
+    assert seen["chunk"] % seen["assign_every"] == 0
+    assert stats["batch"] == 3
+    assert stats["wall_s_per_trial"] >= 0.0
+    # serial runs keep recording batch=1 so evidence stays distinguishable
+    stats = mod.run_config("x", dict(formation="swarm6_3d"), 3)
+    assert stats["batch"] == 1
+
+
 def test_expected_pct_gate():
     """Dispositioned sub-100 rows pass the gate at their documented
     completion; anything below trips it."""
