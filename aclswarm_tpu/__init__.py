@@ -20,6 +20,8 @@ Subpackages
 - ``gains``      ADMM formation-gain design (SDP via ADMM, on device)
 - ``control``    formation control law, collision avoidance, safety shaping
 - ``sim``        vehicle dynamics + closed-loop jitted rollouts
+- ``faults``     fault injection & elastic fleet: scripted dropout/rejoin,
+                 lossy links, masked re-auction (docs/FAULTS.md)
 - ``parallel``   agent-axis sharding over device meshes
 - ``harness``    formation library, random formations, supervisor, trials
 - ``interop``    wire-format message types at the host boundary

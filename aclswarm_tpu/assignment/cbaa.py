@@ -162,7 +162,9 @@ def cbaa_assign(q_veh: jnp.ndarray,
                 v2f_prev: jnp.ndarray,
                 n_iters: Optional[int] = None,
                 task_block: Optional[int] = None,
-                early_exit: bool = True) -> CBAAResult:
+                early_exit: bool = True,
+                alive: Optional[jnp.ndarray] = None,
+                comm_extra: Optional[jnp.ndarray] = None) -> CBAAResult:
     """Run a full synchronous CBAA auction on device.
 
     Args:
@@ -185,6 +187,16 @@ def cbaa_assign(q_veh: jnp.ndarray,
         (`hasReachedConsensus` counts iterations, `auctioneer.cpp:441-444`);
         the bulk-synchronous form holds all n tables and can. Set False to
         reproduce the reference's fixed 2n-round latency (timing parity).
+      alive: optional (n,) bool fault mask (`aclswarm_tpu.faults`). Dead
+        agents never bid and alive agents never bid on dead-owned points
+        (their candidate prices zero out, which `_select_task`'s
+        ``myprice > 0`` guard already excludes); the result pins dead
+        vehicles to their current points and requires consensus only
+        among alive agents over alive-owned points. An all-true mask is
+        bit-identical to None.
+      comm_extra: optional (n, n) bool — per-auction link degradation
+        (dead endpoints, lossy links) ANDed onto the consensus graph.
+        Self-loops never drop (an agent always sees its own table).
 
     Returns a `CBAAResult`; `valid` mirrors the reference's detect-and-skip
     recovery for non-permutation outcomes (`auctioneer.cpp:283-292`).
@@ -196,8 +208,14 @@ def cbaa_assign(q_veh: jnp.ndarray,
 
     # comm graph in vehicle space: v hears w iff adj[v2f[v], v2f[w]] or v==w
     comm_mask = permutil.comm_mask(adjmat, v2f_prev, self_loop=True)
+    if comm_extra is not None:
+        comm_mask = (comm_mask & comm_extra) | jnp.eye(n, dtype=bool)
 
     myprice = bid_prices(q_veh, paligned)
+    if alive is not None:
+        alive_pt = alive[permutil.invert(v2f_prev)]
+        myprice = jnp.where(alive[:, None] & alive_pt[None, :], myprice,
+                            jnp.zeros((), myprice.dtype))
 
     # START bids (auctioneer.cpp:100-105): empty tables + initial greedy bid
     price0 = jnp.zeros((n, n), dtype=myprice.dtype)
@@ -237,9 +255,27 @@ def cbaa_assign(q_veh: jnp.ndarray,
         rounds = jnp.asarray(n_iters, jnp.int32)
 
     # consensus result: every agent's `who` row is its belief of P^T
-    f2v = who[0].astype(jnp.int32)
-    agree = jnp.all(who == who[None, 0, :])
-    valid = agree & permutil.is_valid(f2v)
+    if alive is None:
+        f2v = who[0].astype(jnp.int32)
+        agree = jnp.all(who == who[None, 0, :])
+        valid = agree & permutil.is_valid(f2v)
+    else:
+        # masked extraction: the reference row is the first ALIVE agent's
+        # table; dead-owned points are pinned to their current vehicles
+        # (dead agents' bids never propagate — their tables are noise);
+        # consensus is required only among alive agents over alive-owned
+        # points. All-dead -> no reference row -> invalid -> the engine
+        # holds the current assignment (detect-and-skip, as for any
+        # invalid auction). With an all-true mask this block reduces
+        # bit-exactly to the unmasked extraction above (ref = row 0,
+        # every pin/agree mask degenerate).
+        f2v_cur = permutil.invert(v2f_prev)
+        ref = jnp.argmax(alive)
+        cons = who[ref].astype(jnp.int32)
+        f2v = jnp.where(alive_pt, cons, f2v_cur)
+        agree = jnp.all(jnp.where(alive[:, None] & alive_pt[None, :],
+                                  who == cons[None, :], True))
+        valid = jnp.any(alive) & agree & permutil.is_valid(f2v)
     safe_f2v = jnp.where(valid, f2v, jnp.arange(n, dtype=jnp.int32))
     v2f = permutil.invert(safe_f2v)
     return CBAAResult(v2f=v2f, f2v=f2v, valid=valid, price=price, who=who,
@@ -247,7 +283,8 @@ def cbaa_assign(q_veh: jnp.ndarray,
 
 
 def cbaa_from_state(q_veh, formation_points, adjmat, v2f_prev, n_iters=None,
-                    est=None, task_block=None, early_exit=True):
+                    est=None, task_block=None, early_exit=True,
+                    alive=None, comm_extra=None):
     """Convenience wrapper: local alignment + auction, the full `start()` ->
     consensus pipeline of `auctioneer.cpp:78-120` for the whole swarm.
 
@@ -255,8 +292,15 @@ def cbaa_from_state(q_veh, formation_points, adjmat, v2f_prev, n_iters=None,
     estimates* into its alignment instead of shared ground truth — the
     information model the reference actually runs under (the auctioneer's
     `q_` snapshot comes from `vehicle_estimates`). Own positions stay exact
-    (the diagonal of ``est`` is the autopilot feed)."""
+    (the diagonal of ``est`` is the autopilot feed).
+
+    ``alive``/``comm_extra``: fault masks, see `cbaa_assign`. The local
+    alignment deliberately stays unmasked — a dead vehicle keeps
+    anchoring its neighbors' alignments at its frozen position, exactly
+    like a silent-but-remembered vehicle in the reference (its last
+    flooded estimate persists in every tracker)."""
     paligned = geometry.align_formation_local(
         q_veh, formation_points, adjmat, v2f_prev, est=est)
     return cbaa_assign(q_veh, paligned, adjmat, v2f_prev, n_iters=n_iters,
-                       task_block=task_block, early_exit=early_exit)
+                       task_block=task_block, early_exit=early_exit,
+                       alive=alive, comm_extra=comm_extra)

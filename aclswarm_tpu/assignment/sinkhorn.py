@@ -222,7 +222,9 @@ def sinkhorn_assign(q: jnp.ndarray, p_aligned: jnp.ndarray,
                     rounding: str = "dominant",
                     refine_sweeps: int = 12,
                     impl: str = "auto",
-                    stage_shardings=None) -> SinkhornResult:
+                    stage_shardings=None,
+                    pin: jnp.ndarray | None = None,
+                    forbid: jnp.ndarray | None = None) -> SinkhornResult:
     """Fast assignment: vehicle->point distances, Sinkhorn, rounding, repair.
 
     Cost uses the same distance the reference prices bids with
@@ -248,13 +250,31 @@ def sinkhorn_assign(q: jnp.ndarray, p_aligned: jnp.ndarray,
     and identically) instead of letting GSPMD thread the iteration
     sharding through the loops. See benchmarks/collective_audit.py and
     docs/SCALING.md for the measured inventory.
+
+    ``pin``/``forbid`` ((n, n) bool, together or not at all): the fault
+    model's masked sub-assignment (`aclswarm_tpu.faults.masking`) —
+    pinned pairs become free, forbidden pairs prohibitively expensive,
+    so the rounded permutation is {pinned pairs} ∪ {assignment of the
+    unmasked sub-problem}. Applied AFTER the scale normalization (which
+    keeps using the real cost distribution, so the effective temperature
+    does not drift with the dead fraction) and to the raw cost the 2-opt
+    repair sees (so repair cannot swap a pinned pair away). All-false
+    masks are bit-identical to None.
     """
     from aclswarm_tpu.core import geometry
+    if (pin is None) != (forbid is None):
+        raise ValueError("sinkhorn_assign: pass pin and forbid together "
+                         "or not at all (a lone mask would silently "
+                         "change the masked-assignment contract)")
     # the n=1000 fast path prices with the MXU distance (see cdist_fast:
     # the broadcast cdist was the single largest cost of this pipeline)
     cost_raw = geometry.cdist_fast(q, p_aligned)
     # normalize scale so tau is formation-size independent
     cost = cost_raw / (jnp.mean(cost_raw) + 1e-12)
+    if pin is not None:
+        from aclswarm_tpu.faults.masking import apply_pin_forbid
+        cost = apply_pin_forbid(cost, pin, forbid)
+        cost_raw = apply_pin_forbid(cost_raw, pin, forbid)
     if stage_shardings is not None and impl == "auto":
         # mesh execution: keep the XLA path — GSPMD partitions it freely,
         # while a pallas_call would pin the whole (n, n) computation to

@@ -157,7 +157,8 @@ def _one_agent(qij_xy: jnp.ndarray, active: jnp.ndarray, vel: jnp.ndarray,
 
 def collision_avoidance(q: jnp.ndarray, vel_des: jnp.ndarray,
                         params: SafetyParams,
-                        max_neighbors: int | None = None
+                        max_neighbors: int | None = None,
+                        neighbor_mask: jnp.ndarray | None = None
                         ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Batched velocity-obstacle shim for the whole swarm.
 
@@ -177,6 +178,10 @@ def collision_avoidance(q: jnp.ndarray, vel_des: jnp.ndarray,
         an already-collapsed packing (e.g. k >= the max number of
         ``r_keep_out`` discs that fit in the threshold circle). `None` =
         dense (all n-1), the small-swarm default.
+      neighbor_mask: optional (n,) bool — vehicles with a False bit cast
+        no sector for anyone (the fault model's dead/frozen vehicles,
+        `aclswarm_tpu.faults`; their own row's output is discarded by the
+        engine's freeze). An all-true mask is bit-identical to None.
 
     Returns:
       ((n, 3) safe velocities, (n,) bool modified/avoidance-active flags).
@@ -185,6 +190,8 @@ def collision_avoidance(q: jnp.ndarray, vel_des: jnp.ndarray,
     qij = q[None, :, :] - q[:, None, :]           # (i, j, 3): j relative to i
     dxy = jnp.linalg.norm(qij[..., :2], axis=-1)
     active = (dxy <= params.d_avoid_thresh) & ~jnp.eye(n, dtype=bool)
+    if neighbor_mask is not None:
+        active = active & neighbor_mask[None, :]
     # opt-in cylinder half-height (`SafetyParams.colavoid_dz_ignore`): when
     # set, vertically-clear neighbors cast no sector; <= 0 keeps the
     # reference's infinite planar column (the arithmetic form keeps the

@@ -1,0 +1,21 @@
+"""Fault injection & elastic-swarm subsystem (SURVEY.md §5.3 gap row).
+
+The reference fixes the fleet at startup (`utils.h:43-72`) and assumes
+perfect comms; its only failure handling is trial-level (supervisor
+timeouts, invalid-auction detect-and-skip). This package adds the missing
+capability as a *device-resident* fault model: fault timelines are data
+(a `FaultSchedule` pytree riding in `SimState`), not Python control flow,
+so every trial in a `batched_rollout` batch can carry a different fault
+script inside one compiled scan — scripted vehicle dropout/rejoin, lossy
+links with hold-last-value staleness, and on-device recovery metrics
+(`aclswarm_tpu.sim.summary`). See docs/FAULTS.md.
+"""
+from aclswarm_tpu.faults.masking import (alive_points, apply_pin_forbid,
+                                         mask_cost, pin_forbid)
+from aclswarm_tpu.faults.schedule import (NEVER, FaultSchedule, alive_at,
+                                          fault_event_at, link_up_at,
+                                          no_faults, sample_schedule)
+
+__all__ = ["FaultSchedule", "NEVER", "no_faults", "sample_schedule",
+           "alive_at", "link_up_at", "fault_event_at", "alive_points",
+           "pin_forbid", "mask_cost", "apply_pin_forbid"]

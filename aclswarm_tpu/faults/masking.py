@@ -1,0 +1,78 @@
+"""Masked-assignment helpers: re-auction the alive sub-fleet only.
+
+A dead vehicle keeps OWNING its formation point (its row is *pinned* to
+the current assignment), and alive vehicles compete only over
+alive-owned points (*forbidden* elsewhere). Solving the full-shape LAP
+on the masked cost therefore returns a permutation that is exactly
+{pinned dead pairs} ∪ {optimal assignment of the alive sub-problem} —
+fixed shapes, no gathers into a dynamic sub-matrix, so the whole thing
+vmaps over trials with per-trial alive masks.
+
+Degenerate cases are well-defined by construction:
+
+- **all dead**: every row is pinned -> the solve returns the current
+  assignment unchanged (still a valid permutation);
+- **single survivor**: the only alive-owned point is the survivor's own
+  -> it keeps it; the solve degenerates to the identity on the current
+  assignment;
+- **rejoin**: un-masking is the whole operation — the rejoined rows
+  simply become alive competitors at the next auction.
+
+Bit-parity contract: with an all-alive mask both `pin` and `forbid` are
+all-false, and every `where` below returns its pass-through operand
+bit-for-bit — a no-fault schedule is byte-identical to the unmasked
+solvers (tests/test_faults.py pins this through the full engine).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from aclswarm_tpu.core import perm as permutil
+
+
+def alive_points(alive: jnp.ndarray, v2f: jnp.ndarray) -> jnp.ndarray:
+    """(n,) bool over *formation points*: point i is alive-owned iff the
+    vehicle currently assigned to it is alive (``alive[f2v[i]]``)."""
+    return alive[permutil.invert(v2f)]
+
+
+def pin_forbid(alive: jnp.ndarray, v2f: jnp.ndarray
+               ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(pin, forbid) (n, n) bool masks over (vehicle, formation point).
+
+    ``pin[v, j]``: v is dead and j is its current point — the pair every
+    valid masked assignment must contain. ``forbid[v, j]``: the pair is
+    never allowed (dead vehicle off its point, or alive vehicle onto a
+    dead-owned point). Everything unmasked is the alive sub-problem.
+    """
+    n = v2f.shape[0]
+    pts = jnp.arange(n, dtype=v2f.dtype)
+    own = pts[None, :] == v2f[:, None]
+    dead = ~alive
+    alive_pt = alive_points(alive, v2f)
+    pin = dead[:, None] & own
+    forbid = (dead[:, None] & ~own) | (alive[:, None] & ~alive_pt[None, :])
+    return pin, forbid
+
+
+def apply_pin_forbid(c: jnp.ndarray, pin: jnp.ndarray,
+                     forbid: jnp.ndarray) -> jnp.ndarray:
+    """Apply (pin, forbid) masks to a min-cost matrix: pinned pairs cost
+    0, forbidden pairs cost ``4 * (max(c) + 1)`` — large enough that any
+    solution containing one is strictly worse than the all-pinned
+    alternative, while staying on the problem's own scale (the auction
+    kernel's epsilon-scaling start derives from max|benefit|, so a fixed
+    huge constant would stretch its scaling phases for nothing). Single
+    home of the magnitude rule — the Sinkhorn path masks both its
+    normalized and raw costs through this same helper."""
+    big = 4.0 * (jnp.max(c) + 1.0)
+    return jnp.where(pin, jnp.zeros((), c.dtype),
+                     jnp.where(forbid, big.astype(c.dtype), c))
+
+
+def mask_cost(c: jnp.ndarray, alive: jnp.ndarray,
+              v2f: jnp.ndarray) -> jnp.ndarray:
+    """Masked min-cost matrix for the centralized solvers (see
+    `pin_forbid` / `apply_pin_forbid`)."""
+    pin, forbid = pin_forbid(alive, v2f)
+    return apply_pin_forbid(c, pin, forbid)

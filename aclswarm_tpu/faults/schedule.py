@@ -1,0 +1,142 @@
+"""Fault scripts as data: per-vehicle dropout/rejoin windows + lossy links.
+
+Design rule (the whole point of this module): a fault timeline is a
+*pytree of arrays*, never Python control flow. The engine evaluates
+``alive_at(schedule, tick)`` / ``link_up_at(schedule, tick)`` as pure
+`where`-mask functions of the per-trial tick, so a `batched_rollout`
+batch in which every trial carries a DIFFERENT fault script still
+compiles to one program and runs under `vmap` with the PR-1 shared-tick
+decimation intact (the decimation conds key off the *shared* tick; the
+fault masks key off the per-trial `state.tick`, which is plain data).
+
+Semantics:
+
+- **Dropout**: vehicle v is alive iff ``tick < drop_tick[v]`` or
+  ``tick >= rejoin_tick[v]``. A dead vehicle freezes in place (motors
+  cut mid-air is the harsh reading of the reference's KILL path,
+  `safety.cpp:315-318`; we freeze rather than ballistically drop so the
+  survivors' avoidance problem stays well-posed), publishes no distcmd,
+  casts no avoidance sector, is masked out of the effective adjacency,
+  and neither sends nor receives on any comm link. It keeps OWNING its
+  formation point: the masked assignment solvers pin dead rows to their
+  current points and re-auction only the alive sub-problem
+  (`aclswarm_tpu.faults.masking`), so a rejoin is a plain un-mask — the
+  elastic-fleet behavior the auction re-convergence literature studies
+  (PAPERS.md: arXiv:2401.09032, arXiv:1904.04318).
+- **Link loss**: ``link_loss[v, w]`` is the per-round Bernoulli
+  probability that receiver v misses sender w's broadcast this tick
+  (directed; build it symmetric for undirected channels). A dropped
+  flood link is hold-last-value by construction — the timestamped-flood
+  merge (`sim.localization`) simply keeps the receiver's newest stored
+  estimate and its age keeps growing, exactly the staleness model of the
+  reference's lost `vehicle_estimates` messages. A dropped link during a
+  CBAA auction tick removes that edge from the consensus graph for every
+  bid round of that auction (self-loops never drop — an agent always
+  sees its own table). Draws are seeded per trial and re-sampled per
+  tick via `fold_in(key, tick)`, so sweeps are reproducible and
+  trial-independent.
+
+The no-fault schedule (`no_faults`) is all-alive, zero-loss masks; every
+mask application in the engine is a `where`/`&` against it, so a rollout
+carrying `no_faults(n)` is BIT-IDENTICAL to one carrying ``faults=None``
+(pinned in tests/test_faults.py, serial and batched).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+# np scalar, not jnp: a jax array at import time would initialize the XLA
+# backend and break `jax.distributed.initialize` (same rationale as
+# `sim.localization.AGE_CAP`)
+NEVER = np.int32(2**31 - 1)
+
+
+@struct.dataclass
+class FaultSchedule:
+    """One trial's fault script (all leaves are data; batch by stacking).
+
+    ``rejoin_tick`` must be strictly greater than ``drop_tick`` to script
+    a dropout-then-rejoin window, or `NEVER` to stay down; vehicles with
+    ``drop_tick == NEVER`` never fault.
+    """
+
+    drop_tick: jnp.ndarray    # (n,) int32 tick the vehicle drops; NEVER=never
+    rejoin_tick: jnp.ndarray  # (n,) int32 tick it rejoins; NEVER=stays down
+    link_loss: jnp.ndarray    # (n, n) per-round P(receiver v misses sender w)
+    key: jnp.ndarray          # (2,) uint32 per-trial seed for link draws
+
+    @property
+    def n(self) -> int:
+        return self.drop_tick.shape[0]
+
+
+def no_faults(n: int, dtype=jnp.float32) -> FaultSchedule:
+    """The identity schedule: everyone alive forever, lossless links."""
+    return FaultSchedule(
+        drop_tick=jnp.full((n,), NEVER, jnp.int32),
+        rejoin_tick=jnp.full((n,), NEVER, jnp.int32),
+        link_loss=jnp.zeros((n, n), dtype),
+        key=jnp.zeros((2,), jnp.uint32))
+
+
+def sample_schedule(seed: int, n: int, *, dropout_frac: float = 0.0,
+                    drop_tick: int = 0, rejoin_tick: int | None = None,
+                    link_loss: float = 0.0,
+                    dtype=jnp.float32) -> FaultSchedule:
+    """Seeded spec -> schedule: a random ``dropout_frac`` of the fleet
+    drops at ``drop_tick`` (rejoining at ``rejoin_tick`` if given), and
+    every directed link carries a flat ``link_loss`` Bernoulli rate.
+    Host-side numpy sampling (trial setup, not device code) so the spec
+    is reproducible from ``seed`` alone — the in-rollout per-tick draws
+    are separately seeded from the same integer via the device key.
+    """
+    rng = np.random.default_rng(seed)
+    k = int(round(dropout_frac * n))
+    victims = rng.choice(n, size=k, replace=False) if k else np.empty(0, int)
+    drops = np.full((n,), NEVER, np.int32)
+    drops[victims] = np.int32(drop_tick)
+    rejoins = np.full((n,), NEVER, np.int32)
+    if rejoin_tick is not None:
+        if rejoin_tick <= drop_tick:
+            raise ValueError(f"rejoin_tick ({rejoin_tick}) must be > "
+                             f"drop_tick ({drop_tick})")
+        rejoins[victims] = np.int32(rejoin_tick)
+    loss = np.full((n, n), float(link_loss))
+    np.fill_diagonal(loss, 0.0)
+    # raw threefry key data ([hi, lo] of the seed), wrapped on use — raw
+    # uint32 leaves keep the schedule a plain stackable pytree
+    kd = np.array([(seed >> 32) & 0xFFFFFFFF, seed & 0xFFFFFFFF], np.uint32)
+    return FaultSchedule(
+        drop_tick=jnp.asarray(drops),
+        rejoin_tick=jnp.asarray(rejoins),
+        link_loss=jnp.asarray(loss, dtype),
+        key=jnp.asarray(kd))
+
+
+def alive_at(sched: FaultSchedule, tick) -> jnp.ndarray:
+    """(n,) bool alive mask at ``tick`` — a pure function of data, so it
+    vmaps over batched schedules AND batched per-trial ticks."""
+    t = jnp.asarray(tick, jnp.int32)
+    return (t < sched.drop_tick) | (t >= sched.rejoin_tick)
+
+
+def link_up_at(sched: FaultSchedule, tick) -> jnp.ndarray:
+    """(n, n) bool: directed link (receiver v <- sender w) delivered this
+    tick. Seeded per trial, re-drawn per tick (`fold_in(key, tick)`);
+    zero loss probability always delivers (uniform in [0, 1) >= 0)."""
+    k = jax.random.fold_in(jax.random.wrap_key_data(sched.key),
+                           jnp.asarray(tick, jnp.int32))
+    u = jax.random.uniform(k, sched.link_loss.shape,
+                           dtype=sched.link_loss.dtype)
+    return u >= sched.link_loss
+
+
+def fault_event_at(sched: FaultSchedule, tick) -> jnp.ndarray:
+    """() bool: any vehicle's alive bit flips at ``tick`` (a dropout or a
+    rejoin lands) — the event that (re)starts the recovery clock in
+    `sim.summary`."""
+    t = jnp.asarray(tick, jnp.int32)
+    return jnp.any(alive_at(sched, t) != alive_at(sched, t - 1))

@@ -55,8 +55,8 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def sim_state_sharding(mesh: Mesh, localization: bool = False
-                       ) -> sim.SimState:
+def sim_state_sharding(mesh: Mesh, localization: bool = False,
+                       faults: bool = False) -> sim.SimState:
     """Sharding pytree for `sim.SimState`: per-agent leaves row-sharded.
 
     ``localization=True`` matches states built with
@@ -64,17 +64,26 @@ def sim_state_sharding(mesh: Mesh, localization: bool = False
     shard on the *owning-agent* axis (each shard holds its agents' whole
     belief vectors — the layout of the reference's per-vehicle tracker
     processes), so the flood's min-age merge gathers neighbor rows over
-    ICI exactly like the bid consensus."""
+    ICI exactly like the bid consensus.
+
+    ``faults=True`` matches states carrying a `FaultSchedule`: the
+    per-vehicle timelines and the (n, n) link-loss matrix shard on the
+    vehicle/receiver axis; the trial seed replicates (every shard draws
+    the identical per-tick link lottery)."""
+    from aclswarm_tpu.faults import FaultSchedule
+
     row = row_sharding(mesh)
     rep = replicated(mesh)
     loc = sim.EstimateTable(est=row, age=row) if localization else None
+    fsched = FaultSchedule(drop_tick=row, rejoin_tick=row,
+                           link_loss=row, key=rep) if faults else None
     return sim.SimState(
         swarm=SwarmState(q=row, vel=row),
         goal=control.TrajGoal(pos=row, vel=row, yaw=row, dyaw=row),
         v2f=row, tick=rep,
         flight=sim.FlightState(mode=row, ticks_in_mode=row,
                                initial_alt=row, takeoff_alt=row),
-        loc=loc, first_auction=rep, assign_enabled=rep)
+        loc=loc, first_auction=rep, assign_enabled=rep, faults=fsched)
 
 
 def formation_sharding(mesh: Mesh) -> Formation:
@@ -89,7 +98,8 @@ def formation_sharding(mesh: Mesh) -> Formation:
 
 def shard_problem(state: sim.SimState, formation, mesh: Mesh):
     """Place a sim state + formation onto the mesh with the standard layout."""
-    st_sh = sim_state_sharding(mesh, localization=state.loc is not None)
+    st_sh = sim_state_sharding(mesh, localization=state.loc is not None,
+                               faults=state.faults is not None)
     f_sh = formation_sharding(mesh)
     return (jax.device_put(state, st_sh), jax.device_put(formation, f_sh),
             st_sh, f_sh)

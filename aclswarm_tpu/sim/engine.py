@@ -43,6 +43,9 @@ from aclswarm_tpu.core import geometry
 from aclswarm_tpu.core import perm as permutil
 from aclswarm_tpu.core.types import (ControlGains, Formation, SafetyParams,
                                      SwarmState)
+from aclswarm_tpu.faults import masking as faultmask
+from aclswarm_tpu.faults import schedule as faultlib
+from aclswarm_tpu.faults.schedule import FaultSchedule
 from aclswarm_tpu.sim import localization as loclib
 from aclswarm_tpu.sim import vehicle
 from aclswarm_tpu.sim.localization import EstimateTable
@@ -141,6 +144,14 @@ class SimState:
     # data, not compile-time structure (see `batched_rollout`).
     assign_enabled: jnp.ndarray = struct.field(
         default_factory=lambda: jnp.asarray(True))
+    # fault script (`aclswarm_tpu.faults`): None = the fault-free engine
+    # (structurally identical program to every pre-faults rollout). A
+    # `FaultSchedule` turns on the masked paths — dead vehicles freeze
+    # and vanish from adjacency/avoidance/auctions, lossy links drop
+    # flood/consensus deliveries — keyed on the per-trial `tick` as pure
+    # data, so batched trials may carry different scripts (and a no-fault
+    # schedule is bit-identical to None; tests/test_faults.py).
+    faults: FaultSchedule | None = None
 
 
 @struct.dataclass
@@ -155,15 +166,21 @@ class StepMetrics:
     q: jnp.ndarray              # (n, 3) positions after the tick
     mode: jnp.ndarray           # (n,) int32 flight mode after the tick
     v2f: jnp.ndarray            # (n,) assignment after the tick
+    # fault observables (None unless the state carries a FaultSchedule)
+    alive: jnp.ndarray | None = None        # (n,) bool alive mask this tick
+    fault_event: jnp.ndarray | None = None  # () bool: any alive bit flipped
 
 
 def init_state(q0, v2f0=None, flying: bool = True,
-               localization: bool = False) -> SimState:
+               localization: bool = False,
+               faults: FaultSchedule | None = None) -> SimState:
     """``flying=True`` starts airborne in FLYING (historical rollouts);
     ``flying=False`` starts NOT_FLYING on the ground — send CMD_GO via
     `ExternalInputs` to take off (requires ``cfg.flight_fsm``).
     ``localization=True`` allocates the estimate tables (required iff the
-    rollout runs with ``cfg.localization='flooded'``)."""
+    rollout runs with ``cfg.localization='flooded'``).
+    ``faults`` attaches a fault script (`aclswarm_tpu.faults`); None keeps
+    the fault-free engine."""
     q0 = jnp.asarray(q0)
     n = q0.shape[0]
     if v2f0 is None:
@@ -175,12 +192,15 @@ def init_state(q0, v2f0=None, flying: bool = True,
         tick=jnp.asarray(0, jnp.int32),
         flight=vehicle.init_flight(n, q0.dtype, flying=flying),
         loc=loclib.init_table(q0) if localization else None,
-        first_auction=jnp.asarray(True))
+        first_auction=jnp.asarray(True),
+        faults=faults)
 
 
 def assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
            cfg: SimConfig, est: jnp.ndarray | None = None,
-           first: jnp.ndarray | None = None):
+           first: jnp.ndarray | None = None,
+           alive: jnp.ndarray | None = None,
+           link_mask: jnp.ndarray | None = None):
     """One re-assignment: returns (new v2f, valid flag).
 
     'auction' follows the centralized path (`assignment.py:94-137`): order the
@@ -199,6 +219,16 @@ def assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
     dispatch: the reference accepts it unconditionally
     (`formation_just_received_`, `auctioneer.cpp:310-316`), so the
     `assign_eps` hysteresis is bypassed on that auction.
+
+    ``alive`` (optional (n,) bool) masks the solve to the alive
+    sub-fleet: dead vehicles stay pinned to their current points, alive
+    ones bid only over alive-owned points (`aclswarm_tpu.faults.masking`
+    — the global alignment deliberately keeps all rows: dead vehicles
+    still anchor their pinned points at their frozen positions).
+    ``link_mask`` degrades the decentralized CBAA's consensus graph; the
+    centralized auction/sinkhorn paths ignore it (the reference operator
+    is a base station, `operator.py:221-246` — vehicle-to-vehicle link
+    loss does not apply to it).
     """
     if first is None:
         first = jnp.asarray(False)
@@ -221,20 +251,32 @@ def assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
         q_form = permutil.veh_to_formation_order(swarm.q, v2f)
         paligned = geometry.align(formation.points, q_form, d=2)
         c = geometry.cdist(swarm.q, paligned)
+        if alive is not None:
+            c = faultmask.mask_cost(c, alive, v2f)
         res = auction.auction_lap(-c)
         new_v2f = jnp.where(res.valid, _hysteresis(res.row_to_col, c), v2f)
         return new_v2f, res.valid
     elif cfg.assignment == "sinkhorn":
         q_form = permutil.veh_to_formation_order(swarm.q, v2f)
         paligned = geometry.align(formation.points, q_form, d=2)
-        res = sinkhorn.sinkhorn_assign(swarm.q, paligned)
-        c = (geometry.cdist(swarm.q, paligned) if cfg.assign_eps > 0.0
-             else None)  # cfg is static; skip the matrix when unused
+        if alive is None:
+            res = sinkhorn.sinkhorn_assign(swarm.q, paligned)
+        else:
+            pin, forbid = faultmask.pin_forbid(alive, v2f)
+            res = sinkhorn.sinkhorn_assign(swarm.q, paligned, pin=pin,
+                                           forbid=forbid)
+        if cfg.assign_eps > 0.0:
+            c = geometry.cdist(swarm.q, paligned)
+            if alive is not None:
+                c = faultmask.mask_cost(c, alive, v2f)
+        else:
+            c = None  # cfg is static; skip the matrix when unused
         return _hysteresis(res.row_to_col, c), jnp.asarray(True)
     elif cfg.assignment == "cbaa":
         res = cbaa.cbaa_from_state(swarm.q, formation.points,
                                    formation.adjmat, v2f, est=est,
-                                   task_block=cfg.cbaa_task_block)
+                                   task_block=cfg.cbaa_task_block,
+                                   alive=alive, comm_extra=link_mask)
         new_v2f = jnp.where(res.valid, res.v2f, v2f)
         return new_v2f, res.valid
     elif cfg.assignment == "none":
@@ -266,6 +308,21 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
         inputs = ExternalInputs.none(n, swarm.q.dtype)
     tick_src = state.tick if shared_tick is None else shared_tick
 
+    # --- fault model (`aclswarm_tpu.faults`): masks, not control flow ---
+    # keyed on the PER-TRIAL `state.tick` (plain data, so batched trials
+    # carry different scripts under one vmap), never on the shared
+    # decimation tick — the decimation conds below stay on `tick_src`.
+    faults = state.faults
+    if faults is not None:
+        alive = faultlib.alive_at(faults, state.tick)
+        link_up = faultlib.link_up_at(faults, state.tick)
+        # a link is delivered iff both endpoints live AND the Bernoulli
+        # draw spares it; receiver-major like every comm mask
+        link_mask = link_up & alive[:, None] & alive[None, :]
+        fault_event = faultlib.fault_event_at(faults, state.tick)
+    else:
+        alive = link_mask = fault_event = None
+
     # --- operator flight-mode broadcast (`safety.cpp:101-121`) ---
     if cfg.flight_fsm:
         fs = vehicle.apply_command(fs, inputs.cmd)
@@ -275,17 +332,26 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
     loc = state.loc
     if cfg.localization == "flooded":
         if loc is None:
+            if faults is not None:
+                raise ValueError(
+                    "cfg.localization='flooded' combined with a "
+                    "FaultSchedule needs init_state(..., "
+                    "localization=True, faults=...): the fault model "
+                    "drops flood links, which requires the estimate "
+                    "tables to exist")
             raise ValueError("cfg.localization='flooded' needs "
                              "init_state(..., localization=True)")
         if cfg.flood_phases == 1:
             loc = loclib.tick(loc, swarm.q, formation.adjmat, v2f,
                               (tick_src % cfg.flood_every) == 0,
-                              target_block=cfg.flood_block)
+                              target_block=cfg.flood_block,
+                              link_mask=link_mask)
         else:
             loc = loclib.tick_phased(loc, swarm.q, formation.adjmat, v2f,
                                      tick_src, cfg.flood_every,
                                      cfg.flood_phases,
-                                     target_block=cfg.flood_block)
+                                     target_block=cfg.flood_block,
+                                     link_mask=link_mask)
         est = loc.est
     elif cfg.localization == "truth":
         est = None
@@ -312,7 +378,8 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
         cand_v2f, cand_valid = lax.cond(
             do_assign,
             lambda s, f, p, e: assign(s, f, p, cfg, e,
-                                      first=state.first_auction),
+                                      first=state.first_auction,
+                                      alive=alive, link_mask=link_mask),
             lambda s, f, p, e: (p, jnp.asarray(True)),
             swarm, formation, v2f, est)
         take = do_assign & gate
@@ -325,10 +392,25 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
 
     # --- distributed control law -> distcmd (§3.3) ---
     rel = None if est is None else loclib.relative_views(loc)
-    u = control.compute(swarm, formation, v2f, gains, rel=rel)
+    ctrl_formation = formation
+    if faults is not None:
+        # dead vehicles vanish from the effective formation graph: their
+        # points cast no edges, so survivors' control (and per-neighbor
+        # damping degree) sees only alive neighbors. Masked in formation
+        # space through the current assignment.
+        alive_form = faultmask.alive_points(alive, v2f)
+        pair_alive = alive_form[:, None] & alive_form[None, :]
+        ctrl_formation = formation.replace(
+            adjmat=jnp.where(pair_alive, formation.adjmat,
+                             jnp.zeros((), formation.adjmat.dtype)))
+    u = control.compute(swarm, ctrl_formation, v2f, gains, rel=rel)
     if cfg.flight_fsm:
         # coordination publishes distcmd only while flying
         u = jnp.where(flying[:, None], u, 0.0)
+    if faults is not None:
+        # dead vehicles publish no distcmd (and their |u| must not feed
+        # the convergence predicate)
+        u = jnp.where(alive[:, None], u, 0.0)
     distcmd_norm = jnp.linalg.norm(u, axis=-1)
 
     # --- safety shim: saturate -> mux -> avoid -> safe trajectory ---
@@ -336,7 +418,8 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
     u, yawrate = vehicle.mux_goals(u, inputs)
     if cfg.use_colavoid:
         u, ca = control.collision_avoidance(
-            swarm.q, u, sparams, max_neighbors=cfg.colavoid_neighbors)
+            swarm.q, u, sparams, max_neighbors=cfg.colavoid_neighbors,
+            neighbor_mask=alive)
     else:
         ca = jnp.zeros((n,), bool)
     safe_goal = control.make_safe_traj(cfg.control_dt, u, yawrate, goal,
@@ -368,14 +451,31 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
     else:
         raise ValueError(f"unknown dynamics model {cfg.dynamics!r}")
 
+    # --- fault freeze: dead vehicles hold pose, goal, and flight mode ---
+    # (selected AFTER the full pipeline so every mask is a `where` on
+    # otherwise-identical computation — the vmap/no-fault-parity rule)
+    if faults is not None:
+        row = alive[:, None]
+        swarm = SwarmState(q=jnp.where(row, swarm.q, state.swarm.q),
+                           vel=jnp.where(row, swarm.vel, state.swarm.vel))
+        goal = jax.tree.map(
+            lambda new, old: jnp.where(
+                row if new.ndim == 2 else alive, new, old),
+            goal, state.goal)
+        fs = jax.tree.map(
+            lambda new, old: jnp.where(alive, new, old), fs, state.flight)
+        ca = ca & alive
+
     new_state = SimState(swarm=swarm, goal=goal, v2f=v2f,
                          tick=state.tick + 1, flight=fs, loc=loc,
                          first_auction=first_auction,
-                         assign_enabled=state.assign_enabled)
+                         assign_enabled=state.assign_enabled,
+                         faults=faults)
     return new_state, StepMetrics(distcmd_norm=distcmd_norm, ca_active=ca,
                                   assign_valid=valid, reassigned=reassigned,
                                   auctioned=auctioned, q=swarm.q,
-                                  mode=fs.mode, v2f=v2f)
+                                  mode=fs.mode, v2f=v2f,
+                                  alive=alive, fault_event=fault_event)
 
 
 @partial(jax.jit, static_argnames=("n_ticks", "cfg"))

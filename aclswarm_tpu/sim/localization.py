@@ -228,13 +228,23 @@ def flood(table: EstimateTable, comm: jnp.ndarray,
 
 def tick(table: EstimateTable, q_true: jnp.ndarray, adjmat: jnp.ndarray,
          v2f: jnp.ndarray, do_flood: jnp.ndarray,
-         target_block: int | None = None) -> EstimateTable:
+         target_block: int | None = None,
+         link_mask: jnp.ndarray | None = None) -> EstimateTable:
     """One control tick of the localization layer: ages advance, own state
     refreshes (the autopilot feed outruns the flood), and on decimated
-    ticks (50 Hz, `localization_ros.cpp:34`) the flood round runs."""
+    ticks (50 Hz, `localization_ros.cpp:34`) the flood round runs.
+
+    ``link_mask`` (optional, (n, n) bool, receiver-major like the comm
+    mask) further restricts this round's deliveries — the fault model's
+    dead vehicles and lossy links (`aclswarm_tpu.faults`). A masked link
+    is hold-last-value by construction: the strictly-newer-wins merge
+    just keeps the receiver's stored estimate and its age keeps growing.
+    An all-true mask is bit-identical to no mask."""
     table = EstimateTable(est=table.est, age=table.age + 1)
     table = observe_self(table, q_true)
     comm = comm_mask(adjmat, v2f)
+    if link_mask is not None:
+        comm = comm & link_mask
     return lax.cond(do_flood, lambda t: flood(t, comm, target_block),
                     lambda t: t, table)
 
@@ -242,7 +252,8 @@ def tick(table: EstimateTable, q_true: jnp.ndarray, adjmat: jnp.ndarray,
 def tick_phased(table: EstimateTable, q_true: jnp.ndarray,
                 adjmat: jnp.ndarray, v2f: jnp.ndarray, tick_idx,
                 flood_every: int, phases: int,
-                target_block: int | None = None) -> EstimateTable:
+                target_block: int | None = None,
+                link_mask: jnp.ndarray | None = None) -> EstimateTable:
     """Phased flood: the target axis is split into ``phases`` stripes and
     stripe ``p`` merges on ticks where ``tick % flood_every ==
     p * (flood_every // phases)`` — each target still refreshes every
@@ -253,6 +264,8 @@ def tick_phased(table: EstimateTable, q_true: jnp.ndarray,
     the tick ON which each target's merge runs shifts — no further from
     the reference than the bulk-synchronous form, since the reference's n
     per-vehicle 50 Hz timers free-run on unsynchronized phases anyway.
+
+    ``link_mask``: per-round delivery mask as in `tick` (fault model).
     """
     if flood_every % phases:
         raise ValueError(f"flood_phases={phases} must divide "
@@ -262,6 +275,8 @@ def tick_phased(table: EstimateTable, q_true: jnp.ndarray,
     table = EstimateTable(est=table.est, age=table.age + 1)
     table = observe_self(table, q_true)
     comm = comm_mask(adjmat, v2f)
+    if link_mask is not None:
+        comm = comm & link_mask
     gap = flood_every // phases
     slot = jnp.asarray(tick_idx, jnp.int32) % flood_every
     on_slot = (slot % gap) == 0

@@ -65,6 +65,10 @@ class SummaryCarry:
     fy: jnp.ndarray        # (n,) EWMA-filtered y
     cumdist: jnp.ndarray   # (n,) accumulated filtered planar distance
     inited: jnp.ndarray    # () bool: EWMA filter seeded?
+    # fault-recovery clock (`aclswarm_tpu.faults`; zeros when unused):
+    rec_pending: jnp.ndarray  # () bool: a fault event awaits reconvergence
+    rec_since: jnp.ndarray    # () int32 ticks since the last fault event
+    rec_churn: jnp.ndarray    # () int32 reassignments since that event
 
 
 @struct.dataclass
@@ -80,6 +84,13 @@ class ChunkSummary:
     reassigned: jnp.ndarray    # (T,)
     cumdist: jnp.ndarray       # (n,) EWMA planar distance, trial-cumulative
     q_dec: jnp.ndarray | None  # (ceil(T/pose_every), n, 3) or None
+    # fault observables (None unless the rollout carried a FaultSchedule):
+    fault_event: jnp.ndarray | None = None    # (T,) pass-through
+    n_alive: jnp.ndarray | None = None        # (T,) int32 alive count
+    # recovery clock outputs, -1 except at the tick recovery completes:
+    recovery_ticks: jnp.ndarray | None = None  # (T,) int32 event->conv ticks
+    fault_churn: jnp.ndarray | None = None     # (T,) int32 reassigns in that
+    #                                            window (accepted changes)
 
 
 def init_carry(n: int, window: int, dtype=jnp.float32,
@@ -92,7 +103,10 @@ def init_carry(n: int, window: int, dtype=jnp.float32,
         fx=jnp.zeros(lead + (n,), dtype),
         fy=jnp.zeros(lead + (n,), dtype),
         cumdist=jnp.zeros(lead + (n,), dtype),
-        inited=jnp.zeros(lead, bool))
+        inited=jnp.zeros(lead, bool),
+        rec_pending=jnp.zeros(lead, bool),
+        rec_since=jnp.zeros(lead, jnp.int32),
+        rec_churn=jnp.zeros(lead, jnp.int32))
 
 
 def _trailing_window_mean(x: jnp.ndarray, hist: jnp.ndarray, window: int
@@ -136,21 +150,78 @@ def _ewma_distance(q: jnp.ndarray, carry: SummaryCarry
     return fx, fy, dist, inited
 
 
+def _recovery_clock(fault_event: jnp.ndarray, conv_all: jnp.ndarray,
+                    reassigned: jnp.ndarray, carry: SummaryCarry,
+                    min_ticks: int
+                    ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                               jnp.ndarray, jnp.ndarray]:
+    """Time-to-reconvergence + assignment churn after each fault event,
+    advanced over the chunk (the fault analogue of the supervisor bools:
+    O(1) per tick on device, cross-chunk state in the carry).
+
+    A fault event (any dropout/rejoin landing, `StepMetrics.fault_event`)
+    (re)starts the clock and zeroes the churn counter — overlapping
+    events coalesce into one recovery window measured from the LAST
+    event. Recovery completes at the first tick at least ``min_ticks``
+    (= the supervisor window W) after the event whose windowed
+    convergence predicate (`conv_all`, the supervisor's own) holds; that
+    tick emits ``recovery_ticks`` = ticks since the event and
+    ``fault_churn`` = accepted reassignments in between. All other ticks
+    emit -1. The ``min_ticks`` gate is the device analogue of the host
+    FSM's full-buffer rule: for the first W-1 post-event ticks the
+    trailing mean still averages pre-event samples (a frozen fleet's
+    zeros can mask a rejoiner's transient), so the clock refuses to
+    declare recovery on a window that straddles the event.
+    """
+    def body(c, x):
+        pending, since, churn = c
+        ev, conv, re = x
+        since = jnp.where(ev, 0, since + 1).astype(jnp.int32)
+        churn = jnp.where(ev, 0,
+                          churn + re.astype(jnp.int32)).astype(jnp.int32)
+        pending = pending | ev
+        done = pending & conv & ~ev & (since >= min_ticks)
+        rec_out = jnp.where(done, since, -1)
+        churn_out = jnp.where(done, churn, -1)
+        return (pending & ~done, since, churn), (rec_out, churn_out)
+
+    (pending, since, churn), (rec, chn) = lax.scan(
+        body, (carry.rec_pending, carry.rec_since, carry.rec_churn),
+        (fault_event, conv_all, reassigned))
+    return rec, chn, pending, since, churn
+
+
 def summarize_chunk(metrics: StepMetrics, carry: SummaryCarry,
                     window: int, takeoff_alt, pose_every: int = 0
                     ) -> tuple[ChunkSummary, SummaryCarry]:
     """Reduce one trial's time-major (T, ...) `StepMetrics` to per-tick
     supervisor scalars + cumulative distance. Pure JAX — call inside the
     rollout's jit (the (T, n) intermediates then never reach the host) or
-    standalone on recorded metrics (the parity tests do)."""
+    standalone on recorded metrics (the parity tests do). Metrics from a
+    fault-scripted rollout (`StepMetrics.alive` present) additionally
+    yield the recovery observables (`_recovery_clock`)."""
     dn = metrics.distcmd_norm
     ca = metrics.ca_active.astype(dn.dtype)
     dn_mean, dn_hist = _trailing_window_mean(dn, carry.dn_hist, window)
     ca_mean, ca_hist = _trailing_window_mean(ca, carry.ca_hist, window)
     fx, fy, cumdist, inited = _ewma_distance(metrics.q, carry)
+    conv_all = jnp.all(dn_mean < ORIG_ZERO_VEL_THR, axis=1)
+
+    if metrics.alive is not None:
+        rec, chn, pending, since, churn = _recovery_clock(
+            metrics.fault_event, conv_all, metrics.reassigned, carry,
+            window)
+        fault_kw = dict(fault_event=metrics.fault_event,
+                        n_alive=jnp.sum(metrics.alive, axis=1,
+                                        dtype=jnp.int32),
+                        recovery_ticks=rec, fault_churn=chn)
+    else:
+        pending, since, churn = (carry.rec_pending, carry.rec_since,
+                                 carry.rec_churn)
+        fault_kw = {}
 
     summary = ChunkSummary(
-        conv_all=jnp.all(dn_mean < ORIG_ZERO_VEL_THR, axis=1),
+        conv_all=conv_all,
         grid_any=jnp.any(ca_mean > AVG_ACTIVE_CA_THR, axis=1),
         taken_off=jnp.all(
             jnp.abs(metrics.q[:, :, 2] - takeoff_alt) < ZERO_POS_THR,
@@ -160,9 +231,12 @@ def summarize_chunk(metrics: StepMetrics, carry: SummaryCarry,
         assign_valid=metrics.assign_valid,
         reassigned=metrics.reassigned,
         cumdist=cumdist,
-        q_dec=metrics.q[::pose_every] if pose_every else None)
+        q_dec=metrics.q[::pose_every] if pose_every else None,
+        **fault_kw)
     new_carry = SummaryCarry(dn_hist=dn_hist, ca_hist=ca_hist,
-                             fx=fx, fy=fy, cumdist=cumdist, inited=inited)
+                             fx=fx, fy=fy, cumdist=cumdist, inited=inited,
+                             rec_pending=pending, rec_since=since,
+                             rec_churn=churn)
     return summary, new_carry
 
 

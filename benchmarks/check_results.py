@@ -1,0 +1,123 @@
+"""Tiny schema guard for committed benchmark artifacts.
+
+Committed `benchmarks/results/*.json` artifacts are load-bearing
+evidence; silent schema drift (a renamed key, a row without its metric
+value) turns them into dead weight that downstream tooling mis-parses
+quietly. This checker fails LOUDLY instead.
+
+Two artifact shapes exist:
+
+- **row files** (JSON-lines, one object per line — the benchmark
+  drivers' format): every row must carry a *name* (the ``name`` key; the
+  pre-faults artifacts' ``metric`` key is accepted as the legacy alias)
+  and either a numeric ``value`` or an ``error`` string (recorded
+  environment failures are evidence too, see flood_sweep.json). When an
+  ``n`` key is present it must be a positive integer. Artifacts written
+  by `faults_suite.py` (fault_recovery.json) are held to the strict
+  new-style schema: ``{name, n, value}`` on every row.
+- **summary files** (a single JSON object, e.g. trials_summary.json):
+  must parse and be a dict; their internal schema belongs to their
+  producer.
+
+Run standalone (CI / pre-commit):
+
+    python benchmarks/check_results.py          # exit 1 + report on drift
+
+or via the tier-1 test `tests/test_results_schema.py`.
+"""
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+RESULTS = Path(__file__).resolve().parent / "results"
+
+# artifacts held to the strict {name, n, value} row schema (new-style;
+# everything the faults subsystem and later suites commit goes here)
+STRICT_ROWS = ("fault_recovery.json",)
+
+
+def _check_row(row: dict, path: Path, lineno: int, strict: bool
+               ) -> list[str]:
+    probs = []
+    where = f"{path.name}:{lineno}"
+    if not isinstance(row, dict):
+        return [f"{where}: row is not a JSON object"]
+    name = row.get("name", row.get("metric"))
+    if not isinstance(name, str) or not name:
+        probs.append(f"{where}: strict artifact row lacks 'name'" if strict
+                     else f"{where}: no usable 'name'/'metric' string")
+    elif strict and "name" not in row:
+        probs.append(f"{where}: strict artifact row must use 'name' "
+                     "(not the legacy 'metric' alias)")
+    has_value = isinstance(row.get("value"), (int, float)) \
+        and not isinstance(row.get("value"), bool)
+    has_error = isinstance(row.get("error"), str)
+    if strict and not has_value:
+        probs.append(f"{where}: strict artifact row lacks numeric 'value'")
+    elif not (has_value or has_error):
+        probs.append(f"{where}: neither numeric 'value' nor 'error' string")
+    if "n" in row:
+        if not isinstance(row["n"], int) or isinstance(row["n"], bool) \
+                or row["n"] <= 0:
+            probs.append(f"{where}: 'n' must be a positive int, got "
+                         f"{row['n']!r}")
+    elif strict:
+        probs.append(f"{where}: strict artifact row lacks 'n'")
+    return probs
+
+
+def check_file(path: Path) -> list[str]:
+    """Validate one committed artifact; returns a list of problems."""
+    text = path.read_text()
+    lines = [ln for ln in text.strip().splitlines() if ln.strip()]
+    if not lines:
+        return [f"{path.name}: empty artifact"]
+    # summary-shaped: the whole (multi-line, pretty-printed) file is one
+    # JSON object — trials_summary.json and friends; a single line that
+    # parses as an object without a name/metric key counts too
+    try:
+        whole = json.loads(text)
+    except json.JSONDecodeError:
+        whole = None
+    if isinstance(whole, dict) and (
+            len(lines) > 1
+            or ("name" not in whole and "metric" not in whole)):
+        return []
+    probs = []
+    strict = path.name in STRICT_ROWS
+    for i, line in enumerate(lines, 1):
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError as e:
+            probs.append(f"{path.name}:{i}: unparseable row ({e})")
+            continue
+        probs.extend(_check_row(row, path, i, strict))
+    return probs
+
+
+def check_all(results_dir: Path = RESULTS) -> list[str]:
+    probs = []
+    files = sorted(results_dir.glob("*.json"))
+    if not files:
+        return [f"no committed artifacts under {results_dir}"]
+    for f in files:
+        probs.extend(check_file(f))
+    return probs
+
+
+def main() -> int:
+    probs = check_all()
+    if probs:
+        print(f"ARTIFACT SCHEMA DRIFT ({len(probs)} problem(s)):")
+        for p in probs:
+            print(f"  {p}")
+        return 1
+    print(f"all {len(sorted(RESULTS.glob('*.json')))} committed "
+          "results/*.json artifacts pass the schema check")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,218 @@
+"""Fault-recovery sweep: measured elastic-swarm evidence.
+
+The tentpole demonstration of `aclswarm_tpu.faults`: ONE compiled
+batched rollout in which every trial carries a DIFFERENT fault script —
+a (dropout fraction x link-loss rate) grid plus a no-fault control row —
+runs under `vmap` with the shared-tick decimation intact, on the
+fully-faithful decentralized stack (CBAA consensus auctions over flooded
+localization estimates, the mode where BOTH fault axes bite: dropouts
+shrink the auction and the comm graph, link loss starves the flood and
+the consensus rounds).
+
+Per trial the swarm converges to a random rigid formation, a scripted
+fraction of the fleet drops mid-flight (tick D), and the survivors'
+masked re-auction + control recover formation; the dropped vehicles
+rejoin at tick R and the fleet re-absorbs them. The on-device recovery
+clock (`sim.summary`) emits time-to-reconvergence and assignment churn
+for both events; this driver commits them as
+
+    benchmarks/results/fault_recovery.json      {name, n, value} rows
+                                                (strict schema —
+                                                benchmarks/check_results)
+
+Run:
+    python benchmarks/faults_suite.py [--quick] [--n 10] [--out PATH]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+RESULTS = Path(__file__).resolve().parent / "results"
+
+# the sweep grid: every (dropout_frac, link_loss) cell is one trial row
+# of the SAME batched rollout; (0, 0) is the no-fault control row
+GRID = [(0.0, 0.0), (0.1, 0.0), (0.3, 0.0), (0.5, 0.0),
+        (0.1, 0.3), (0.3, 0.3), (0.1, 0.6), (0.3, 0.6)]
+
+# per-scale problem shaping (generation box per trials_suite conventions;
+# spacing >= 2 * d_avoid_thresh so parked vehicles sit outside each
+# other's avoidance shells — docs/SCALE_TUNING.md §5) and fault timeline
+# (the recovery windows must clear the scale's own convergence
+# transient: n=100 under reference-default control at the 40 m box
+# converges in ~2600 ticks — measured baseline_conv_tick_n100 — so its
+# drop/rejoin events and windows stretch accordingly)
+SCALES = {
+    10: dict(box=(15.0, 15.0, 2.0), min_dist=2.0,
+             drop_tick=300, rejoin_tick=1500, n_ticks=2640),
+    100: dict(box=(40.0, 40.0, 3.0), min_dist=3.0,
+              drop_tick=600, rejoin_tick=4200, n_ticks=7800),
+}
+
+
+def _problem(n: int, seed: int):
+    """One seeded formation + an airborne start displaced a few metres
+    from it. The displacement matters: the dropout is scripted
+    MID-TRANSIT, so the drop-recovery window measures the survivors
+    finishing convergence with the dead frozen mid-air (masked out of
+    graph and avoidance), and the rejoin-recovery window measures the
+    fleet re-absorbing vehicles that froze ~3 m off their points."""
+    import jax.numpy as jnp
+
+    from aclswarm_tpu import gains as gainslib
+    from aclswarm_tpu.core.types import make_formation
+    from aclswarm_tpu.harness import formgen
+
+    box = SCALES[n]["box"]
+    spec = formgen.generate_specs(
+        n, seed=seed, l=box[0], w=box[1], h=box[2],
+        min_dist=SCALES[n]["min_dist"], k=1)[0]
+    g = np.asarray(gainslib.solve_gains(spec.points, spec.adjmat,
+                                        max_nonedges=max(n - 4, 1)))
+    form = make_formation(jnp.asarray(spec.points), jnp.asarray(spec.adjmat),
+                          jnp.asarray(g))
+    rng = np.random.default_rng(seed)
+    q0 = np.asarray(spec.points).copy()
+    q0[:, :2] += rng.normal(size=(n, 2)) * 3.0   # a few metres of transit
+    q0[:, 2] = np.abs(q0[:, 2]) + 2.0 \
+        + rng.normal(size=n) * 0.3               # airborne, above the floor
+    return form, q0
+
+
+def run_scale(n: int, *, seed: int = 1, drop_tick: int | None = None,
+              rejoin_tick: int | None = None, n_ticks: int | None = None,
+              chunk: int = 120, assign_every: int = 60) -> list[dict]:
+    import jax
+    import jax.numpy as jnp
+
+    from aclswarm_tpu import faults, sim
+    from aclswarm_tpu.core.types import ControlGains, SafetyParams
+    from aclswarm_tpu.sim import summary as sumlib
+
+    assert chunk % assign_every == 0, "shared auction phase"
+    drop_tick = SCALES[n]["drop_tick"] if drop_tick is None else drop_tick
+    rejoin_tick = SCALES[n]["rejoin_tick"] if rejoin_tick is None \
+        else rejoin_tick
+    n_ticks = SCALES[n]["n_ticks"] if n_ticks is None else n_ticks
+    form, q0 = _problem(n, seed)
+    B = len(GRID)
+    dtype = jnp.asarray(q0).dtype
+    scheds = [faults.sample_schedule(seed * 1000 + i, n, dropout_frac=df,
+                                     drop_tick=drop_tick,
+                                     rejoin_tick=rejoin_tick,
+                                     link_loss=pl, dtype=dtype)
+              for i, (df, pl) in enumerate(GRID)]
+    states = [sim.init_state(q0, localization=True, faults=sc)
+              for sc in scheds]
+    bstate = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+    bform = jax.tree.map(lambda *xs: jnp.stack(xs), *([form] * B))
+    sparams = SafetyParams(
+        bounds_min=jnp.asarray([-100.0, -100.0, 0.0]),
+        bounds_max=jnp.asarray([100.0, 100.0, 30.0]))
+    cfg = sim.SimConfig(assignment="cbaa", assign_every=assign_every,
+                        localization="flooded",
+                        colavoid_neighbors=16 if n > 16 else None)
+    window = 100                              # 1 s at the 100 Hz tick
+    carry = sumlib.init_carry(n, window, dtype=dtype, batch=B)
+
+    t0 = time.time()
+    conv = np.zeros((B, 0), bool)
+    rec = np.zeros((B, 0), np.int32)
+    chn = np.zeros((B, 0), np.int32)
+    nal = np.zeros((B, 0), np.int32)
+    for c0 in range(0, n_ticks, chunk):
+        bstate, carry, summ = sumlib.batched_rollout_summary(
+            bstate, carry, bform, ControlGains(), sparams, cfg, chunk,
+            None, 0, window=window, takeoff_alt=2.0)
+        conv = np.concatenate([conv, np.asarray(summ.conv_all)], axis=1)
+        rec = np.concatenate([rec, np.asarray(summ.recovery_ticks)], axis=1)
+        chn = np.concatenate([chn, np.asarray(summ.fault_churn)], axis=1)
+        nal = np.concatenate([nal, np.asarray(summ.n_alive)], axis=1)
+    wall = time.time() - t0
+
+    def first_recovery(b, after, before):
+        done = np.nonzero(rec[b, after:before] >= 0)[0]
+        if done.size == 0:
+            return -1, -1
+        t = after + int(done[0])
+        return int(rec[b, t]), int(chn[b, t])
+
+    rows = []
+    base = dict(n=n, unit="ticks", batch=B, seed=seed,
+                drop_tick=drop_tick, rejoin_tick=rejoin_tick,
+                assignment="cbaa", localization="flooded",
+                wall_s=round(wall, 1))
+    for b, (df, pl) in enumerate(GRID):
+        tag = f"n{n}_drop{int(df * 100):02d}_loss{int(pl * 100):02d}"
+        if df == 0.0 and pl == 0.0:
+            # control row: no fault events; record the initial
+            # convergence tick as the baseline transient (first
+            # full-window tick whose predicate holds — earlier ticks
+            # average the zero-padded history, which the host FSM's
+            # push counters would gate)
+            c = np.nonzero(conv[b, window:])[0]
+            rows.append(dict(base, name=f"baseline_conv_tick_n{n}",
+                             value=int(c[0]) + window if c.size else -1,
+                             dropout_frac=df, link_loss=pl))
+            continue
+        for event, lo, hi in (("drop", drop_tick, rejoin_tick),
+                              ("rejoin", rejoin_tick, n_ticks)):
+            r, c = first_recovery(b, lo, hi)
+            rows.append(dict(
+                base, name=f"recovery_ticks_{tag}_{event}", value=r,
+                dropout_frac=df, link_loss=pl, event=event,
+                churn=c, recovered=r >= 0,
+                n_alive_during=int(nal[b, lo + 1])))
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="n=10 only, short horizon (smoke)")
+    ap.add_argument("--n", type=int, action="append", default=None,
+                    help="scale(s) to run (default 10 and 100)")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--out", default=str(RESULTS / "fault_recovery.json"))
+    args = ap.parse_args(argv)
+
+    import jax
+    ns = args.n or ([10] if args.quick else [10, 100])
+    kw = dict(drop_tick=300, rejoin_tick=720, n_ticks=1200) if args.quick \
+        else {}
+    all_rows = []
+    for n in ns:
+        print(f"=== fault sweep n={n} (B={len(GRID)}) ===", flush=True)
+        rows = run_scale(n, seed=args.seed, **kw)
+        for r in rows:
+            r["device"] = jax.default_backend()
+            print(json.dumps(r), flush=True)
+        all_rows.extend(rows)
+
+    RESULTS.mkdir(exist_ok=True)
+    out = Path(args.out)
+    with out.open("w") as f:
+        for r in all_rows:
+            f.write(json.dumps(r) + "\n")
+    print(f"wrote {out} ({len(all_rows)} rows)")
+
+    # self-check against the committed-artifact schema guard
+    from check_results import check_file
+    probs = check_file(out)
+    if probs:
+        print("SCHEMA DRIFT in freshly written artifact:")
+        for p in probs:
+            print(f"  {p}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,11 @@ def pytest_configure(config):
         "slow: > ~30 s (full trials, cross-process bridge loops). Quick "
         "tier: pytest -m 'not slow' (< ~2 min); run the full suite "
         "before committing substantial changes")
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-injection / elastic-swarm subsystem "
+        "(aclswarm_tpu.faults; docs/FAULTS.md). Batch-scale sweeps "
+        "(B >= 8) additionally carry `slow` so tier-1 stays on budget")
 
 
 @pytest.fixture

@@ -1,0 +1,393 @@
+"""Fault-injection & elastic-swarm subsystem tests (`aclswarm_tpu.faults`).
+
+Pins the subsystem's three contracts:
+
+1. **No-fault parity**: a rollout carrying `no_faults(n)` is BIT-IDENTICAL
+   to one carrying ``faults=None`` — serial and batched, every assignment
+   mode, both information models (every fault mask is a `where` whose
+   all-true case is the pass-through operand).
+2. **Masked-assignment degenerates**: all-dead, single-survivor, and
+   dropout-then-rejoin round trips keep `v2f` a valid permutation with
+   dead vehicles pinned to their current points, for auction, CBAA, and
+   Sinkhorn.
+3. **Fault semantics**: dead vehicles freeze and cast no avoidance
+   sector, lossy links go hold-last-value stale in the flood, and the
+   on-device recovery clock (`sim.summary`) matches host recomputation.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from aclswarm_tpu import faults, sim
+from aclswarm_tpu.core import perm as permutil
+from aclswarm_tpu.core.types import ControlGains, SafetyParams, make_formation
+from aclswarm_tpu.sim import summary as sumlib
+
+pytestmark = pytest.mark.faults
+
+METRIC_FIELDS = ("distcmd_norm", "ca_active", "assign_valid", "reassigned",
+                 "auctioned", "q", "mode", "v2f")
+
+
+def _problem(B, n, seed=0, localization=False, scheds=None):
+    """B (formation, state) pairs + stacked batch, as in test_batched."""
+    rng = np.random.default_rng(seed)
+    adj = np.ones((n, n)) - np.eye(n)
+    forms, states = [], []
+    for b in range(B):
+        pts = rng.normal(size=(n, 3)) * 5
+        gains = rng.normal(size=(n, n, 3, 3)) * 0.01
+        forms.append(make_formation(jnp.asarray(pts), jnp.asarray(adj),
+                                    jnp.asarray(gains)))
+        states.append(sim.init_state(
+            rng.normal(size=(n, 3)) * 5 + np.array([0, 0, 2.0]),
+            localization=localization,
+            faults=None if scheds is None else scheds[b]))
+    sp = SafetyParams(bounds_min=jnp.asarray([-50.0, -50.0, 0.0]),
+                      bounds_max=jnp.asarray([50.0, 50.0, 20.0]))
+    bstate = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+    bform = jax.tree.map(lambda *xs: jnp.stack(xs), *forms)
+    return states, forms, bstate, bform, sp
+
+
+def _assert_rollouts_equal(m1, m2, f1, f2):
+    for name in METRIC_FIELDS:
+        np.testing.assert_array_equal(np.asarray(getattr(m1, name)),
+                                      np.asarray(getattr(m2, name)), name)
+    np.testing.assert_array_equal(np.asarray(f1.swarm.q),
+                                  np.asarray(f2.swarm.q))
+    np.testing.assert_array_equal(np.asarray(f1.swarm.vel),
+                                  np.asarray(f2.swarm.vel))
+    np.testing.assert_array_equal(np.asarray(f1.v2f), np.asarray(f2.v2f))
+
+
+def _assert_valid_perms(v2f):
+    """(T, n) or (T, B, n): every tick's assignment is a permutation."""
+    n = v2f.shape[-1]
+    flat = np.asarray(v2f).reshape(-1, n)
+    for row in flat:
+        assert sorted(row) == list(range(n))
+
+
+# --------------------------------------------------------------------------
+# 1. no-fault schedule == today's faultless engine, bit for bit
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("assignment", ["auction", "sinkhorn", "cbaa"])
+def test_no_fault_schedule_bit_parity_serial(assignment):
+    n, T = 6, 130
+    states, forms, _, _, sp = _problem(1, n, seed=1)
+    cfg = sim.SimConfig(assignment=assignment, assign_every=60,
+                        flight_fsm=True)
+    nf = faults.no_faults(n, states[0].swarm.q.dtype)
+    f1, m1 = sim.rollout(states[0], forms[0], ControlGains(), sp, cfg, T)
+    f2, m2 = sim.rollout(states[0].replace(faults=nf), forms[0],
+                         ControlGains(), sp, cfg, T)
+    _assert_rollouts_equal(m1, m2, f1, f2)
+    # the fault observables exist and are trivial
+    assert np.asarray(m2.alive).all()
+    assert not np.asarray(m2.fault_event).any()
+    assert m1.alive is None
+
+
+def test_no_fault_schedule_bit_parity_flooded():
+    """Flooded information model: the link mask must not perturb the
+    timestamped flood (estimate tables bit-identical too)."""
+    n, T = 6, 130
+    states, forms, _, _, sp = _problem(1, n, seed=2, localization=True)
+    cfg = sim.SimConfig(assignment="cbaa", assign_every=60,
+                        localization="flooded", flight_fsm=True)
+    nf = faults.no_faults(n, states[0].swarm.q.dtype)
+    f1, m1 = sim.rollout(states[0], forms[0], ControlGains(), sp, cfg, T)
+    f2, m2 = sim.rollout(states[0].replace(faults=nf), forms[0],
+                         ControlGains(), sp, cfg, T)
+    _assert_rollouts_equal(m1, m2, f1, f2)
+    np.testing.assert_array_equal(np.asarray(f1.loc.est),
+                                  np.asarray(f2.loc.est))
+    np.testing.assert_array_equal(np.asarray(f1.loc.age),
+                                  np.asarray(f2.loc.age))
+
+
+def test_no_fault_schedule_bit_parity_batched():
+    """Batched: a batch of no-fault schedules == the schedule-less batched
+    rollout, bit for bit (and == serial, transitively via test_batched)."""
+    B, n, T = 3, 6, 130
+    states, forms, bstate, bform, sp = _problem(B, n, seed=3)
+    cfg = sim.SimConfig(assignment="auction", assign_every=60)
+    nf = [faults.no_faults(n, bstate.swarm.q.dtype) for _ in range(B)]
+    # deep-copy: batched_rollout donates its carry, and the two batches
+    # would otherwise share (and invalidate) the same buffers
+    bstate_nf = jax.tree.map(jnp.copy, bstate).replace(
+        faults=jax.tree.map(lambda *xs: jnp.stack(xs), *nf))
+    bf1, bm1 = sim.batched_rollout(bstate, bform, ControlGains(), sp, cfg, T)
+    bf2, bm2 = sim.batched_rollout(bstate_nf, bform, ControlGains(), sp,
+                                   cfg, T)
+    _assert_rollouts_equal(bm1, bm2, bf1, bf2)
+
+
+# --------------------------------------------------------------------------
+# 2. batched rollout with heterogeneous fault scripts == serial, bit for bit
+# --------------------------------------------------------------------------
+
+def test_heterogeneous_schedules_batched_matches_serial():
+    """The tentpole acceptance claim: trials carrying DIFFERENT fault
+    scripts run in one compiled vmapped scan, bit-identical per trial to
+    serial rollouts with the same scripts (shared-tick decimation holds)."""
+    B, n, T = 3, 6, 130
+    scheds = [
+        faults.no_faults(n, jnp.float64),
+        faults.sample_schedule(11, n, dropout_frac=0.34, drop_tick=30,
+                               rejoin_tick=90, dtype=jnp.float64),
+        faults.sample_schedule(12, n, dropout_frac=0.5, drop_tick=61,
+                               link_loss=0.4, dtype=jnp.float64),
+    ]
+    states, forms, bstate, bform, sp = _problem(B, n, seed=4,
+                                                localization=True,
+                                                scheds=scheds)
+    cfg = sim.SimConfig(assignment="cbaa", assign_every=60,
+                        localization="flooded", flight_fsm=True)
+    bf, bm = sim.batched_rollout(bstate, bform, ControlGains(), sp, cfg, T)
+    for b in range(B):
+        fs, ms = sim.rollout(states[b], forms[b], ControlGains(), sp, cfg, T)
+        for name in METRIC_FIELDS + ("alive", "fault_event"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ms, name)),
+                np.asarray(getattr(bm, name))[:, b], (b, name))
+        np.testing.assert_array_equal(np.asarray(fs.loc.age),
+                                      np.asarray(bf.loc.age)[b])
+    _assert_valid_perms(bm.v2f)
+
+
+# --------------------------------------------------------------------------
+# 3. masked-assignment degenerate cases
+# --------------------------------------------------------------------------
+
+def _degenerate_schedule(n, kind, drop=30, rejoin=90, dtype=jnp.float64):
+    """all_dead / single_survivor / partial round-trip scripts."""
+    drops = np.full((n,), faults.NEVER, np.int32)
+    rejoins = np.full((n,), faults.NEVER, np.int32)
+    if kind == "all_dead":
+        drops[:] = drop
+    elif kind == "single_survivor":
+        drops[1:] = drop
+    elif kind == "round_trip":
+        drops[: n // 2] = drop
+        rejoins[: n // 2] = rejoin
+    else:
+        raise ValueError(kind)
+    return faults.FaultSchedule(drop_tick=jnp.asarray(drops),
+                                rejoin_tick=jnp.asarray(rejoins),
+                                link_loss=jnp.zeros((n, n), dtype),
+                                key=jnp.zeros((2,), jnp.uint32))
+
+
+@pytest.mark.parametrize("assignment", ["auction", "sinkhorn", "cbaa"])
+@pytest.mark.parametrize("kind",
+                         ["all_dead", "single_survivor", "round_trip"])
+def test_masked_assignment_degenerates(assignment, kind):
+    """All-dead, single-survivor, and dropout-then-rejoin round trips keep
+    the assignment a valid permutation with dead vehicles pinned to their
+    current points, in every solver mode."""
+    n, T, drop, rejoin = 6, 190, 30, 90
+    states, forms, _, _, sp = _problem(1, n, seed=5)
+    sched = _degenerate_schedule(n, kind, drop, rejoin)
+    cfg = sim.SimConfig(assignment=assignment, assign_every=60)
+    st = states[0].replace(faults=sched)
+    final, m = sim.rollout(st, forms[0], ControlGains(), sp, cfg, T)
+    _assert_valid_perms(m.v2f)
+
+    v2f = np.asarray(m.v2f)
+    alive = np.asarray(m.alive)
+    # dead vehicles never change assignment while dead: compare each
+    # dead tick's v2f entry to the pre-drop assignment
+    pre = v2f[drop - 1]
+    for t in range(drop, T):
+        dead = ~alive[t]
+        np.testing.assert_array_equal(v2f[t][dead], pre[dead],
+                                      f"dead row reassigned at tick {t}")
+    if kind == "round_trip":
+        # after rejoin the fleet keeps auctioning validly (auctions at
+        # t=120, 180 with everyone alive again)
+        assert alive[rejoin:].all()
+        auct = np.asarray(m.auctioned) & np.asarray(m.assign_valid)
+        assert auct[rejoin:].any()
+
+
+def test_dead_vehicles_freeze_and_cast_no_sector():
+    """A dead vehicle's pose/velocity hold exactly; survivors' collision
+    avoidance ignores it (no CA activity from a frozen obstacle parked
+    outside their paths); its ca/distcmd observables read inactive."""
+    n, T, drop, rejoin = 6, 120, 20, 80
+    states, forms, _, _, sp = _problem(1, n, seed=6)
+    sched = _degenerate_schedule(n, "round_trip", drop, rejoin)
+    cfg = sim.SimConfig(assignment="auction", assign_every=60)
+    st = states[0].replace(faults=sched)
+    _, m = sim.rollout(st, forms[0], ControlGains(), sp, cfg, T)
+    q = np.asarray(m.q)
+    vel_dead = np.asarray(m.distcmd_norm)
+    alive = np.asarray(m.alive)
+    dead = ~alive[drop]
+    assert dead.any()
+    # frozen: every dead tick's pose equals the pose at the drop tick
+    for t in range(drop, rejoin):
+        np.testing.assert_array_equal(q[t][dead], q[drop][dead])
+    # moves again after rejoin (the control law pulls it toward its point)
+    assert not np.array_equal(q[rejoin + 30][dead], q[drop][dead])
+    # dead observables: no distcmd, no CA activity
+    assert (vel_dead[drop:rejoin][:, dead] == 0.0).all()
+    assert not np.asarray(m.ca_active)[drop:rejoin][:, dead].any()
+
+
+def test_lossy_links_hold_last_value():
+    """link_loss=1 between all pairs: the flood delivers nothing, so every
+    off-diagonal estimate stays the startup census (hold-last-value) and
+    its age grows monotonically; loss=0 floods normally."""
+    n, T = 5, 40
+    states, forms, _, _, sp = _problem(1, n, seed=7, localization=True)
+    cfg = sim.SimConfig(assignment="none", localization="flooded")
+    loss = jnp.ones((n, n)) - jnp.eye(n)
+    sched = faults.FaultSchedule(
+        drop_tick=jnp.full((n,), faults.NEVER, jnp.int32),
+        rejoin_tick=jnp.full((n,), faults.NEVER, jnp.int32),
+        link_loss=loss.astype(states[0].swarm.q.dtype),
+        key=jnp.zeros((2,), jnp.uint32))
+    st = states[0].replace(faults=sched)
+    final, _ = sim.rollout(st, forms[0], ControlGains(), sp, cfg, T)
+    age = np.asarray(final.loc.age)
+    off = ~np.eye(n, dtype=bool)
+    census = np.asarray(states[0].loc.est)
+    # nothing ever delivered: ages reach T everywhere off-diagonal and the
+    # estimates are still the startup census
+    assert (age[off] == T).all()
+    np.testing.assert_array_equal(np.asarray(final.loc.est)[off],
+                                  census[off])
+    # control: loss=0 actually floods (ages bounded by the flood period)
+    nf = faults.no_faults(n, states[0].swarm.q.dtype)
+    final0, _ = sim.rollout(states[0].replace(faults=nf), forms[0],
+                            ControlGains(), sp, cfg, T)
+    assert (np.asarray(final0.loc.age)[off] < T).all()
+
+
+def test_link_draws_reproducible_and_seeded():
+    p = 0.5
+    n = 8
+    s1 = faults.sample_schedule(1, n, link_loss=p)
+    s2 = faults.sample_schedule(1, n, link_loss=p)
+    s3 = faults.sample_schedule(2, n, link_loss=p)
+    a = np.asarray(faults.link_up_at(s1, 17))
+    assert np.array_equal(a, np.asarray(faults.link_up_at(s2, 17)))
+    assert not np.array_equal(a, np.asarray(faults.link_up_at(s1, 18)))
+    assert not np.array_equal(a, np.asarray(faults.link_up_at(s3, 17)))
+    # diagonal never lossy in sampled specs
+    assert np.asarray(faults.link_up_at(s1, 17))[np.eye(n, dtype=bool)].all()
+
+
+# --------------------------------------------------------------------------
+# 4. recovery observability (sim.summary)
+# --------------------------------------------------------------------------
+
+def test_recovery_clock_matches_host_recompute():
+    """Device recovery clock == host recomputation over the per-tick
+    fault_event/conv/reassigned bools, across a chunk boundary."""
+    B, n, T, W = 2, 6, 150, 20
+    scheds = [faults.sample_schedule(20 + b, n, dropout_frac=0.34,
+                                     drop_tick=40, rejoin_tick=100,
+                                     dtype=jnp.float64)
+              for b in range(B)]
+    states, forms, bstate, bform, sp = _problem(B, n, seed=8,
+                                                scheds=scheds)
+    cfg = sim.SimConfig(assignment="auction", assign_every=50)
+    carry = sumlib.init_carry(n, W, dtype=jnp.float64, batch=B)
+    chunks = []
+    for _ in range(2):
+        bstate, carry, summ = sumlib.batched_rollout_summary(
+            bstate, carry, bform, ControlGains(), sp, cfg, T // 2,
+            None, 0, window=W, takeoff_alt=2.0)
+        chunks.append(jax.tree.map(np.asarray, summ))
+    cat = lambda name: np.concatenate(
+        [getattr(c, name) for c in chunks], axis=1)
+    ev, conv, re = cat("fault_event"), cat("conv_all"), cat("reassigned")
+    rec, chn = cat("recovery_ticks"), cat("fault_churn")
+    for b in range(B):
+        pending, since, churn = False, 0, 0
+        for t in range(T):
+            since = 0 if ev[b, t] else since + 1
+            churn = 0 if ev[b, t] else churn + int(re[b, t])
+            pending = pending or bool(ev[b, t])
+            done = (pending and bool(conv[b, t]) and not bool(ev[b, t])
+                    and since >= W)   # full-window gate (`_recovery_clock`)
+            assert rec[b, t] == (since if done else -1), (b, t)
+            assert chn[b, t] == (churn if done else -1), (b, t)
+            if done:
+                pending = False
+        # two fault events surfaced (drop + rejoin)
+        assert ev[b].sum() == 2
+
+
+def test_summary_without_faults_has_none_fields():
+    n, T, W = 6, 60, 20
+    states, forms, _, _, sp = _problem(1, n, seed=9)
+    cfg = sim.SimConfig(assignment="auction", assign_every=60)
+    _, m = sim.rollout(states[0], forms[0], ControlGains(), sp, cfg, T)
+    carry = sumlib.init_carry(n, W, dtype=jnp.float64)
+    summ, _ = sumlib.summarize_chunk(m, carry, W, 2.0)
+    assert summ.recovery_ticks is None and summ.fault_event is None
+    assert summ.n_alive is None and summ.fault_churn is None
+
+
+# --------------------------------------------------------------------------
+# 5. guard rails
+# --------------------------------------------------------------------------
+
+def test_flooded_with_faults_needs_localization_tables():
+    """The satellite check: flooded + FaultSchedule without
+    init_state(..., localization=True) raises the fault-specific error."""
+    n = 5
+    states, forms, _, _, sp = _problem(1, n, seed=10)
+    cfg = sim.SimConfig(assignment="none", localization="flooded")
+    st = states[0].replace(faults=faults.no_faults(n))
+    with pytest.raises(ValueError, match="FaultSchedule"):
+        sim.step(st, forms[0], ControlGains(), sp, cfg)
+    # and the pre-existing flooded check still fires without faults
+    with pytest.raises(ValueError, match="localization=True"):
+        sim.step(states[0], forms[0], ControlGains(), sp, cfg)
+
+
+def test_sample_schedule_validates_rejoin():
+    with pytest.raises(ValueError, match="rejoin_tick"):
+        faults.sample_schedule(0, 4, dropout_frac=0.5, drop_tick=10,
+                               rejoin_tick=10)
+
+
+# --------------------------------------------------------------------------
+# 6. batch-scale sweep (slow tier)
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_fault_sweep_b8_batched_matches_serial():
+    """A B=8 wave of distinct dropout/link-loss scripts through the
+    batched engine == 8 serial rollouts (the faults_suite sweep shape)."""
+    B, n, T = 8, 6, 130
+    rng = np.random.default_rng(0)
+    scheds = []
+    for b in range(B):
+        scheds.append(faults.sample_schedule(
+            b, n, dropout_frac=float(rng.choice([0.0, 0.17, 0.34])),
+            drop_tick=30, rejoin_tick=int(rng.integers(70, 110)),
+            link_loss=float(rng.choice([0.0, 0.3])), dtype=jnp.float64))
+    states, forms, bstate, bform, sp = _problem(B, n, seed=11,
+                                                localization=True,
+                                                scheds=scheds)
+    cfg = sim.SimConfig(assignment="cbaa", assign_every=60,
+                        localization="flooded")
+    bf, bm = sim.batched_rollout(bstate, bform, ControlGains(), sp, cfg, T)
+    for b in range(B):
+        fs, ms = sim.rollout(states[b], forms[b], ControlGains(), sp,
+                             cfg, T)
+        for name in METRIC_FIELDS + ("alive",):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ms, name)),
+                np.asarray(getattr(bm, name))[:, b], (b, name))
+    _assert_valid_perms(bm.v2f)

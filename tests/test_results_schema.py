@@ -1,0 +1,63 @@
+"""Committed benchmark artifacts stay on schema (benchmarks/check_results).
+
+Tier-1 guard: every committed `benchmarks/results/*.json` row carries a
+usable name + value (or a recorded error), and strict new-style artifacts
+(fault_recovery.json) carry full ``{name, n, value}`` rows — schema drift
+fails loudly here instead of silently corrupting downstream evidence.
+"""
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "benchmarks"))
+
+from check_results import RESULTS, check_all, check_file  # noqa: E402
+
+
+def test_committed_artifacts_pass_schema():
+    probs = check_all()
+    assert not probs, "artifact schema drift:\n" + "\n".join(probs)
+
+
+def test_strict_artifact_present_and_strictly_checked():
+    """fault_recovery.json is committed and held to {name, n, value}."""
+    path = RESULTS / "fault_recovery.json"
+    assert path.exists(), "benchmarks/results/fault_recovery.json missing"
+    rows = [json.loads(ln) for ln in path.read_text().strip().splitlines()]
+    assert rows, "fault_recovery.json has no rows"
+    for row in rows:
+        assert isinstance(row["name"], str) and row["name"]
+        assert isinstance(row["n"], int) and row["n"] > 0
+        assert isinstance(row["value"], (int, float))
+    # both benchmark scales are represented
+    assert {r["n"] for r in rows} >= {10, 100}
+
+
+def test_checker_flags_drift(tmp_path):
+    """The guard actually fails on drifted rows (not a rubber stamp)."""
+    bad = tmp_path / "fault_recovery.json"
+    bad.write_text('{"name": "x", "value": 1.0}\n'     # missing n
+                   '{"n": 10, "value": 2.0}\n'         # missing name
+                   '{"name": "y", "n": 10}\n')         # missing value
+    probs = check_file(bad)
+    assert len(probs) == 3, probs
+
+    ok = tmp_path / "whatever.json"
+    ok.write_text('{"metric": "legacy_row", "value": 3.0}\n'
+                  '{"metric": "recorded_failure", "error": "boom"}\n')
+    assert check_file(ok) == []
+
+    drift = tmp_path / "other.json"
+    drift.write_text('{"metric": "no_value_no_error"}\n')
+    assert len(check_file(drift)) == 1
+    empty = tmp_path / "empty.json"
+    empty.write_text("")
+    assert len(check_file(empty)) == 1
+
+
+def test_checker_accepts_summary_objects(tmp_path):
+    summ = tmp_path / "trials_summary.json"
+    summ.write_text(json.dumps({"backend": "cpu", "configs": {}}, indent=1))
+    assert check_file(summ) == []
