@@ -1,0 +1,35 @@
+"""`jaxcheck` — repo-wide JAX static analysis (docs/STATIC_ANALYSIS.md).
+
+Two layers guard the compiled surface the perf work built up
+(batched `scan` rollouts, donated carries, fault masks, Pallas ops):
+
+- `analysis.lint` — an AST linter with JAX-specific rules JC001–JC005
+  (host syncs reachable from jit, Python control flow on traced values,
+  weak-dtype array creation, nondeterminism in compiled paths,
+  read-after-donate). Run standalone via ``scripts/lint.sh`` or
+  ``python -m aclswarm_tpu.analysis.lint``.
+- `analysis.trace_audit` — an entry-point registry of every public
+  jitted function, abstract-traced under
+  ``jax.transfer_guard("disallow")``, asserting no implicit transfers,
+  cache stability (a second identical call compiles nothing), and no
+  f64 leaves in any output aval.
+
+Both run in tier-1 (`tests/test_analysis.py`, marker ``analysis``).
+"""
+# lazy re-exports: `python -m aclswarm_tpu.analysis.lint` must not
+# re-import its own module through the package (runpy double-import),
+# and importing the package must stay cheap for scripts/lint.sh
+_LINT = ("Violation", "lint_paths")
+_AUDIT = ("ENTRY_POINTS", "AuditReport", "audit_entry", "audit_all",
+          "iter_grid", "register_entry", "GridPoint", "f32_mode")
+__all__ = list(_LINT + _AUDIT)
+
+
+def __getattr__(name):
+    if name in _LINT:
+        from aclswarm_tpu.analysis import lint
+        return getattr(lint, name)
+    if name in _AUDIT:
+        from aclswarm_tpu.analysis import trace_audit
+        return getattr(trace_audit, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,827 @@
+"""`jaxcheck` layer 1: AST lint with JAX-specific rules (JC001–JC005).
+
+Why an AST pass and not runtime checks: the defect classes below are
+*silent* at runtime on CPU CI (a host sync inside a rollout is just a
+slow tick; a weak-dtype `jnp.asarray` is just an extra compile), and
+only become visible as vanished throughput on the real accelerator —
+exactly the regression class PR 1's 182x on-device win is exposed to.
+The linter makes them loud at review time.
+
+Rules (catalog + rationale: docs/STATIC_ANALYSIS.md):
+
+- **JC001 host-sync-in-jit** — `.item()`, `.tolist()`, `float(...)`,
+  `np.asarray`/`np.array`, `jax.device_get`, `block_until_ready`
+  lexically inside a function reachable from a `@jax.jit` root or a
+  `scan`/`vmap`/`cond` body. These force a device->host round trip (or
+  fail tracing outright) inside the hot path.
+- **JC002 python-control-flow-on-traced** — `if`/`while` (and `x if c
+  else y`) whose condition reads a *traced* parameter of a
+  jit-reachable function. Heuristic: parameters are presumed static
+  when their annotation is a Python-static type (`int`, `str`, `bool`,
+  `float`, `tuple`, optionally `| None`), when their default is a
+  Python literal, or when their name is in `STATIC_PARAM_NAMES`;
+  `is None` tests, `.shape`/`.ndim`/`.dtype` accesses, `isinstance`,
+  and comparisons against string literals are always allowed.
+- **JC003 weak-dtype-array** — dtype-less `jnp.asarray`/`jnp.array` on
+  a bare name or numeric literal inside jit-reachable code or a pytree
+  `struct.field(default_factory=...)`. Python scalars produce
+  weak-typed avals and names inherit whatever the caller passed, so
+  the same call site traces to different avals on different calls —
+  the silent-recompile generator. Bool literals are exempt (JAX bools
+  are not weak).
+- **JC004 nondeterminism-in-jit** — `time.time`/`perf_counter`/
+  `monotonic`, `np.random.*`, stdlib `random.*` inside jit-reachable
+  code. These bake a host value into the compiled constant pool: the
+  program is stale the second call and nondeterministic across
+  retraces (device randomness goes through `jax.random` keys).
+- **JC005 read-after-donate** — a bare name passed in a donated
+  position of a call to a `donate_argnums` function and *read again*
+  after that call without rebinding. The donated buffer is dead; XLA
+  may have aliased it into the output.
+
+Escape hatch: append ``# jaxcheck: disable=JC001`` (comma-separate
+several rules, or omit ``=...`` to disable all rules) to the offending
+line.
+
+Run standalone: ``python -m aclswarm_tpu.analysis.lint [paths...]`` or
+``scripts/lint.sh``. Zero violations on `aclswarm_tpu/` is enforced in
+tier-1 (`tests/test_analysis.py`).
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import re
+import sys
+from pathlib import Path
+
+# ---------------------------------------------------------------------------
+# configuration
+
+RULES = {
+    "JC001": "host sync reachable from jit",
+    "JC002": "python control flow on traced value",
+    "JC003": "dtype-less array creation (weak-type -> recompile)",
+    "JC004": "host nondeterminism in compiled path",
+    "JC005": "donated argument read after donation",
+}
+
+# parameter names presumed compile-time static even without annotation —
+# the codebase's conventional config/static spellings (JC002 allowlist)
+STATIC_PARAM_NAMES = {
+    "self", "cls", "cfg", "config", "params", "dtype", "shape", "axis",
+    "n", "d", "mode", "impl", "static", "planar", "window",
+}
+
+# annotations that mark a parameter as a Python-static value
+_STATIC_ANN_NAMES = {"int", "str", "bool", "float", "tuple", "bytes"}
+
+# attribute accesses that are static regardless of the root object
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "weak_type"}
+
+# jax transforms whose function-valued arguments execute in a compiled
+# context (fq dotted names after alias resolution)
+_TRANSFORMS = {
+    "jax.jit", "jax.pmap", "jax.vmap", "jax.eval_shape", "jax.checkpoint",
+    "jax.remat", "jax.grad", "jax.value_and_grad", "jax.experimental.pjit",
+    "jax.lax.scan", "jax.lax.cond", "jax.lax.while_loop", "jax.lax.map",
+    "jax.lax.fori_loop", "jax.lax.switch", "jax.lax.associative_scan",
+    "jax.lax.custom_root",
+}
+
+# JC001 call targets (fq) and method names
+_HOST_SYNC_FQ = {
+    "jax.device_get", "jax.block_until_ready",
+    "numpy.asarray", "numpy.array", "numpy.copyto",
+}
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+# JC004 call targets: exact fq names, and fq prefixes (module trees)
+_NONDET_FQ = {
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.process_time", "time.time_ns", "time.perf_counter_ns",
+}
+_NONDET_PREFIXES = ("numpy.random.", "random.", "secrets.", "uuid.")
+
+_ARRAY_CTORS = {"jax.numpy.asarray", "jax.numpy.array"}
+
+_DISABLE_RE = re.compile(
+    r"#\s*jaxcheck:\s*disable(?:\s*=\s*([A-Za-z0-9_,\s]+))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# module model
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function/method/lambda and its lint-relevant facts."""
+
+    fq: str                       # module.qualname
+    module: "ModuleInfo"
+    node: ast.AST                 # FunctionDef | AsyncFunctionDef | Lambda
+    parent: "FuncInfo | None"
+    params: list[str] = dataclasses.field(default_factory=list)
+    static_params: set[str] = dataclasses.field(default_factory=set)
+    jit_root: bool = False
+    donate_positions: tuple[int, ...] = ()
+    donate_names: tuple[str, ...] = ()
+    children: dict[str, "FuncInfo"] = dataclasses.field(default_factory=dict)
+    calls: list[tuple[ast.Call, "FuncInfo"]] = \
+        dataclasses.field(default_factory=list)   # (call node, scope)
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    name: str
+    path: Path
+    tree: ast.Module
+    aliases: dict[str, str] = dataclasses.field(default_factory=dict)
+    defs: dict[str, FuncInfo] = dataclasses.field(default_factory=dict)
+    funcs: list[FuncInfo] = dataclasses.field(default_factory=list)
+    lambdas: list[FuncInfo] = dataclasses.field(default_factory=list)
+    factories: list[ast.Lambda] = dataclasses.field(default_factory=list)
+    pytree_classes: set[str] = dataclasses.field(default_factory=set)
+    disabled: dict[int, set | None] = dataclasses.field(default_factory=dict)
+
+
+def _module_name(path: Path) -> str:
+    """Dotted module name by walking up through package __init__ files."""
+    path = path.resolve()
+    parts = [path.stem] if path.stem != "__init__" else []
+    d = path.parent
+    while (d / "__init__.py").exists():
+        parts.insert(0, d.name)
+        d = d.parent
+    return ".".join(parts) or path.stem
+
+
+def _dotted(node: ast.AST) -> list[str] | None:
+    """Name/Attribute chain -> ['a', 'b', 'c'] for a.b.c, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.insert(0, node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.insert(0, node.id)
+        return parts
+    return None
+
+
+def _is_static_annotation(ann: ast.AST | None) -> bool:
+    """int / str / bool / float / tuple, optionally `| None` / Optional."""
+    if ann is None:
+        return False
+    if isinstance(ann, ast.Constant):           # string annotations
+        return any(t in str(ann.value).replace(" ", "").split("|")
+                   for t in _STATIC_ANN_NAMES)
+    if isinstance(ann, ast.Name):
+        return ann.id in _STATIC_ANN_NAMES
+    if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+        sides = [ann.left, ann.right]
+        return any(_is_static_annotation(s) for s in sides
+                   if not (isinstance(s, ast.Constant) and s.value is None))
+    if isinstance(ann, ast.Subscript):          # Optional[int] etc.
+        base = _dotted(ann.value)
+        if base and base[-1] in ("Optional", "Union"):
+            return _is_static_annotation(ann.slice)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# pass A: per-module collection
+
+class _Collector(ast.NodeVisitor):
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.scope: list[FuncInfo] = []
+        self.qual: list[str] = []
+
+    # -- imports -> alias map ------------------------------------------------
+    def visit_Import(self, node: ast.Import):
+        for a in node.names:
+            if a.asname:                 # `import jax.numpy as jnp`
+                self.mod.aliases[a.asname] = a.name
+            else:                        # `import jax.numpy` binds `jax`
+                head = a.name.split(".")[0]
+                self.mod.aliases.setdefault(head, head)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        if node.level:      # relative: resolve against this module's package
+            pkg = self.mod.name.split(".")
+            # drop the module's own leaf unless it's a package __init__
+            if self.mod.path.stem != "__init__":
+                pkg = pkg[:-1]
+            pkg = pkg[:len(pkg) - (node.level - 1)]
+            base = ".".join(pkg + ([node.module] if node.module else []))
+        else:
+            base = node.module or ""
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.mod.aliases[a.asname or a.name] = f"{base}.{a.name}"
+        self.generic_visit(node)
+
+    # -- defs ---------------------------------------------------------------
+    def _decorator_facts(self, node):
+        """(jit_root, donate_positions, donate_names, static_names)."""
+        jit = False
+        donate_pos: tuple[int, ...] = ()
+        donate_names: tuple[str, ...] = ()
+        static_names: set[str] = set()
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            parts = _dotted(target)
+            fq = self._resolve_parts(parts) if parts else None
+            kw = {}
+            if isinstance(dec, ast.Call):
+                if fq == "functools.partial" and dec.args:
+                    inner = _dotted(dec.args[0])
+                    fq = self._resolve_parts(inner) if inner else None
+                kw = {k.arg: k.value for k in dec.keywords if k.arg}
+            if fq in ("jax.jit", "jax.pmap", "jax.experimental.pjit"):
+                jit = True
+                for key, sink in (("donate_argnums", "pos"),
+                                  ("donate_argnames", "name"),
+                                  ("static_argnums", "spos"),
+                                  ("static_argnames", "sname")):
+                    v = kw.get(key)
+                    if v is None:
+                        continue
+                    try:
+                        vals = ast.literal_eval(v)
+                    except Exception:       # computed argnums: best effort
+                        continue
+                    vals = (vals,) if not isinstance(
+                        vals, (tuple, list)) else tuple(vals)
+                    if sink == "pos":
+                        donate_pos = tuple(int(x) for x in vals)
+                    elif sink == "name":
+                        donate_names = tuple(str(x) for x in vals)
+                    elif sink == "sname":
+                        static_names |= {str(x) for x in vals}
+                    elif sink == "spos":
+                        args = [a.arg for a in node.args.posonlyargs
+                                + node.args.args]
+                        static_names |= {args[i] for i in vals
+                                         if i < len(args)}
+        return jit, donate_pos, donate_names, static_names
+
+    def _make_func(self, node, name: str) -> FuncInfo:
+        fq = ".".join([self.mod.name] + self.qual + [name])
+        info = FuncInfo(fq=fq, module=self.mod, node=node,
+                        parent=self.scope[-1] if self.scope else None)
+        if isinstance(node, ast.Lambda):
+            args = node.args
+        else:
+            args = node.args
+        params, statics = [], set()
+        all_args = (args.posonlyargs + args.args + args.kwonlyargs
+                    + ([args.vararg] if args.vararg else [])
+                    + ([args.kwarg] if args.kwarg else []))
+        ndef = len(args.defaults)
+        defaulted = {a.arg for a in (args.posonlyargs + args.args)[-ndef:]
+                     } if ndef else set()
+        defaulted |= {a.arg for a, d in zip(args.kwonlyargs, args.kw_defaults)
+                      if d is not None}
+        for a in all_args:
+            params.append(a.arg)
+            ann_static = _is_static_annotation(getattr(a, "annotation", None))
+            if (a.arg in STATIC_PARAM_NAMES or ann_static
+                    or a.arg in defaulted):
+                statics.add(a.arg)
+        info.params = params
+        info.static_params = statics
+        if not isinstance(node, ast.Lambda):
+            (info.jit_root, info.donate_positions, info.donate_names,
+             deco_static) = self._decorator_facts(node)
+            info.static_params |= deco_static
+        if self.scope:
+            self.scope[-1].children[name] = info
+        return info
+
+    def visit_FunctionDef(self, node):
+        info = self._make_func(node, node.name)
+        self.mod.funcs.append(info)
+        self.mod.defs[".".join(self.qual + [node.name])] = info
+        self.scope.append(info)
+        self.qual.append(node.name)
+        self.generic_visit(node)
+        self.qual.pop()
+        self.scope.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        # flax struct dataclasses are the jit-facing pytrees: host
+        # functions constructing them feed avals straight into the jit
+        # cache, so JC003 applies to their whole body
+        for dec in node.decorator_list:
+            parts = _dotted(dec.func if isinstance(dec, ast.Call) else dec)
+            fq = self._resolve_parts(parts) if parts else None
+            if fq in ("flax.struct.dataclass", "struct.dataclass",
+                      "chex.dataclass"):
+                self.mod.pytree_classes.add(node.name)
+        self.qual.append(node.name)
+        self.generic_visit(node)
+        self.qual.pop()
+
+    def visit_Lambda(self, node: ast.Lambda):
+        info = self._make_func(node, f"<lambda L{node.lineno}>")
+        self.mod.lambdas.append(info)
+        self.scope.append(info)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    def visit_Call(self, node: ast.Call):
+        if self.scope:
+            self.scope[-1].calls.append((node, self.scope[-1]))
+        else:
+            # module-level call (e.g. a struct.field default_factory)
+            pass
+        # default_factory lambdas are pytree-construction sites: their
+        # bodies run on every dataclass instantiation, including inside
+        # jit — collect them for JC003 regardless of reachability
+        for k in node.keywords:
+            if k.arg == "default_factory" and isinstance(k.value, ast.Lambda):
+                self.mod.factories.append(k.value)
+        self.generic_visit(node)
+
+    def _resolve_parts(self, parts: list[str]) -> str | None:
+        """Local best-effort: alias-expand the head within this module."""
+        if not parts:
+            return None
+        head = self.mod.aliases.get(parts[0], parts[0])
+        return ".".join([head] + parts[1:])
+
+
+# ---------------------------------------------------------------------------
+# linter
+
+class Linter:
+    def __init__(self) -> None:
+        self.modules: dict[str, ModuleInfo] = {}
+        self.violations: list[Violation] = []
+
+    # -- loading ------------------------------------------------------------
+    def load(self, paths: list[Path]) -> None:
+        files: list[Path] = []
+        for p in paths:
+            p = Path(p)
+            files += sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for f in files:
+            src = f.read_text()
+            mod = ModuleInfo(name=_module_name(f), path=f,
+                             tree=ast.parse(src, filename=str(f)))
+            for i, line in enumerate(src.splitlines(), 1):
+                m = _DISABLE_RE.search(line)
+                if m:
+                    mod.disabled[i] = (
+                        {r.strip().upper() for r in m.group(1).split(",")}
+                        if m.group(1) else None)
+            _Collector(mod).visit(mod.tree)
+            self.modules[mod.name] = mod
+
+    # -- cross-module resolution --------------------------------------------
+    def _resolve(self, mod: ModuleInfo, parts: list[str],
+                 scope: FuncInfo | None = None, _depth: int = 0
+                 ) -> "FuncInfo | str | None":
+        """Resolve a dotted call target to a FuncInfo (repo function), a
+        fq string (external, e.g. 'jax.lax.scan'), or None."""
+        if not parts or _depth > 8:
+            return None
+        # lexical scope chain: nested defs visible to enclosing functions
+        s = scope
+        while s is not None and len(parts) == 1:
+            if parts[0] in s.children:
+                return s.children[parts[0]]
+            s = s.parent
+        # self.method -> any method of an enclosing/any class in module
+        if parts[0] in ("self", "cls") and len(parts) == 2:
+            for qual, info in mod.defs.items():
+                if qual.split(".")[-1] == parts[1] and "." in qual:
+                    return info
+            return None
+        # module-local definition (possibly Class.method)
+        if ".".join(parts) in mod.defs:
+            return mod.defs[".".join(parts)]
+        if parts[0] in mod.defs:
+            return mod.defs[parts[0]]
+        # alias expansion
+        head = mod.aliases.get(parts[0])
+        if head is None:
+            return None
+        fq = head.split(".") + parts[1:]
+        return self._resolve_fq(fq, _depth + 1)
+
+    def _resolve_fq(self, parts: list[str], _depth: int = 0
+                    ) -> "FuncInfo | str | None":
+        fqs = ".".join(parts)
+        # longest module prefix owned by the repo
+        for cut in range(len(parts), 0, -1):
+            mname = ".".join(parts[:cut])
+            if mname in self.modules:
+                tmod = self.modules[mname]
+                rest = parts[cut:]
+                if not rest:
+                    return fqs
+                if ".".join(rest) in tmod.defs:
+                    return tmod.defs[".".join(rest)]
+                # re-export through the target module's imports
+                if rest[0] in tmod.aliases:
+                    tgt = tmod.aliases[rest[0]].split(".") + rest[1:]
+                    return self._resolve_fq(tgt, _depth + 1)
+                return fqs
+        return fqs      # external (jax.lax.scan, numpy.asarray, ...)
+
+    # -- reachability -------------------------------------------------------
+    def _compiled_set(self) -> set[int]:
+        """ids of FuncInfos reachable from a jit root / transform body."""
+        roots: list[FuncInfo] = []
+        for mod in self.modules.values():
+            for info in mod.funcs:
+                if info.jit_root:
+                    roots.append(info)
+            # function-valued args of jax transforms
+            for info in mod.funcs + mod.lambdas:
+                for call, scope in info.calls:
+                    parts = _dotted(call.func)
+                    target = self._resolve(mod, parts, scope) if parts \
+                        else None
+                    fq = target if isinstance(target, str) else (
+                        None if target is None else None)
+                    if isinstance(target, str) and target in _TRANSFORMS:
+                        cands = list(call.args)
+                        if (target == "functools.partial" and call.args):
+                            cands = call.args[1:]
+                        lam_map = {id(f.node): f for f in mod.lambdas}
+                        for a in cands:
+                            if isinstance(a, ast.Lambda):
+                                t = lam_map.get(id(a))
+                                if t is not None:
+                                    roots.append(t)
+                                continue
+                            ap = _dotted(a)
+                            if ap:
+                                t = self._resolve(mod, ap, scope)
+                                if isinstance(t, FuncInfo):
+                                    roots.append(t)
+                    del fq
+        seen: set[int] = set()
+        stack = roots[:]
+        while stack:
+            f = stack.pop()
+            if id(f) in seen:
+                continue
+            seen.add(id(f))
+            # lambdas nested in compiled code execute in the same trace
+            for child in f.children.values():
+                if isinstance(child.node, ast.Lambda):
+                    stack.append(child)
+            for call, scope in f.calls:
+                parts = _dotted(call.func)
+                if parts:
+                    t = self._resolve(f.module, parts, scope)
+                    if isinstance(t, FuncInfo):
+                        stack.append(t)
+                # names passed as function args within compiled code
+                # (scan/cond bodies defined elsewhere)
+                for a in call.args:
+                    ap = _dotted(a)
+                    if ap:
+                        ta = self._resolve(f.module, ap, scope)
+                        if isinstance(ta, FuncInfo) and id(ta) not in seen:
+                            stack.append(ta)
+        return seen
+
+    # -- rule machinery -----------------------------------------------------
+    def _emit(self, mod: ModuleInfo, node: ast.AST, rule: str, msg: str):
+        line = getattr(node, "lineno", 0)
+        if line in mod.disabled:
+            rules = mod.disabled[line]
+            if rules is None or rule in rules:
+                return
+        self.violations.append(
+            Violation(str(mod.path), line, rule, msg))
+
+    def _call_fq(self, mod: ModuleInfo, call: ast.Call,
+                 scope: FuncInfo | None) -> str | None:
+        parts = _dotted(call.func)
+        if not parts:
+            return None
+        t = self._resolve(mod, parts, scope)
+        return t if isinstance(t, str) else (t.fq if t else None)
+
+    @staticmethod
+    def _iter_own_body(info: FuncInfo):
+        """Nodes of this function's body, NOT descending into nested
+        defs/lambdas (they are separate FuncInfos, checked when they are
+        themselves reachable)."""
+        if isinstance(info.node, ast.Lambda):
+            start = [info.node.body]
+        else:
+            start = list(info.node.body)
+        stack = start[:]
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                stack.append(child)
+
+    # JC001 / JC003 / JC004 share a walk over a compiled body
+    def _check_compiled_body(self, info: FuncInfo) -> None:
+        mod = info.module
+        for node in self._iter_own_body(info):
+            if isinstance(node, ast.Call):
+                self._check_call(info, mod, node)
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                self._check_jc002(info, mod, node, node.test)
+
+    def _check_call(self, info: FuncInfo, mod: ModuleInfo,
+                    call: ast.Call) -> None:
+        fq = self._call_fq(mod, call, info)
+        # JC001: host syncs
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in _HOST_SYNC_METHODS:
+            self._emit(mod, call, "JC001",
+                       f".{call.func.attr}() forces a device->host sync "
+                       "inside a jit-reachable function")
+        elif fq in _HOST_SYNC_FQ:
+            self._emit(mod, call, "JC001",
+                       f"{fq} forces a host transfer inside a "
+                       "jit-reachable function")
+        elif (isinstance(call.func, ast.Name) and call.func.id == "float"
+              and call.args
+              and not isinstance(call.args[0], ast.Constant)):
+            self._emit(mod, call, "JC001",
+                       "float(...) concretizes a traced value "
+                       "(device->host sync) inside a jit-reachable "
+                       "function")
+        # JC004: nondeterminism
+        if fq and (fq in _NONDET_FQ
+                   or any(fq.startswith(p) for p in _NONDET_PREFIXES)):
+            self._emit(mod, call, "JC004",
+                       f"{fq} bakes a host value into the compiled "
+                       "program (stale + nondeterministic across "
+                       "retraces); thread jax.random keys instead")
+        # JC003: weak-dtype array creation
+        if fq in _ARRAY_CTORS:
+            self._check_jc003(mod, call, fq)
+
+    def _check_jc003(self, mod: ModuleInfo, call: ast.Call,
+                     fq: str) -> None:
+        if len(call.args) >= 2 or any(k.arg == "dtype"
+                                      for k in call.keywords):
+            return
+        if not call.args:
+            return
+        arg = call.args[0]
+        if self._weak_candidate(arg):
+            name = fq.split(".")[-1]
+            self._emit(mod, call, "JC003",
+                       f"dtype-less jnp.{name}(...) — a Python scalar "
+                       "traces weak-typed and a bare name inherits the "
+                       "caller's dtype, so identical calls retrace; "
+                       "pass an explicit dtype")
+
+    @staticmethod
+    def _weak_candidate(arg: ast.AST) -> bool:
+        """Arguments whose dtype depends on the caller / Python literals."""
+        if isinstance(arg, ast.Constant):
+            return isinstance(arg.value, (int, float, complex)) \
+                and not isinstance(arg.value, bool)
+        if isinstance(arg, ast.Name):
+            return True
+        if isinstance(arg, ast.UnaryOp):
+            return Linter._weak_candidate(arg.operand)
+        if isinstance(arg, (ast.List, ast.Tuple)):
+            return any(Linter._weak_candidate(e) for e in arg.elts)
+        return False
+
+    # -- JC002 --------------------------------------------------------------
+    def _check_jc002(self, info: FuncInfo, mod: ModuleInfo,
+                     node: ast.AST, test: ast.AST) -> None:
+        offenders = self._traced_names_in_test(info, test)
+        for name in sorted(offenders):
+            kind = "while" if isinstance(node, ast.While) else "if"
+            self._emit(
+                mod, node, "JC002",
+                f"python `{kind}` on traced parameter `{name}` — under "
+                "jit this branches on an abstract value (TracerBoolError "
+                "or silent both-branch select); use lax.cond/jnp.where, "
+                "or mark the parameter static")
+
+    def _traced_names_in_test(self, info: FuncInfo,
+                              test: ast.AST) -> set[str]:
+        # collect parameter names from the lexical scope chain
+        traced: dict[str, bool] = {}
+        s: FuncInfo | None = info
+        while s is not None:
+            for p in s.params:
+                if p not in traced:
+                    traced[p] = p not in s.static_params
+            s = s.parent
+
+        offenders: set[str] = set()
+
+        def walk(n: ast.AST, safe: bool) -> None:
+            if isinstance(n, ast.Compare):
+                ops_safe = all(isinstance(o, (ast.Is, ast.IsNot))
+                               for o in n.ops)
+                # comparisons against string literals are static mode
+                # switches (assignment/localization/impl selectors)
+                str_cmp = any(isinstance(c, ast.Constant)
+                              and isinstance(c.value, str)
+                              for c in [n.left] + list(n.comparators))
+                for child in [n.left] + list(n.comparators):
+                    walk(child, safe or ops_safe or str_cmp)
+                return
+            if isinstance(n, ast.Call):
+                fqp = _dotted(n.func)
+                if fqp and fqp[-1] in ("isinstance", "len", "hasattr",
+                                       "getattr", "callable"):
+                    return          # static introspection
+                walk(n.func, safe)
+                for a in list(n.args) + [k.value for k in n.keywords]:
+                    walk(a, safe)
+                return
+            if isinstance(n, ast.Attribute):
+                if n.attr in _STATIC_ATTRS:
+                    return          # .shape / .ndim / .dtype are static
+                walk(n.value, safe)
+                return
+            if isinstance(n, ast.Name):
+                if not safe and traced.get(n.id, False):
+                    offenders.add(n.id)
+                return
+            for child in ast.iter_child_nodes(n):
+                walk(child, safe)
+
+        walk(test, False)
+        return offenders
+
+    # -- JC005 --------------------------------------------------------------
+    def _donating(self) -> dict[str, FuncInfo]:
+        out: dict[str, FuncInfo] = {}
+        for mod in self.modules.values():
+            for f in mod.funcs:
+                if f.donate_positions or f.donate_names:
+                    out[f.fq] = f
+        return out
+
+    def _check_jc005(self) -> None:
+        donating = self._donating()
+        if not donating:
+            return
+        for mod in self.modules.values():
+            for caller in mod.funcs:
+                self._check_jc005_in(mod, caller, donating)
+
+    def _check_jc005_in(self, mod: ModuleInfo, caller: FuncInfo,
+                        donating: dict[str, FuncInfo]) -> None:
+        node = caller.node
+        if isinstance(node, ast.Lambda):
+            return
+        # statements in document order, with spans
+        stmts = [n for n in ast.walk(node) if isinstance(n, ast.stmt)]
+        for call, scope in caller.calls:
+            if scope is not caller:
+                continue
+            fq = self._call_fq(mod, call, caller)
+            target = donating.get(fq or "")
+            if target is None:
+                continue
+            donated: list[str] = []
+            for pos in target.donate_positions:
+                if pos < len(call.args) and isinstance(call.args[pos],
+                                                       ast.Name):
+                    donated.append(call.args[pos].id)
+            for kw in call.keywords:
+                if kw.arg in target.donate_names \
+                        and isinstance(kw.value, ast.Name):
+                    donated.append(kw.value.id)
+            if not donated:
+                continue
+            # enclosing statement + rebinding targets
+            enclosing = None
+            for s in stmts:
+                if (s.lineno <= call.lineno
+                        and (s.end_lineno or s.lineno) >= call.lineno):
+                    if enclosing is None or s.lineno >= enclosing.lineno:
+                        enclosing = s
+            rebound: set[str] = set()
+            if isinstance(enclosing, ast.Assign):
+                for t in enclosing.targets:
+                    for el in ast.walk(t):
+                        if isinstance(el, ast.Name):
+                            rebound.add(el.id)
+            end = (enclosing.end_lineno if enclosing is not None
+                   else call.end_lineno) or call.lineno
+            for name in donated:
+                if name in rebound:
+                    continue
+                for later in ast.walk(node):
+                    if (isinstance(later, ast.Name) and later.id == name
+                            and isinstance(later.ctx, ast.Load)
+                            and later.lineno > end):
+                        self._emit(
+                            mod, later, "JC005",
+                            f"`{name}` was donated to "
+                            f"{fq.split('.')[-1]}() at line "
+                            f"{call.lineno} and read again — the buffer "
+                            "may be aliased into the output; rebind the "
+                            "result (x = f(x, ...)) or copy first")
+                        break
+
+    # -- default_factory JC003 ----------------------------------------------
+    def _check_factories(self) -> None:
+        for mod in self.modules.values():
+            for lam in mod.factories:
+                for n in ast.walk(lam):
+                    if isinstance(n, ast.Call):
+                        fq = self._call_fq(mod, n, None)
+                        if fq in _ARRAY_CTORS:
+                            self._check_jc003(mod, n, fq)
+
+    # -- pytree constructors: JC003 only ------------------------------------
+    def _check_pytree_ctors(self, compiled: set[int]) -> None:
+        """Host functions constructing flax-struct pytrees feed their leaf
+        dtypes straight into the jit cache — dtype-less creation there is
+        the caller-dependent-aval drift JC003 exists for (the
+        `init_state(q0)` class of site)."""
+        class_names = set()
+        for mod in self.modules.values():
+            class_names |= mod.pytree_classes
+        if not class_names:
+            return
+        for mod in self.modules.values():
+            for info in mod.funcs:
+                if id(info) in compiled:
+                    continue        # already fully checked
+                ctor = any(
+                    (parts := _dotted(call.func)) is not None
+                    and parts[-1] in class_names
+                    for call, scope in info.calls if scope is info)
+                if not ctor:
+                    continue
+                for call, scope in info.calls:
+                    if scope is not info:
+                        continue
+                    fq = self._call_fq(mod, call, info)
+                    if fq in _ARRAY_CTORS:
+                        self._check_jc003(mod, call, fq)
+
+    # -- driver -------------------------------------------------------------
+    def run(self) -> list[Violation]:
+        compiled = self._compiled_set()
+        for mod in self.modules.values():
+            for info in mod.funcs + mod.lambdas:
+                if id(info) in compiled:
+                    self._check_compiled_body(info)
+        self._check_pytree_ctors(compiled)
+        self._check_factories()
+        self._check_jc005()
+        self.violations = sorted(set(self.violations),
+                                 key=lambda v: (v.path, v.line, v.rule))
+        return self.violations
+
+
+def lint_paths(paths: list[str | Path]) -> list[Violation]:
+    """Lint files/directories; returns sorted violations."""
+    linter = Linter()
+    linter.load([Path(p) for p in paths])
+    return linter.run()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="jaxcheck: JAX-specific AST lint (JC001-JC005)")
+    ap.add_argument("paths", nargs="*",
+                    default=[str(Path(__file__).resolve().parents[1])],
+                    help="files or directories (default: aclswarm_tpu/)")
+    args = ap.parse_args(argv)
+    violations = lint_paths(args.paths)
+    for v in violations:
+        print(v)
+    n = len(violations)
+    print(f"jaxcheck: {n} violation{'s' if n != 1 else ''} "
+          f"in {len(args.paths)} path(s)")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,435 @@
+"""`jaxcheck` layer 2: trace-time compile/transfer audit of every public
+jitted entry point.
+
+The AST lint (layer 1) reasons about source; this layer checks the
+*traced program*. Every entry in `ENTRY_POINTS` is abstract-traced via
+`jax.eval_shape` and then executed twice with freshly built,
+device-committed inputs, all under ``jax.transfer_guard("disallow")``,
+asserting:
+
+(a) **no implicit host transfers** — the trace and both executions
+    complete under the guard (a `np.asarray` on a traced value, a
+    `float()` sync, or an un-committed numpy constant sneaking into the
+    call all raise);
+(b) **cache stability** — the second identical call compiles nothing
+    (`_cache_size() == 1` on a private jit wrapper): weak-dtype drift,
+    aval-dependent python branching, or non-hashable statics would all
+    show up as a second cache entry — the silent-recompile class that
+    turns the 182x on-device win back into host-bound mush;
+(c) **no f64 leaves** in any output aval (audited in f32 mode: the
+    deployment precision; f64 anywhere means a dtype-less construction
+    upcast something and doubled the HBM/ICI bill).
+
+Audits run inside `f32_mode()` regardless of the suite's x64 default
+(tier-1 enables x64 for the golden f64 parity tests; the audit checks
+the deployment-precision program).
+
+Registering a new jitted entry point (see docs/STATIC_ANALYSIS.md):
+
+    from aclswarm_tpu.analysis import trace_audit
+
+    def _build_my_entry(gp):         # gp: GridPoint
+        args = (...)                 # freshly built arrays, f32-explicit
+        statics = {"cfg": ...}       # static_argnames -> values
+        return args, statics
+
+    trace_audit.register_entry(
+        "mymod.my_fn", my_fn, static_argnames=("cfg",),
+        build=_build_my_entry)
+
+The builder must return *fresh* arrays each call (entries with donated
+arguments are executed twice) and every grid point it supports; raise
+`Skip` for unsupported combinations.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from functools import partial
+from typing import Callable, Iterable
+
+import numpy as np
+
+__all__ = [
+    "GridPoint", "AuditReport", "Skip", "ENTRY_POINTS", "register_entry",
+    "audit_entry", "audit_all", "iter_grid", "f32_mode",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class GridPoint:
+    """One cell of the audit grid."""
+
+    n: int = 5            # fleet size
+    B: int = 2            # trial-batch width (batched entries)
+    solver: str = "auction"       # 'auction' | 'sinkhorn' | 'cbaa'
+    faults: bool = False          # attach a FaultSchedule
+    localization: str = "truth"   # 'truth' | 'flooded'
+
+
+class Skip(Exception):
+    """Raised by a builder for an unsupported grid combination."""
+
+
+@dataclasses.dataclass
+class EntryPoint:
+    name: str
+    fn: Callable
+    static_argnames: tuple
+    build: Callable[[GridPoint], tuple]
+    # which grid axes this entry actually varies over (grid dedup)
+    axes: tuple = ("n",)
+
+
+@dataclasses.dataclass
+class AuditReport:
+    name: str
+    grid: GridPoint
+    n_compiles: int
+    out_dtypes: tuple
+    f64_leaves: tuple          # offending output dtypes, must be empty
+    recompiled: bool           # second identical call compiled again
+
+    @property
+    def ok(self) -> bool:
+        return not self.f64_leaves and not self.recompiled
+
+
+ENTRY_POINTS: list[EntryPoint] = []
+
+
+def register_entry(name: str, fn: Callable, *, build: Callable,
+                   static_argnames: tuple = (),
+                   axes: tuple = ("n",)) -> None:
+    ENTRY_POINTS.append(EntryPoint(name=name, fn=fn,
+                                   static_argnames=tuple(static_argnames),
+                                   build=build, axes=tuple(axes)))
+
+
+@contextlib.contextmanager
+def f32_mode():
+    """Run the audit at deployment precision regardless of the suite's
+    x64 default (new traces only — existing arrays are untouched)."""
+    import jax
+    old = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", False)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_x64", old)
+
+
+# ---------------------------------------------------------------------------
+# input builders (fresh, f32-explicit, device-committed by the auditor)
+
+def _ring(n: int) -> np.ndarray:
+    ang = np.linspace(0, 2 * np.pi, n, endpoint=False)
+    return np.stack([3.0 * np.cos(ang), 3.0 * np.sin(ang),
+                     np.full(n, 2.0)], 1).astype(np.float32)
+
+
+def _scatter(n: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    q = rng.normal(size=(n, 3)).astype(np.float32) * 2.0
+    q[:, 2] = 2.0
+    return q
+
+
+def _formation(n: int):
+    from aclswarm_tpu.core.types import make_formation
+    adj = (np.ones((n, n)) - np.eye(n)).astype(np.float32)
+    gains = (np.eye(n, dtype=np.float32)[:, :, None, None]
+             * np.eye(3, dtype=np.float32)[None, None] * 0.01)
+    return make_formation(_ring(n), adj, gains)
+
+
+def _sparams():
+    import jax.numpy as jnp
+
+    from aclswarm_tpu.core.types import SafetyParams
+    return SafetyParams(
+        bounds_min=jnp.asarray([-50.0, -50.0, 0.0], jnp.float32),
+        bounds_max=jnp.asarray([50.0, 50.0, 10.0], jnp.float32))
+
+
+def _sim_cfg(gp: GridPoint):
+    from aclswarm_tpu import sim
+    return sim.SimConfig(assignment=gp.solver, assign_every=2,
+                         localization=gp.localization, flood_every=2,
+                         flight_fsm=False)
+
+
+def _faults(gp: GridPoint, seed: int = 0):
+    if not gp.faults:
+        return None
+    from aclswarm_tpu.faults import schedule as faultlib
+    return faultlib.sample_schedule(
+        seed, gp.n, dropout_frac=0.25, drop_tick=1, rejoin_tick=3,
+        link_loss=0.1)
+
+
+def _sim_state(gp: GridPoint, seed: int = 0):
+    from aclswarm_tpu import sim
+    return sim.init_state(_scatter(gp.n, seed),
+                          localization=(gp.localization == "flooded"),
+                          faults=_faults(gp, seed))
+
+
+_TICKS = 4
+
+
+def _build_rollout(gp: GridPoint):
+    from aclswarm_tpu.core.types import ControlGains
+    args = (_sim_state(gp), _formation(gp.n), ControlGains(), _sparams())
+    return args, {"cfg": _sim_cfg(gp), "n_ticks": _TICKS}
+
+
+def _build_batched_rollout(gp: GridPoint):
+    import jax
+    import jax.numpy as jnp
+
+    from aclswarm_tpu.core.types import ControlGains
+    states = [_sim_state(gp, seed=b) for b in range(gp.B)]
+    forms = [_formation(gp.n) for _ in range(gp.B)]
+    stack = lambda *xs: jnp.stack(xs)                      # noqa: E731
+    state = jax.tree.map(stack, *states)
+    form = jax.tree.map(stack, *forms)
+    args = (state, form, ControlGains(), _sparams())
+    return args, {"cfg": _sim_cfg(gp), "n_ticks": _TICKS}
+
+
+def _build_rollout_summary(gp: GridPoint):
+    import jax.numpy as jnp
+
+    from aclswarm_tpu.sim import summary
+    args, statics = _build_batched_rollout(gp)
+    carry = summary.init_carry(gp.n, window=3, dtype=jnp.float32,
+                               batch=gp.B)
+    statics.update(window=3, pose_every=0)
+    # takeoff_alt is keyword-only and traced: it rides in the kwargs dict
+    # as a committed scalar (a bare python float would be an implicit
+    # transfer under the guard)
+    statics["takeoff_alt"] = jnp.asarray(1.0, jnp.float32)
+    return ((args[0], carry) + args[1:]), statics
+
+
+def _aligned_pair(gp: GridPoint):
+    q = _scatter(gp.n)
+    rng = np.random.default_rng(1)
+    return q, _ring(gp.n)[rng.permutation(gp.n)]
+
+
+def _build_auction(gp: GridPoint):
+    q, p = _aligned_pair(gp)
+    c = np.linalg.norm(q[:, None] - p[None], axis=-1).astype(np.float32)
+    return (-c,), {}
+
+
+def _build_sinkhorn(gp: GridPoint):
+    q, p = _aligned_pair(gp)
+    return (q, p), {}
+
+
+def _build_cbaa(gp: GridPoint):
+    import jax.numpy as jnp
+    q, p = _aligned_pair(gp)
+    adj = (np.ones((gp.n, gp.n)) - np.eye(gp.n)).astype(np.float32)
+    v2f = jnp.arange(gp.n, dtype=jnp.int32)
+    return (q, p, adj, v2f), {}
+
+
+def _build_admm(gp: GridPoint):
+    # the host half of `gains.solve_gains`, made explicit: ring graph ->
+    # padded non-edge index arrays (the traced inputs of `_solve_jit`)
+    from aclswarm_tpu.gains.admm import AdmmParams
+    n = gp.n
+    adj = np.zeros((n, n), bool)
+    for k in (1, 2):        # ring + chords: rigid enough, non-edges exist
+        adj |= np.eye(n, k=k, dtype=bool) | np.eye(n, k=-k, dtype=bool)
+        adj |= np.eye(n, k=n - k, dtype=bool) | np.eye(n, k=k - n,
+                                                       dtype=bool)
+    iu, ju = np.triu_indices(n, k=1)
+    off = ~adj[iu, ju]
+    i_idx = iu[off].astype(np.int32)
+    j_idx = ju[off].astype(np.int32)
+    if i_idx.size == 0:
+        i_idx = j_idx = np.zeros(1, np.int32)
+        valid = np.zeros(1, bool)
+    else:
+        valid = np.ones(i_idx.shape[0], bool)
+    adjmask = adj | np.eye(n, dtype=bool)
+    args = (_ring(n), i_idx, j_idx, valid, adjmask)
+    return args, {"planar": False, "params": AdmmParams()}
+
+
+def _build_planner_tick(gp: GridPoint):
+    import jax.numpy as jnp
+
+    from aclswarm_tpu import sim
+    from aclswarm_tpu.core.types import ControlGains, SwarmState
+    if gp.solver == "sinkhorn":
+        raise Skip("planner tick serves auction/cbaa (its wire modes)")
+    swarm_q = jnp.asarray(_scatter(gp.n), jnp.float32)
+    swarm = SwarmState(q=swarm_q, vel=jnp.zeros_like(swarm_q))
+    v2f = jnp.arange(gp.n, dtype=jnp.int32)
+    cfg = sim.SimConfig(assignment=gp.solver, assign_every=2)
+    args = (swarm, _formation(gp.n), v2f, ControlGains(), _sparams(),
+            jnp.asarray(True), jnp.asarray(True))
+    kwargs = {"cfg": cfg}
+    if gp.localization == "flooded":
+        # `est` sits after `cfg` in the signature: pass it by keyword
+        kwargs["est"] = jnp.broadcast_to(swarm_q[None],
+                                         (gp.n, gp.n, 3)).copy()
+    return args, kwargs
+
+
+def _install_default_registry() -> None:
+    """Every public jitted entry point of the compiled surface."""
+    from aclswarm_tpu.assignment import auction, cbaa, sinkhorn
+    from aclswarm_tpu.gains import admm
+    from aclswarm_tpu.interop import planner
+    from aclswarm_tpu.sim import engine, summary
+
+    register_entry("sim.engine.rollout", engine.rollout,
+                   static_argnames=("n_ticks", "cfg"),
+                   build=_build_rollout,
+                   axes=("n", "solver", "faults", "localization"))
+    register_entry("sim.engine.batched_rollout", engine.batched_rollout,
+                   static_argnames=("n_ticks", "cfg"),
+                   build=_build_batched_rollout,
+                   axes=("n", "B", "solver", "faults", "localization"))
+    register_entry("sim.summary.batched_rollout_summary",
+                   summary.batched_rollout_summary,
+                   static_argnames=("cfg", "n_ticks", "window",
+                                    "pose_every"),
+                   build=_build_rollout_summary,
+                   axes=("n", "B", "solver", "faults", "localization"))
+    register_entry("assignment.auction.auction_lap", auction.auction_lap,
+                   build=_build_auction)
+    register_entry("assignment.sinkhorn.sinkhorn_assign",
+                   sinkhorn.sinkhorn_assign, build=_build_sinkhorn)
+    register_entry("assignment.cbaa.cbaa_from_state", cbaa.cbaa_from_state,
+                   build=_build_cbaa)
+    register_entry("gains.admm.solve", admm._solve_jit,
+                   static_argnames=("planar", "params"), build=_build_admm)
+    register_entry("interop.planner.tick", planner._tick,
+                   static_argnames=("cfg",), build=_build_planner_tick,
+                   axes=("n", "solver", "localization"))
+
+
+_install_default_registry()
+
+
+# ---------------------------------------------------------------------------
+# the audit
+
+def _commit(tree):
+    """Device-commit every leaf (incl. python scalars) so the guarded
+    call sees zero implicit host-to-device transfers."""
+    import jax
+    return jax.tree.map(
+        lambda x: None if x is None else jax.device_put(x), tree,
+        is_leaf=lambda x: x is None)
+
+
+def _shape_only(tree):
+    import jax
+    return jax.tree.map(
+        lambda x: None if x is None
+        else jax.ShapeDtypeStruct(x.shape, x.dtype), tree,
+        is_leaf=lambda x: x is None)
+
+
+_BAD_DTYPES = ("float64", "complex128", "int64")
+
+
+def audit_entry(entry: EntryPoint, gp: GridPoint) -> AuditReport:
+    """Run checks (a)-(c) for one entry at one grid point.
+
+    Raises on guard/trace failures (check (a)); returns a report whose
+    ``.ok`` captures (b) and (c).
+    """
+    import jax
+
+    with f32_mode():
+        fn = getattr(entry.fn, "__wrapped__", entry.fn)
+        # a fresh `partial` gives the jit wrapper a private tracing cache
+        # (jax keys its cache on the callable's identity, so wrapping the
+        # bare fn twice would accumulate entries across audits)
+        wrapper = jax.jit(partial(fn),
+                          static_argnames=entry.static_argnames)
+
+        # inputs are built and committed OUTSIDE the guard: only the
+        # entry point itself must be transfer-free
+        args, statics = entry.build(gp)
+        args = _commit(args)
+        args2 = _commit(entry.build(gp)[0])   # fresh (donation-safe)
+        call = partial(wrapper, **statics)
+
+        with jax.transfer_guard("disallow"):
+            # (a) + (c): abstract trace — implicit transfers and traced
+            # host syncs raise here; output avals carry the dtypes
+            out = jax.eval_shape(call, *_shape_only(args))
+            leaves = [x for x in jax.tree.leaves(out) if x is not None]
+            dtypes = tuple(str(x.dtype) for x in leaves)
+            f64 = tuple(d for d in dtypes if d in _BAD_DTYPES)
+
+            # (b): two real calls with identical (fresh) avals must
+            # compile exactly once — a second entry is the silent
+            # recompile class (weak-type drift, unstable statics)
+            call(*args)
+            call(*args2)
+        compiles = wrapper._cache_size()
+
+    return AuditReport(name=entry.name, grid=gp, n_compiles=compiles,
+                       out_dtypes=dtypes, f64_leaves=f64,
+                       recompiled=compiles != 1)
+
+
+def iter_grid(slow: bool = False) -> Iterable[GridPoint]:
+    """Tier-1 keeps the grid small (n=5, B=2: one fault-free truth-model
+    point per solver plus the faulted/flooded stack); ``slow=True``
+    crosses the axes at n=16/B=4 as well."""
+    yield GridPoint(n=5, B=2, solver="auction")
+    yield GridPoint(n=5, B=2, solver="sinkhorn", faults=True)
+    yield GridPoint(n=5, B=2, solver="cbaa", faults=True,
+                    localization="flooded")
+    if slow:
+        for solver in ("auction", "sinkhorn", "cbaa"):
+            for faults in (False, True):
+                for loc in ("truth", "flooded"):
+                    yield GridPoint(n=16, B=4, solver=solver,
+                                    faults=faults, localization=loc)
+
+
+def audit_all(slow: bool = False) -> list[AuditReport]:
+    """Audit every registered entry across the grid (deduplicating grid
+    points an entry does not vary over)."""
+    reports: list[AuditReport] = []
+    for entry in ENTRY_POINTS:
+        seen = set()
+        for gp in iter_grid(slow):
+            key = tuple(getattr(gp, a) for a in entry.axes)
+            if key in seen:
+                continue
+            seen.add(key)
+            try:
+                reports.append(audit_entry(entry, gp))
+            except Skip:
+                continue
+    return reports
+
+
+def main() -> int:
+    ok = True
+    for r in audit_all():
+        status = "ok" if r.ok else "FAIL"
+        print(f"{status:4s} {r.name} {r.grid} compiles={r.n_compiles} "
+              f"f64={list(r.f64_leaves)}")
+        ok &= r.ok
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -125,7 +125,7 @@ def round_parallel(plan_log: jnp.ndarray,
 
     assign0 = jnp.full((n,), -1, jnp.int32)
     assign, _, _ = jax.lax.while_loop(
-        cond, body, (assign0, plan_log, jnp.asarray(0)))
+        cond, body, (assign0, plan_log, jnp.asarray(0, jnp.int32)))
     # termination: the globally-best remaining claim always wins its column,
     # so every round permanently assigns >= 1 agent; with max_rounds = n the
     # result is always a complete, valid permutation
@@ -166,7 +166,7 @@ def round_dominant(plan_log: jnp.ndarray,
 
     assign0 = jnp.full((n,), -1, jnp.int32)
     assign, _, _ = jax.lax.while_loop(
-        cond, body, (assign0, plan_log, jnp.asarray(0)))
+        cond, body, (assign0, plan_log, jnp.asarray(0, jnp.int32)))
     return assign
 
 
@@ -200,7 +200,7 @@ def two_opt_refine(cost: jnp.ndarray, v2f: jnp.ndarray,
         return (~done) & (it < sweeps)
 
     v2f, _, _ = jax.lax.while_loop(
-        cond, body, (v2f, jnp.asarray(0), jnp.asarray(False)))
+        cond, body, (v2f, jnp.asarray(0, jnp.int32), jnp.asarray(False)))
     return v2f
 
 

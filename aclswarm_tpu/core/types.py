@@ -16,6 +16,26 @@ import jax.numpy as jnp
 from flax import struct
 
 
+def canonical_float(x) -> jnp.dtype:
+    """Strong float dtype for host data entering the compiled surface.
+
+    Floating inputs keep their dtype; everything else (Python lists,
+    scalars, int arrays) gets the canonical float (float32, or float64
+    under ``jax_enable_x64``). Every pytree-construction boundary uses
+    this so identical calls produce identical avals — a dtype-less
+    ``jnp.asarray`` inherits whatever the caller happened to pass (or a
+    weak type, for scalars) and silently retraces the jit cache
+    (jaxcheck JC003, docs/STATIC_ANALYSIS.md).
+    """
+    dt = getattr(x, "dtype", None)
+    if dt is not None and jnp.issubdtype(dt, jnp.floating):
+        # result_type canonicalizes to the enabled precision (an f64
+        # numpy input with x64 off becomes f32 silently — the same
+        # truncation a dtype-less asarray always did, minus the warning)
+        return jnp.result_type(dt)
+    return jnp.result_type(float)
+
+
 @struct.dataclass
 class SwarmState:
     """Batched swarm state, vehicle order.
@@ -80,9 +100,11 @@ class SafetyParams:
     """
 
     bounds_min: jnp.ndarray = struct.field(
-        default_factory=lambda: jnp.array([0.0, 0.0, 0.0]))
+        default_factory=lambda: jnp.array([0.0, 0.0, 0.0],
+                                          jnp.result_type(float)))
     bounds_max: jnp.ndarray = struct.field(
-        default_factory=lambda: jnp.array([1.0, 1.0, 1.0]))
+        default_factory=lambda: jnp.array([1.0, 1.0, 1.0],
+                                          jnp.result_type(float)))
     spinup_time: float = 2.0
     # NOTE: the control tick period lives on `sim.SimConfig.control_dt`
     # (single source of truth); the reference's safety node has its own
@@ -143,13 +165,13 @@ def make_formation(points, adjmat, gains=None) -> Formation:
     """
     from aclswarm_tpu.core import geometry
 
-    points = jnp.asarray(points)
-    adjmat = jnp.asarray(adjmat)
+    points = jnp.asarray(points, canonical_float(points))
+    adjmat = jnp.asarray(adjmat, canonical_float(adjmat))
     n = points.shape[0]
     if gains is None:
         gains = jnp.zeros((n, n, 3, 3), dtype=points.dtype)
     else:
-        gains = jnp.asarray(gains)
+        gains = jnp.asarray(gains, canonical_float(gains))
         if gains.ndim == 2:
             gains = gains_from_flat(gains)
     return Formation(

@@ -110,10 +110,10 @@ def sample_schedule(seed: int, n: int, *, dropout_frac: float = 0.0,
     # uint32 leaves keep the schedule a plain stackable pytree
     kd = np.array([(seed >> 32) & 0xFFFFFFFF, seed & 0xFFFFFFFF], np.uint32)
     return FaultSchedule(
-        drop_tick=jnp.asarray(drops),
-        rejoin_tick=jnp.asarray(rejoins),
+        drop_tick=jnp.asarray(drops, jnp.int32),
+        rejoin_tick=jnp.asarray(rejoins, jnp.int32),
         link_loss=jnp.asarray(loss, dtype),
-        key=jnp.asarray(kd))
+        key=jnp.asarray(kd, jnp.uint32))
 
 
 def alive_at(sched: FaultSchedule, tick) -> jnp.ndarray:

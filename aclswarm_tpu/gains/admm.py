@@ -137,7 +137,7 @@ def _constraint_system(Q: jnp.ndarray, i_idx: jnp.ndarray,
             + jnp.matmul(U, W.T, precision=hp) * jnp.matmul(W, U.T, precision=hp))
     # trace constraint (<I, B> = dm) appended last; <H_k, I> = u_k . w_k
     g = jnp.sum(U * W, axis=1)
-    G = jnp.block([[G, g[:, None]], [g[None, :], jnp.full((1, 1), float(dm), dtype)]])
+    G = jnp.block([[G, g[:, None]], [g[None, :], jnp.full((1, 1), dm, dtype)]])
     # padded slots get a unit diagonal so the system stays well-posed
     pad = jnp.concatenate([1.0 - vmask, jnp.zeros((1,), dtype)])
     G = G + jnp.diag(pad)
@@ -168,7 +168,7 @@ def _subproblem(Q: jnp.ndarray, i_idx: jnp.ndarray, j_idx: jnp.ndarray,
     Cfun, Ct, Ginv_apply = _constraint_system(Q, i_idx, j_idx, valid, d)
     c = jnp.zeros((2 * i_idx.shape[0] if d == 2 else i_idx.shape[0],),
                   dtype)
-    c = jnp.concatenate([c, jnp.full((1,), float(dm), dtype)])
+    c = jnp.concatenate([c, jnp.full((1,), dm, dtype)])
 
     def P_V(B):
         """Project onto {structured symmetric} ∩ {<H_k, .> = 0}."""
@@ -295,7 +295,7 @@ def _subproblem(Q: jnp.ndarray, i_idx: jnp.ndarray, j_idx: jnp.ndarray,
                 return Znew, it + 1, num / den < params.newton_tol
 
             Z, _, _ = lax.while_loop(
-                cond, abody, (Z, jnp.asarray(0), jnp.asarray(False)))
+                cond, abody, (Z, jnp.asarray(0, jnp.int32), jnp.asarray(False)))
         else:
             def body(Z, _):
                 return 1.5 * Z - 0.5 * jnp.matmul(
@@ -326,7 +326,7 @@ def _subproblem(Q: jnp.ndarray, i_idx: jnp.ndarray, j_idx: jnp.ndarray,
         return Xnew, Snew, it + 1, stop
 
     X, S, _, _ = lax.while_loop(cond, body,
-                                (X0, S0, jnp.asarray(0), jnp.asarray(False)))
+                                (X0, S0, jnp.asarray(0, jnp.int32), jnp.asarray(False)))
 
     # final projection with S = 0 (`solver.cpp:333-346`)
     W = W_of(C - mu * X)

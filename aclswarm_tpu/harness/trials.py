@@ -216,8 +216,10 @@ def _trial_sparams(cfg: TrialConfig) -> SafetyParams:
     import jax.numpy as jnp
 
     return SafetyParams(
-        bounds_min=jnp.asarray([-cfg.room_x, -cfg.room_y, 0.0]),
-        bounds_max=jnp.asarray([cfg.room_x, cfg.room_y, cfg.room_z]),
+        bounds_min=jnp.asarray([-cfg.room_x, -cfg.room_y, 0.0],
+                               jnp.result_type(float)),
+        bounds_max=jnp.asarray([cfg.room_x, cfg.room_y, cfg.room_z],
+                               jnp.result_type(float)),
         **_trial_overrides(cfg, "max_vel_xy", "max_vel_z", "max_accel_xy",
                            "max_accel_z", "keepout_repulse_vel",
                            "colavoid_dz_ignore"))
@@ -320,7 +322,7 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
             cmd[0] = sim.vehicle.CMD_GO
             pending_go = False
         inputs = sim.ExternalInputs(
-            cmd=jnp.asarray(cmd),
+            cmd=jnp.asarray(cmd, jnp.int32),
             joy_vel=jnp.zeros((chunk, n, 3), state.swarm.q.dtype),
             joy_yawrate=jnp.zeros((chunk, n), state.swarm.q.dtype),
             joy_active=jnp.zeros((chunk, n), bool))
@@ -509,7 +511,7 @@ def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
                 new_b *= 2
             fillers = [i for i, f in enumerate(fsms) if f.done]
             keep = sorted(live + fillers[:new_b - len(live)])
-            idx = jnp.asarray(keep)
+            idx = jnp.asarray(keep, jnp.int32)
             bstate = jax.tree.map(lambda x: x[idx], bstate)
             bform = jax.tree.map(lambda x: x[idx], bform)
             scarry = jax.tree.map(lambda x: x[idx], scarry)
@@ -528,7 +530,8 @@ def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
             if pending_go[b]:
                 cmd[0, b] = sim.vehicle.CMD_GO
                 pending_go[b] = False
-        inputs = sim.ExternalInputs(cmd=jnp.asarray(cmd), joy_vel=joy_vel,
+        inputs = sim.ExternalInputs(cmd=jnp.asarray(cmd, jnp.int32),
+                                    joy_vel=joy_vel,
                                     joy_yawrate=joy_yawrate,
                                     joy_active=joy_active)
         bstate, scarry, summ = sumlib.batched_rollout_summary(

@@ -28,7 +28,8 @@ import numpy as np
 from aclswarm_tpu import control
 from aclswarm_tpu.core import perm as permutil
 from aclswarm_tpu.core.types import (ControlGains, Formation as DevFormation,
-                                     SafetyParams, SwarmState, make_formation)
+                                     SafetyParams, SwarmState,
+                                     canonical_float, make_formation)
 from aclswarm_tpu.interop import messages as m
 from aclswarm_tpu.sim import engine
 
@@ -243,9 +244,13 @@ class TpuPlanner:
              else np.asarray(estimates))
         if q.shape != (self.n, 3):
             raise ValueError(f"estimates shape {q.shape} != {(self.n, 3)}")
-        v = jnp.zeros((self.n, 3), jnp.asarray(q).dtype) if vel is None \
-            else jnp.asarray(vel)
-        swarm = SwarmState(q=jnp.asarray(q), vel=v)
+        # strong dtypes at the wire boundary: the jit cache keys on avals,
+        # so a caller alternating list / f64 / f32 feeds must not retrace
+        # `_tick` every call (jaxcheck JC003)
+        qdt = canonical_float(q)
+        v = jnp.zeros((self.n, 3), qdt) if vel is None \
+            else jnp.asarray(vel, canonical_float(vel))
+        swarm = SwarmState(q=jnp.asarray(q, qdt), vel=v)
         do_assign = (self._ticks_since_commit % self.cfg.assign_every) == 0
         adopted_central = False
         if self.central_assignment:
@@ -258,14 +263,16 @@ class TpuPlanner:
                 self._central_rcvd = False
                 adopted_central = True
             do_assign = False
-        est_j = None if est is None else jnp.asarray(est)
+        est_j = None if est is None \
+            else jnp.asarray(est, canonical_float(est))
         if est_j is not None and est_j.shape != (self.n, self.n, 3):
             raise ValueError(f"est shape {est_j.shape} != "
                              f"{(self.n, self.n, 3)}")
         u, new_v2f, valid, ca = _tick(swarm, self.formation, self.v2f,
                                       self.cgains, self.sparams,
-                                      jnp.asarray(do_assign),
-                                      jnp.asarray(self._await_first_accept),
+                                      jnp.asarray(do_assign, bool),
+                                      jnp.asarray(self._await_first_accept,
+                                                  bool),
                                       self.cfg, est=est_j)
         self._ticks_since_commit += 1
         # an adoption is published unconditionally (`newAssignmentCb`,

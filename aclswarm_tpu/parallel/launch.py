@@ -31,6 +31,8 @@ import argparse
 import json
 import sys
 
+from aclswarm_tpu.utils import timing  # no backend touch at import time
+
 
 def _put_global(tree, shardings):
     """Materialize a host-replicated pytree as global sharded arrays.
@@ -74,8 +76,8 @@ def run(n: int, ticks: int, seed: int = 0) -> dict:
     gains = rng.normal(size=(n, n, 3, 3)) * 0.01
     formation = make_formation(points, adj, gains)
     sparams = SafetyParams(
-        bounds_min=jnp.asarray([-500.0, -500.0, 0.0]),
-        bounds_max=jnp.asarray([500.0, 500.0, 10.0]))
+        bounds_min=jnp.asarray([-500.0, -500.0, 0.0], jnp.float32),
+        bounds_max=jnp.asarray([500.0, 500.0, 10.0], jnp.float32))
     block = max(1, min(64, n // 2))
     cfg = sim.SimConfig(assignment="cbaa", assign_every=max(1, ticks // 2),
                         localization="flooded", flood_block=block,
@@ -96,7 +98,10 @@ def run(n: int, ticks: int, seed: int = 0) -> dict:
             state = step(state)
         digest = jax.jit(lambda s: s.swarm.q.sum(),
                          out_shardings=rep)(state)
-        digest = float(jax.block_until_ready(digest))
+        # completion barrier through the remote-device tunnel: one
+        # documented idiom (`utils.timing.readback_sync`) — a bare
+        # `block_until_ready` may return at dispatch-acknowledge there
+        digest = timing.readback_sync(digest)
     return {"process": jax.process_index(),
             "processes": jax.process_count(),
             "global_devices": ndev,

@@ -42,7 +42,7 @@ from aclswarm_tpu.assignment import auction, cbaa, sinkhorn
 from aclswarm_tpu.core import geometry
 from aclswarm_tpu.core import perm as permutil
 from aclswarm_tpu.core.types import (ControlGains, Formation, SafetyParams,
-                                     SwarmState)
+                                     SwarmState, canonical_float)
 from aclswarm_tpu.faults import masking as faultmask
 from aclswarm_tpu.faults import schedule as faultlib
 from aclswarm_tpu.faults.schedule import FaultSchedule
@@ -181,7 +181,10 @@ def init_state(q0, v2f0=None, flying: bool = True,
     rollout runs with ``cfg.localization='flooded'``).
     ``faults`` attaches a fault script (`aclswarm_tpu.faults`); None keeps
     the fault-free engine."""
-    q0 = jnp.asarray(q0)
+    # explicit strong dtype: a dtype-less asarray would inherit whatever
+    # the caller passed (list vs np array vs f32 array), and every distinct
+    # aval retraces the whole rollout (jaxcheck JC003)
+    q0 = jnp.asarray(q0, canonical_float(q0))
     n = q0.shape[0]
     if v2f0 is None:
         v2f0 = permutil.identity(n)

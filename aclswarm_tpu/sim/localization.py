@@ -50,6 +50,7 @@ from flax import struct
 from jax import lax
 
 from aclswarm_tpu.core import perm as permutil
+from aclswarm_tpu.core.types import canonical_float
 
 # The merge packs (age, sender id) into one int32 — min over the packed
 # value finds the freshest sender AND breaks age ties to the lowest id in
@@ -78,7 +79,7 @@ class EstimateTable:
 def init_table(q0: jnp.ndarray) -> EstimateTable:
     """Every vehicle starts knowing the true initial positions (startup
     census; see module docstring for the divergence note)."""
-    q0 = jnp.asarray(q0)
+    q0 = jnp.asarray(q0, canonical_float(q0))  # strong dtype (JC003)
     n = q0.shape[0]
     return EstimateTable(est=jnp.broadcast_to(q0[None], (n, n, 3)).copy(),
                          age=jnp.zeros((n, n), jnp.int32))

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# jaxcheck layer 1 standalone: the JAX-specific AST lint (JC001-JC005).
+#
+#   scripts/lint.sh                 # lint aclswarm_tpu/ (the tier-1 bar)
+#   scripts/lint.sh path/to/file.py # lint specific files/dirs
+#
+# Exit 1 on any violation. Layer 2 (the trace audit) needs a backend:
+#   JAX_PLATFORMS=cpu python -m aclswarm_tpu.analysis.trace_audit
+# Rule catalog + escape hatch syntax: docs/STATIC_ANALYSIS.md.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m aclswarm_tpu.analysis.lint "$@"

@@ -45,6 +45,12 @@ def pytest_configure(config):
         "faults: fault-injection / elastic-swarm subsystem "
         "(aclswarm_tpu.faults; docs/FAULTS.md). Batch-scale sweeps "
         "(B >= 8) additionally carry `slow` so tier-1 stays on budget")
+    config.addinivalue_line(
+        "markers",
+        "analysis: jaxcheck static analysis — AST lint (JC001-JC005) + "
+        "trace-time compile/transfer audit of the jitted entry points "
+        "(aclswarm_tpu.analysis; docs/STATIC_ANALYSIS.md). The heavy "
+        "n=16/B=4 audit grid additionally carries `slow`")
 
 
 @pytest.fixture

@@ -1,0 +1,44 @@
+"""JC001 fixture: host syncs reachable from jit (every one must fire)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def direct_item(x):
+    return x.sum().item()                       # JC001 (.item)
+
+
+@jax.jit
+def direct_float(x):
+    return float(x[0]) * 2.0                    # JC001 (float)
+
+
+@jax.jit
+def np_pull(x):
+    return np.asarray(x) + 1                    # JC001 (np.asarray)
+
+
+def helper(x):
+    # not itself decorated — reachable from jitted `via_helper` below
+    return jax.device_get(x)                    # JC001 (device_get)
+
+
+@jax.jit
+def via_helper(x):
+    return helper(x * 2)
+
+
+def scan_body(c, x):
+    jax.block_until_ready(c)                    # JC001 (block_until_ready)
+    return c + x, None
+
+
+def host_driver(xs):
+    # scan body executes in a compiled context even without @jit
+    return jax.lax.scan(scan_body, jnp.float32(0.0), xs)
+
+
+def host_only(x):
+    # NOT reachable from any jit root: must NOT fire
+    return float(np.asarray(x).sum())

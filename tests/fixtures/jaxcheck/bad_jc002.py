@@ -1,0 +1,35 @@
+"""JC002 fixture: Python control flow on traced values."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branch_on_arg(x, threshold: float = 0.5):
+    if x > threshold:                           # JC002 (x traced)
+        return x * 2
+    return x
+
+
+@jax.jit
+def while_on_arg(x):
+    while x < 10.0:                             # JC002 (x traced)
+        x = x + 1.0
+    return x
+
+
+@jax.jit
+def ifexp_on_arg(q):
+    return q * 2 if q.sum() else q              # JC002 (q traced)
+
+
+@jax.jit
+def allowed_patterns(x, mask=None, n_iters: int = 5, mode: str = "fast"):
+    if mask is None:                            # ok: is-None dispatch
+        mask = jnp.ones_like(x)
+    if mode == "fast":                          # ok: string mode switch
+        x = x * 2
+    if n_iters > 3:                             # ok: static annotation
+        x = x + 1
+    if x.ndim == 2:                             # ok: shape introspection
+        x = x[0]
+    return x * mask

@@ -1,0 +1,35 @@
+"""JC003 fixture: dtype-less array creation (weak types -> recompiles)."""
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@jax.jit
+def weak_scalar(x):
+    return x + jnp.asarray(1.0)                 # JC003 (weak float scalar)
+
+
+@jax.jit
+def caller_dtype(q0):
+    return jnp.asarray(q0) * 2                  # JC003 (inherits caller)
+
+
+@jax.jit
+def weak_list(x):
+    return x + jnp.array([0.0, 0.0, 1.0])       # JC003 (literal list)
+
+
+@struct.dataclass
+class Carry:
+    flag: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.asarray(True))   # ok: bool not weak
+    level: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.asarray(0.0))    # JC003 (weak factory)
+
+
+@jax.jit
+def explicit_ok(x):
+    a = jnp.asarray(1.0, jnp.float32)           # ok: explicit dtype
+    b = jnp.array([1.0, 2.0], dtype=x.dtype)    # ok: dtype kwarg
+    c = jnp.asarray(x.sum() * 2)                # ok: traced expression
+    return a + b + c

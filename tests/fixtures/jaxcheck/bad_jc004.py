@@ -1,0 +1,30 @@
+"""JC004 fixture: host nondeterminism baked into compiled paths."""
+import random
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def stamped(x):
+    return x + time.time()                      # JC004 (time.time)
+
+
+@jax.jit
+def np_randomness(x):
+    return x + np.random.normal()               # JC004 (np.random)
+
+
+def vmapped_body(x):
+    return x * random.random()                  # JC004 (stdlib random)
+
+
+def host_driver(xs):
+    return jax.vmap(vmapped_body)(xs)
+
+
+def host_only_timing():
+    # NOT reachable from jit: benchmarks may time on the host freely
+    t0 = time.perf_counter()
+    return time.perf_counter() - t0

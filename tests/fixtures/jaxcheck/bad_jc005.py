@@ -1,0 +1,26 @@
+"""JC005 fixture: donated-argument read-after-donate."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def consume(state, delta):
+    return state + delta
+
+
+def bad_caller(state, delta):
+    out = consume(state, delta)
+    return out + state.sum()                    # JC005 (state donated above)
+
+
+def good_caller(state, delta):
+    state = consume(state, delta)               # ok: donate-and-rebind
+    return state + consume(state, delta)
+
+
+def good_chunked(state, deltas):
+    for d in deltas:
+        state = consume(state, d)               # ok: rebound every pass
+    return state

@@ -1,0 +1,21 @@
+"""Escape-hatch fixture: every violation here is explicitly disabled."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def intentional_sync(x):
+    return np.asarray(x)          # jaxcheck: disable=JC001
+
+
+@jax.jit
+def intentional_branch(x):
+    if x > 0:                     # jaxcheck: disable
+        return x
+    return -x
+
+
+@jax.jit
+def multi_rule(x):
+    import jax.numpy as jnp
+    return jnp.asarray(float(x))  # jaxcheck: disable=JC001,JC003

@@ -1,0 +1,171 @@
+"""jaxcheck tier-1: AST lint (layer 1) + trace audit (layer 2).
+
+Three claims, per docs/STATIC_ANALYSIS.md:
+- every rule JC001–JC005 FIRES on the known-bad fixtures
+  (`tests/fixtures/jaxcheck/`), and the escape hatch suppresses;
+- the linter reports ZERO violations on `aclswarm_tpu/` itself;
+- every registered jitted entry point traces with no implicit host
+  transfers, compiles nothing on a second identical call, and emits no
+  f64 output leaves (n=5/B=2 grid in tier-1; the n=16/B=4 cross
+  product under `-m slow`).
+"""
+from functools import partial
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+
+from aclswarm_tpu.analysis import lint as lintmod
+from aclswarm_tpu.analysis import trace_audit as ta
+
+pytestmark = pytest.mark.analysis
+
+FIXTURES = Path(__file__).parent / "fixtures" / "jaxcheck"
+PACKAGE = Path(__file__).parents[1] / "aclswarm_tpu"
+
+
+def _by_file(violations):
+    out = {}
+    for v in violations:
+        out.setdefault(Path(v.path).name, []).append(v)
+    return out
+
+
+class TestLintFixtures:
+    """Each rule fires on known-bad code — and only where expected."""
+
+    @pytest.fixture(scope="class")
+    def fired(self):
+        return _by_file(lintmod.lint_paths([FIXTURES]))
+
+    @pytest.mark.parametrize("fixture,rule,count", [
+        ("bad_jc001.py", "JC001", 5),
+        ("bad_jc002.py", "JC002", 3),
+        ("bad_jc003.py", "JC003", 4),
+        ("bad_jc004.py", "JC004", 3),
+        ("bad_jc005.py", "JC005", 1),
+    ])
+    def test_rule_fires(self, fired, fixture, rule, count):
+        vs = fired.get(fixture, [])
+        assert [v.rule for v in vs] == [rule] * count, \
+            f"{fixture}: expected {count}x{rule}, got {vs}"
+
+    def test_fixture_lines_match_annotations(self, fired):
+        """Every violation lands on a line whose comment names its rule —
+        and every `# JCnnn` annotation in the fixtures is hit."""
+        for fname, vs in fired.items():
+            src = (FIXTURES / fname).read_text().splitlines()
+            for v in vs:
+                assert v.rule in src[v.line - 1], \
+                    f"{fname}:{v.line} fired {v.rule} on an " \
+                    f"unannotated line: {src[v.line - 1]!r}"
+
+    def test_escape_hatch_suppresses(self, fired):
+        assert "suppressed.py" not in fired
+
+    def test_host_only_code_not_flagged(self, fired):
+        """Reachability matters: host-side code using the same calls is
+        legal (the `host_only` defs carry no annotation)."""
+        for fname in ("bad_jc001.py", "bad_jc004.py"):
+            src = (FIXTURES / fname).read_text().splitlines()
+            for v in fired[fname]:
+                assert "host_only" not in src[v.line - 1]
+
+
+class TestLintRepo:
+    def test_package_is_clean(self):
+        """The acceptance bar: zero violations across aclswarm_tpu/."""
+        violations = lintmod.lint_paths([PACKAGE])
+        assert violations == [], "\n".join(str(v) for v in violations)
+
+    def test_cli_exit_codes(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import jax\n@jax.jit\ndef f(x):\n    return x.item()\n")
+        assert lintmod.main([str(bad)]) == 1
+        assert lintmod.main([str(PACKAGE)]) == 0
+
+
+class TestTraceAudit:
+    """Layer 2 on the tier-1 grid (n=5, B=2, all three solvers, faults
+    on/off, truth + flooded localization)."""
+
+    @pytest.mark.parametrize(
+        "entry", ta.ENTRY_POINTS, ids=lambda e: e.name)
+    def test_entry_clean(self, entry):
+        seen = set()
+        reports = []
+        for gp in ta.iter_grid():
+            key = tuple(getattr(gp, a) for a in entry.axes)
+            if key in seen:
+                continue
+            seen.add(key)
+            try:
+                reports.append(ta.audit_entry(entry, gp))
+            except ta.Skip:
+                continue
+        assert reports, f"{entry.name}: no grid point ran"
+        for r in reports:
+            assert not r.recompiled, \
+                f"{r.name} {r.grid}: second identical call compiled " \
+                f"again (cache entries: {r.n_compiles})"
+            assert not r.f64_leaves, \
+                f"{r.name} {r.grid}: f64 leaves {r.f64_leaves} " \
+                f"in output avals {r.out_dtypes}"
+
+    @pytest.mark.slow
+    def test_full_grid(self):
+        bad = [r for r in ta.audit_all(slow=True) if not r.ok]
+        assert bad == [], bad
+
+
+class TestWeakTypeRegression:
+    """Satellite of the JC003 sweep: `init_state` now pins a strong
+    canonical dtype, so list / int / f32-array callers all produce the
+    SAME avals and the rollout never retraces (the silent-recompile
+    defect the dtype-less `jnp.asarray(q0)` used to cause)."""
+
+    Q = [[0.0, 0.0, 2.0], [2.0, 0.0, 2.0], [0.0, 2.0, 2.0],
+         [2.0, 2.0, 2.0], [1.0, 1.0, 2.0]]
+
+    def _states(self):
+        from aclswarm_tpu import sim
+        return [
+            sim.init_state(self.Q),                              # list
+            sim.init_state(np.asarray(self.Q, np.float32)),      # f32
+            sim.init_state([[int(x) for x in row]
+                            for row in self.Q]),                 # int list
+        ]
+
+    def test_identical_avals(self):
+        with ta.f32_mode():
+            trees = [jax.tree.map(
+                lambda x: None if x is None else (x.shape, str(x.dtype)),
+                s, is_leaf=lambda x: x is None) for s in self._states()]
+        assert trees[0] == trees[1] == trees[2]
+
+    def test_rollout_traces_once(self):
+        """Trace twice with differently-sourced (but equal) states:
+        zero recompiles."""
+        from aclswarm_tpu.sim import engine
+        with ta.f32_mode():
+            states = self._states()
+            cfg = ta._sim_cfg(ta.GridPoint())
+            form = ta._formation(len(self.Q))
+            from aclswarm_tpu.core.types import ControlGains
+            w = jax.jit(partial(engine.rollout.__wrapped__),
+                        static_argnames=("n_ticks", "cfg"))
+            for s in states:
+                w(s, form, ControlGains(), ta._sparams(),
+                  cfg=cfg, n_ticks=2)
+            assert w._cache_size() == 1
+
+    def test_localization_table_dtype(self):
+        from aclswarm_tpu.sim import localization as loc
+        with ta.f32_mode():
+            t1 = loc.init_table(self.Q)
+            t2 = loc.init_table(np.asarray(self.Q, np.float32))
+        assert t1.est.dtype == t2.est.dtype == np.float32
+        assert t1.age.dtype == np.int32

@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 
 from aclswarm_tpu.utils import (Stopwatch, get_logger, median_time,
-                                readback_sync)
+                                readback_sync, timing)
 
 
 class TestTiming:
@@ -41,6 +41,61 @@ class TestTiming:
         lines = []
         sw.report(lines.append)
         assert len(lines) == 2 and lines[0].startswith("a:")
+
+
+class TestTimingStats:
+    """`timing_stats` is the single home every benchmark imports; its
+    contract (warmup call, rep spread, per-division) is load-bearing for
+    the committed artifacts' jitter columns."""
+
+    def test_keys_and_ordering(self):
+        stats = timing.timing_stats(lambda x: x, jnp.zeros(1), reps=4)
+        assert set(stats) == {"median_s", "min_s", "max_s", "reps"}
+        assert stats["min_s"] <= stats["median_s"] <= stats["max_s"]
+        assert stats["reps"] == 4
+
+    def test_warmup_not_measured(self):
+        """The first (compile/warmup) call must not pollute the stats."""
+        calls = []
+
+        def fn(x):
+            calls.append(time.perf_counter())
+            if len(calls) == 1:
+                time.sleep(0.05)        # a 'compile' on the warmup call
+            return x
+
+        stats = timing.timing_stats(fn, jnp.zeros(1), reps=3)
+        assert len(calls) == 4          # 1 warmup + 3 reps
+        assert stats["max_s"] < 0.05
+
+    def test_per_divides_every_stat(self):
+        def fn(x):
+            time.sleep(0.02)
+            return x
+
+        s1 = timing.timing_stats(fn, jnp.zeros(1), per=1, reps=2)
+        s10 = timing.timing_stats(fn, jnp.zeros(1), per=10, reps=2)
+        assert s10["median_s"] < s1["median_s"] / 5
+        assert s10["max_s"] < 0.01
+
+    def test_median_time_matches_stats(self):
+        dt = median_time(lambda x: x, jnp.zeros(1), reps=3)
+        assert isinstance(dt, float) and dt >= 0.0
+
+    def test_readback_sync_is_a_barrier(self):
+        """readback_sync must return a host float of the FIRST leaf —
+        the digest contract `parallel.launch` relies on."""
+        out = readback_sync({"a": jnp.full((3,), 7.5), "b": jnp.zeros(2)})
+        assert isinstance(out, float) and out == 7.5
+
+    def test_trace_writes_profile(self, tmp_path):
+        """`timing.trace` wraps jax.profiler start/stop: the logdir must
+        exist and contain a capture afterwards."""
+        logdir = tmp_path / "prof"
+        with timing.trace(str(logdir)):
+            readback_sync(jnp.arange(8.0) * 2.0)
+        files = list(logdir.rglob("*"))
+        assert files, "profiler trace produced no output"
 
 
 class TestLogging:
