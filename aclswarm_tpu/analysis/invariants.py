@@ -1,0 +1,306 @@
+"""`swarmcheck` — compiled-in invariant sanitizer (the runtime tier of
+the jaxcheck stack; docs/STATIC_ANALYSIS.md §runtime tier).
+
+jaxcheck layers 1+2 guard *trace-time* properties (host syncs, weak
+dtypes, cache stability). Nothing guarded the *values* flowing through
+the compiled programs: a NaN pose, a doubly-assigned formation point, or
+a stale alive mask after a fault rejoin silently corrupts a whole
+batched rollout — every downstream metric is garbage and the trial FSM
+happily reads it. This module is the sanitizer tier: a declarative
+registry of the algebraic invariants the paper states (assignment is a
+permutation, Sinkhorn marginals within tolerance, adjacency symmetric,
+fault masks consistent with the `FaultSchedule`, poses finite and
+in-bounds after the safety shim, ADMM residuals driven down), compiled
+INTO the jitted entry points as a functional error-accumulation carry.
+
+Design rules (each one load-bearing):
+
+- **Errors are data, not control flow.** A violation is recorded into an
+  `InvariantState` carry ((), int32 ``code`` + ``tick``) threaded
+  through the rollout scan exactly like the fault masks: first violation
+  wins, later ones never overwrite it. The carry vmaps over the trial
+  axis, so a batched rollout attributes each violation to (trial index,
+  tick, contract id) with zero extra host syncs — the per-tick code
+  rides the `StepMetrics`/`ChunkSummary` arrays the drivers already
+  sync per chunk (`first_violation` decodes them host-side).
+- **`check_mode` is static, and off is FREE.** The flag lives in
+  `SimConfig` (compile-time); every check site is Python-gated on it, so
+  ``check_mode='off'`` inserts zero operations and zero carry leaves —
+  the lowered HLO is bit-identical to the pre-swarmcheck program.
+  `analysis.trace_audit.verify_zero_cost_off` PROVES that per entry
+  point against committed baseline HLO digests (`hlo_baseline.json`).
+- **Checkers are independent oracles.** A contract predicate never
+  reuses the value-producing code path it checks (e.g.
+  `alive_mask_stale` recomputes the alive mask from the raw
+  `FaultSchedule` leaves instead of calling `faults.schedule.alive_at`)
+  — a bug in the checked path must not blind its own checker. The
+  deliberate duplication is the contract definition.
+
+`jax.experimental.checkify` implements the same functional error carry;
+the hand-threaded form is used instead because (a) the carry must
+coexist with the engine's donated `SimState` scan carry and batched
+`vmap` without re-wrapping the public entry points (their HLO identity
+under ``off`` is the proven guarantee), and (b) the error payload here
+is a *per-trial* (code, tick) pair the summary layer forwards, not a
+process-global checkify error.
+
+Raising: the device never raises. Host drivers (`harness.trials`,
+`benchmarks.faults_suite`) call `raise_on_violation` on the synced
+per-tick code arrays and get a structured `InvariantViolation`
+(trial index + tick + contract id).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+__all__ = [
+    "Contract", "CONTRACTS", "CODES", "InvariantState",
+    "InvariantViolation", "init_invariants", "record", "record_code",
+    "contract_of", "first_violation", "raise_on_violation",
+    "SINKHORN_MARGINAL_TOL", "BOUNDS_MARGIN",
+    "perm_violated", "adjacency_asymmetric", "alive_mask_stale",
+    "dead_rows_active", "dead_rows_moved", "nonfinite_state",
+    "out_of_bounds", "sinkhorn_marginals_violated",
+    "admm_residual_violated",
+]
+
+# tolerances (module constants so the contract table in the docs has a
+# single source; see docs/STATIC_ANALYSIS.md for the calibration notes)
+#
+# Sinkhorn marginal: sum_i |row_mass_i - 1/n| (same for columns). The
+# production settings (tau=0.03, 200 iters, mean-normalized cost) leave
+# < 1e-3 at n <= 100; 0.05 (5% of total mass misallocated) is far
+# outside that envelope while still catching a broken iteration long
+# before the rounded permutation degrades.
+SINKHORN_MARGINAL_TOL = 0.05
+# room-bounds slack in metres: the safety shim clamps *goals* to the
+# room, but second-order dynamics ('doubleint') may physically overshoot
+# the clamped goal by a small margin before the PD law pulls back.
+BOUNDS_MARGIN = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Contract:
+    """One registered invariant. ``code`` is the int32 the device carry
+    records (0 is reserved for 'clean'); ``scope`` names the pipeline
+    stage the check runs at (where the blame points)."""
+
+    id: str
+    code: int
+    summary: str
+    scope: str
+
+
+CONTRACTS: tuple[Contract, ...] = (
+    # when one tick violates several contracts the FIRST one *recorded*
+    # in `engine.step` wins (adj_sym, mask_consistency, the solver-level
+    # sinkhorn_marginal, assign_perm, dead_distcmd, dead_frozen,
+    # state_finite, state_bounds — e.g. a NaN pose is reported as
+    # state_finite, not as the out-of-bounds its NaN comparisons imply)
+    Contract("adj_sym", 1,
+             "formation adjacency matrix is symmetric",
+             "engine.step input"),
+    Contract("mask_consistency", 2,
+             "alive mask equals the FaultSchedule's mask at the "
+             "current tick (no stale mask after a drop/rejoin)",
+             "engine.step fault model"),
+    Contract("assign_perm", 3,
+             "the assignment v2f is a permutation (auction, "
+             "Sinkhorn-rounded, and CBAA consensus outputs alike)",
+             "engine.step after assignment"),
+    Contract("sinkhorn_marginal", 4,
+             "Sinkhorn transport-plan row/col marginals within "
+             "SINKHORN_MARGINAL_TOL of uniform",
+             "engine.assign sinkhorn path"),
+    Contract("dead_distcmd", 5,
+             "dead vehicles publish no distcmd",
+             "engine.step control masking"),
+    Contract("dead_frozen", 6,
+             "dead vehicles' poses stay pinned across the tick",
+             "engine.step fault freeze"),
+    Contract("state_finite", 7,
+             "poses/velocities/goals finite after the safety shim",
+             "engine.step post-dynamics"),
+    Contract("state_bounds", 8,
+             "poses within room bounds + BOUNDS_MARGIN",
+             "engine.step post-dynamics"),
+    Contract("admm_residual", 9,
+             "ADMM gain iteration drove its residual down (converged "
+             "by threshold, or net decrease over the budget)",
+             "gains.admm solve"),
+)
+
+CODES = {c.id: c.code for c in CONTRACTS}
+_BY_CODE = {c.code: c for c in CONTRACTS}
+
+
+def contract_of(code: int) -> Contract | None:
+    """Decode a device code (0 / unknown -> None)."""
+    return _BY_CODE.get(int(code))
+
+
+class InvariantViolation(RuntimeError):
+    """Structured sanitizer failure surfaced by a host driver."""
+
+    def __init__(self, contract: Contract, tick: int,
+                 trial: int | None = None):
+        self.contract = contract
+        self.tick = tick
+        self.trial = trial
+        where = f"trial {trial}, " if trial is not None else ""
+        super().__init__(
+            f"invariant {contract.id!r} violated ({where}tick {tick}): "
+            f"{contract.summary} [scope: {contract.scope}]")
+
+
+@struct.dataclass
+class InvariantState:
+    """Per-trial error carry: code of the FIRST violation (0 = clean)
+    and the per-trial tick it landed on (-1 = none). Batch by stacking;
+    all leaves are data, so the carry vmaps and donates with the rest
+    of `SimState`."""
+
+    code: jnp.ndarray   # () int32
+    tick: jnp.ndarray   # () int32
+
+
+def init_invariants(batch: int | None = None) -> InvariantState:
+    lead = () if batch is None else (batch,)
+    return InvariantState(code=jnp.zeros(lead, jnp.int32),
+                          tick=jnp.full(lead, -1, jnp.int32))
+
+
+def record(inv: InvariantState, violated: jnp.ndarray, contract_id: str,
+           tick) -> InvariantState:
+    """First-wins accumulation of one contract's () bool predicate."""
+    return record_code(
+        inv,
+        jnp.where(violated, jnp.asarray(CODES[contract_id], jnp.int32),
+                  jnp.zeros((), jnp.int32)),
+        tick)
+
+
+def record_code(inv: InvariantState, code: jnp.ndarray,
+                tick) -> InvariantState:
+    """First-wins accumulation of an already-encoded () int32 code
+    (0 = no violation) — the solver-level checks return these."""
+    hit = (code != 0) & (inv.code == 0)
+    return InvariantState(
+        code=jnp.where(hit, code, inv.code),
+        tick=jnp.where(hit, jnp.asarray(tick, jnp.int32), inv.tick))
+
+
+# ---------------------------------------------------------------------------
+# contract predicates (pure jnp; each returns a () bool, True = VIOLATED)
+
+def perm_violated(v2f: jnp.ndarray) -> jnp.ndarray:
+    """Not a permutation of 0..n-1 (independent of `core.perm.is_valid`
+    only in location, not in algorithm — the count test IS the
+    definition; a corrupted solver output cannot satisfy it)."""
+    n = v2f.shape[0]
+    inrange = (v2f >= 0) & (v2f < n)
+    counts = jnp.zeros((n,), jnp.int32).at[jnp.clip(v2f, 0, n - 1)].add(
+        inrange.astype(jnp.int32))
+    return ~jnp.all(counts == 1)
+
+
+def adjacency_asymmetric(adjmat: jnp.ndarray) -> jnp.ndarray:
+    return jnp.any(adjmat != adjmat.T)
+
+
+def alive_mask_stale(alive: jnp.ndarray, sched, tick) -> jnp.ndarray:
+    """The mask the engine threads differs from the schedule's own
+    semantics at ``tick``. Deliberately recomputes the reference mask
+    inline from the raw schedule leaves (alive iff ``tick < drop`` or
+    ``tick >= rejoin``) instead of calling `faults.schedule.alive_at`:
+    the checker must not share the checked path."""
+    t = jnp.asarray(tick, jnp.int32)
+    ref = (t < sched.drop_tick) | (t >= sched.rejoin_tick)
+    return jnp.any(alive != ref)
+
+
+def dead_rows_active(distcmd_norm: jnp.ndarray,
+                     alive: jnp.ndarray) -> jnp.ndarray:
+    """A dead vehicle published a nonzero distcmd."""
+    return jnp.any(jnp.where(alive, jnp.zeros((), distcmd_norm.dtype),
+                             distcmd_norm) > 0)
+
+
+def dead_rows_moved(q_new: jnp.ndarray, q_prev: jnp.ndarray,
+                    alive: jnp.ndarray) -> jnp.ndarray:
+    """A dead vehicle's pose changed across the tick (the freeze
+    contract; a rejoined vehicle is alive and exempt by definition)."""
+    moved = jnp.any(q_new != q_prev, axis=-1)
+    return jnp.any(~alive & moved)
+
+
+def nonfinite_state(swarm, goal) -> jnp.ndarray:
+    """Any non-finite pose/velocity/goal leaf after the safety shim."""
+    bad = jnp.zeros((), bool)
+    for x in (swarm.q, swarm.vel, goal.pos, goal.vel):
+        bad = bad | jnp.any(~jnp.isfinite(x))
+    return bad
+
+
+def out_of_bounds(q: jnp.ndarray, sparams,
+                  margin: float = BOUNDS_MARGIN) -> jnp.ndarray:
+    """A pose left the room by more than ``margin``. NaN poses fail the
+    inside test too, but `nonfinite_state` is recorded first, so a NaN
+    is always attributed to state_finite (first-wins ordering)."""
+    lo = sparams.bounds_min - margin
+    hi = sparams.bounds_max + margin
+    inside = (q >= lo) & (q <= hi)
+    return ~jnp.all(inside)
+
+
+def sinkhorn_marginals_violated(row_err: jnp.ndarray, col_err: jnp.ndarray,
+                                tol: float = SINKHORN_MARGINAL_TOL
+                                ) -> jnp.ndarray:
+    """Row/col L1 marginal errors (from `sinkhorn.marginal_errors`)
+    outside the tolerance envelope."""
+    return (row_err > tol) | (col_err > tol)
+
+
+def admm_residual_violated(first_diff: jnp.ndarray, last_diff: jnp.ndarray,
+                           stopped: jnp.ndarray) -> jnp.ndarray:
+    """The ADMM iteration neither converged by its stopping criteria nor
+    achieved a net residual decrease over its budget — 'monotone-ish':
+    transient growth is normal ADMM behavior, finishing higher than it
+    started is not."""
+    return ~stopped & (last_diff > first_diff)
+
+
+# ---------------------------------------------------------------------------
+# host-side surfacing
+
+def first_violation(codes: np.ndarray, tick0: int = 0
+                    ) -> tuple[int, Contract] | None:
+    """Decode a synced per-tick ``(T,)`` code array: (global tick,
+    Contract) of the first violation, or None if clean. ``tick0`` is the
+    global tick of the array's first element (chunked drivers pass their
+    chunk base)."""
+    codes = np.asarray(codes)
+    nz = np.nonzero(codes != 0)[0]
+    if nz.size == 0:
+        return None
+    t = int(nz[0])
+    contract = contract_of(int(codes[t]))
+    if contract is None:       # unknown code: still a violation, loudly
+        contract = Contract("unknown", int(codes[t]),
+                            "unregistered contract code", "unknown")
+    return tick0 + t, contract
+
+
+def raise_on_violation(codes: np.ndarray, trial: int | None = None,
+                       tick0: int = 0) -> None:
+    """Raise `InvariantViolation` on the first nonzero code, else no-op.
+    The chunked drivers call this on arrays they already sync — the
+    happy path costs nothing extra."""
+    hit = first_violation(codes, tick0)
+    if hit is not None:
+        tick, contract = hit
+        raise InvariantViolation(contract, tick, trial=trial)

@@ -38,10 +38,20 @@ Rules (catalog + rationale: docs/STATIC_ANALYSIS.md):
   position of a call to a `donate_argnums` function and *read again*
   after that call without rebinding. The donated buffer is dead; XLA
   may have aliased it into the output.
+- **JC006 unmasked-reduction** — `jnp.sum/mean/min/max/argmin/argmax`
+  in the fault-aware modules (`sim/`, `assignment/`, `control/`,
+  `faults/`) inside a function that handles an alive/link mask, where
+  NO mask feeds the reduced operand (transitively through local
+  assignments, flow-insensitively). This is the bug class the fault
+  masking made possible: a reduction over the agent axis that forgets
+  the dead rows (a frozen vehicle's pose polluting a mean, a dead
+  bidder winning an argmin). Scope rules below.
 
 Escape hatch: append ``# jaxcheck: disable=JC001`` (comma-separate
 several rules, or omit ``=...`` to disable all rules) to the offending
-line.
+line. File-level: a ``# jaxcheck: disable-file=JC001,JC006`` comment
+anywhere in a file disables those rules for the whole file (omit
+``=...`` to disable all — reserve for generated/vendored code).
 
 Run standalone: ``python -m aclswarm_tpu.analysis.lint [paths...]`` or
 ``scripts/lint.sh``. Zero violations on `aclswarm_tpu/` is enforced in
@@ -65,6 +75,7 @@ RULES = {
     "JC003": "dtype-less array creation (weak-type -> recompile)",
     "JC004": "host nondeterminism in compiled path",
     "JC005": "donated argument read after donation",
+    "JC006": "unmasked reduction in fault-aware code",
 }
 
 # parameter names presumed compile-time static even without annotation —
@@ -106,8 +117,31 @@ _NONDET_PREFIXES = ("numpy.random.", "random.", "secrets.", "uuid.")
 
 _ARRAY_CTORS = {"jax.numpy.asarray", "jax.numpy.array"}
 
+# JC006: the modules where fault masking is load-bearing. Fixture /
+# out-of-tree files opt in with a `# jaxcheck: fault-aware-file` comment.
+_JC006_MODULE_PREFIXES = ("aclswarm_tpu.sim", "aclswarm_tpu.assignment",
+                          "aclswarm_tpu.control", "aclswarm_tpu.faults")
+# reductions that silently fold dead/masked rows into their result
+_JC006_REDUCTIONS = {
+    "jax.numpy." + r for r in ("sum", "mean", "min", "max",
+                               "argmin", "argmax")}
+# identifier tokens that mark a value as mask-derived (split on
+# underscores; `*mask` suffixes like `neighbor_mask`/`comm_mask` match)
+_MASKISH_TOKENS = {"alive", "dead", "mask", "masked", "pin", "pinned",
+                   "forbid", "forbidden", "comm"}
+
+
+def _is_maskish(name: str) -> bool:
+    parts = [p for p in re.split(r"[_\W0-9]+", name.lower()) if p]
+    return any(p in _MASKISH_TOKENS or p.endswith("mask") for p in parts)
+
+
+# `disable` must not swallow `disable-file` (negative lookahead)
 _DISABLE_RE = re.compile(
-    r"#\s*jaxcheck:\s*disable(?:\s*=\s*([A-Za-z0-9_,\s]+))?")
+    r"#\s*jaxcheck:\s*disable(?!-file)(?:\s*=\s*([A-Za-z0-9_,\s]+))?")
+_DISABLE_FILE_RE = re.compile(
+    r"#\s*jaxcheck:\s*disable-file(?:\s*=\s*([A-Za-z0-9_,\s]+))?")
+_FAULT_AWARE_FILE_RE = re.compile(r"#\s*jaxcheck:\s*fault-aware-file")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -154,6 +188,11 @@ class ModuleInfo:
     factories: list[ast.Lambda] = dataclasses.field(default_factory=list)
     pytree_classes: set[str] = dataclasses.field(default_factory=set)
     disabled: dict[int, set | None] = dataclasses.field(default_factory=dict)
+    # file-level pragma state: empty set = nothing disabled file-wide,
+    # None = ALL rules disabled (`# jaxcheck: disable-file`)
+    file_disabled: set | None = dataclasses.field(default_factory=set)
+    # `# jaxcheck: fault-aware-file` opt-in (JC006 outside its modules)
+    fault_aware_file: bool = False
 
 
 def _module_name(path: Path) -> str:
@@ -390,6 +429,16 @@ class Linter:
                     mod.disabled[i] = (
                         {r.strip().upper() for r in m.group(1).split(",")}
                         if m.group(1) else None)
+                fm = _DISABLE_FILE_RE.search(line)
+                if fm and mod.file_disabled is not None:
+                    if fm.group(1) is None:
+                        mod.file_disabled = None        # all rules
+                    else:
+                        mod.file_disabled |= {
+                            r.strip().upper()
+                            for r in fm.group(1).split(",")}
+                if _FAULT_AWARE_FILE_RE.search(line):
+                    mod.fault_aware_file = True
             _Collector(mod).visit(mod.tree)
             self.modules[mod.name] = mod
 
@@ -508,6 +557,8 @@ class Linter:
     # -- rule machinery -----------------------------------------------------
     def _emit(self, mod: ModuleInfo, node: ast.AST, rule: str, msg: str):
         line = getattr(node, "lineno", 0)
+        if mod.file_disabled is None or rule in mod.file_disabled:
+            return
         if line in mod.disabled:
             rules = mod.disabled[line]
             if rules is None or rule in rules:
@@ -527,7 +578,10 @@ class Linter:
     def _iter_own_body(info: FuncInfo):
         """Nodes of this function's body, NOT descending into nested
         defs/lambdas (they are separate FuncInfos, checked when they are
-        themselves reachable)."""
+        themselves reachable). The nested-def test applies to the popped
+        node itself, not only to grandchildren: a `def` that is a direct
+        statement of the body must be skipped too, or every violation in
+        it is double-reported (once for it, once for its parent)."""
         if isinstance(info.node, ast.Lambda):
             start = [info.node.body]
         else:
@@ -535,11 +589,20 @@ class Linter:
         stack = start[:]
         while stack:
             node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                # the nested BODY is a separate FuncInfo, but its
+                # decorators and argument defaults evaluate in THIS
+                # scope (during this function's trace) — keep scanning
+                # those
+                if not isinstance(node, ast.Lambda):
+                    stack.extend(node.decorator_list)
+                args = node.args
+                stack.extend(d for d in args.defaults)
+                stack.extend(d for d in args.kw_defaults if d is not None)
+                continue
             yield node
             for child in ast.iter_child_nodes(node):
-                if isinstance(child, (ast.FunctionDef,
-                                      ast.AsyncFunctionDef, ast.Lambda)):
-                    continue
                 stack.append(child)
 
     # JC001 / JC003 / JC004 share a walk over a compiled body
@@ -747,6 +810,106 @@ class Linter:
                             "result (x = f(x, ...)) or copy first")
                         break
 
+    # -- JC006 --------------------------------------------------------------
+    @staticmethod
+    def _expr_names(expr: ast.AST) -> set[str]:
+        """All bare names and attribute names in an expression — the
+        flow-insensitive provenance alphabet for the mask test."""
+        out: set[str] = set()
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+            elif isinstance(n, ast.Attribute):
+                out.add(n.attr)
+        return out
+
+    def _check_jc006(self) -> None:
+        """Unmasked reductions in fault-aware code.
+
+        Scope (both conditions must hold, keeping the rule quiet on the
+        purely-geometric kernels that share these modules):
+
+        1. the module is one of the fault-aware subpackages
+           (`_JC006_MODULE_PREFIXES`) or carries the
+           ``# jaxcheck: fault-aware-file`` opt-in;
+        2. the *function* itself handles a mask: a mask-ish identifier
+           (`_MASKISH_TOKENS`) appears among its parameters, its body's
+           names, or the attributes it reads. A solver that never sees
+           an alive mask (`auction_lap`, the Sinkhorn roundings) has no
+           masking obligation and is exempt.
+
+        A reduction passes when a mask-ish name reaches its operand
+        transitively through the function's local assignments
+        (flow-insensitive: any binding of a name contributes — rebinding
+        ``cost = apply_pin_forbid(cost, pin, forbid)`` marks `cost`).
+        """
+        for mod in self.modules.values():
+            in_scope = mod.fault_aware_file or any(
+                mod.name == p or mod.name.startswith(p + ".")
+                for p in _JC006_MODULE_PREFIXES)
+            if not in_scope:
+                continue
+            for info in mod.funcs:
+                self._check_jc006_fn(mod, info)
+
+    def _check_jc006_fn(self, mod: ModuleInfo, info: FuncInfo) -> None:
+        assigns: dict[str, set[str]] = {}
+        seen_names: set[str] = set(info.params)
+        reductions: list[tuple[ast.Call, str]] = []
+        for node in self._iter_own_body(info):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = node.value
+                if value is None:
+                    continue
+                rhs = self._expr_names(value)
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    for el in ast.walk(t):
+                        if isinstance(el, ast.Name):
+                            assigns.setdefault(el.id, set()).update(rhs)
+            elif isinstance(node, ast.Name):
+                seen_names.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                seen_names.add(node.attr)
+            elif isinstance(node, ast.Call):
+                fq = self._call_fq(mod, node, info)
+                if fq in _JC006_REDUCTIONS \
+                        and (node.args or node.keywords):
+                    reductions.append((node, fq))
+        if not reductions:
+            return
+        if not any(_is_maskish(x) for x in seen_names):
+            return          # function never touches a mask: exempt
+        for call, fq in reductions:
+            # provenance covers the positional operand AND every keyword
+            # value: the native masked-reduction idiom
+            # `jnp.sum(x, where=alive)` is masked by construction, and a
+            # keyword-passed operand (`jnp.sum(a=x)`) must not escape
+            prov: set[str] = set()
+            for a in list(call.args[:1]) + [k.value for k in
+                                            call.keywords]:
+                prov |= self._expr_names(a)
+            frontier = set(prov)
+            for _ in range(32):     # transitive closure, bounded
+                step = set()
+                for nm in frontier:
+                    step |= assigns.get(nm, set())
+                step -= prov
+                if not step:
+                    break
+                prov |= step
+                frontier = step
+            if not any(_is_maskish(x) for x in prov):
+                red = fq.rsplit(".", 1)[-1]
+                self._emit(
+                    mod, call, "JC006",
+                    f"jnp.{red}(...) in fault-aware code reduces an "
+                    "operand no alive/link mask feeds — dead/masked "
+                    "rows fold silently into the result; mask the "
+                    "operand (jnp.where(alive, ...)) or disable with "
+                    "a pragma if the full-fleet reduction is intended")
+
     # -- default_factory JC003 ----------------------------------------------
     def _check_factories(self) -> None:
         for mod in self.modules.values():
@@ -795,8 +958,22 @@ class Linter:
         self._check_pytree_ctors(compiled)
         self._check_factories()
         self._check_jc005()
-        self.violations = sorted(set(self.violations),
-                                 key=lambda v: (v.path, v.line, v.rule))
+        self._check_jc006()
+        # dedupe to one report per (file, line, rule): the same site is
+        # reached through every call-graph path to it (two jit roots
+        # calling one helper), and differently-worded messages for one
+        # defect are noise — keep the first message in sort order
+        ordered = sorted(set(self.violations),
+                         key=lambda v: (v.path, v.line, v.rule, v.message))
+        seen: set[tuple] = set()
+        unique: list[Violation] = []
+        for v in ordered:
+            key = (v.path, v.line, v.rule)
+            if key in seen:
+                continue
+            seen.add(key)
+            unique.append(v)
+        self.violations = unique
         return self.violations
 
 

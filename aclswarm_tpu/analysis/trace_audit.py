@@ -40,12 +40,27 @@ Registering a new jitted entry point (see docs/STATIC_ANALYSIS.md):
 The builder must return *fresh* arrays each call (entries with donated
 arguments are executed twice) and every grid point it supports; raise
 `Skip` for unsupported combinations.
+
+Zero-cost-off proof (the swarmcheck guarantee, docs/STATIC_ANALYSIS.md):
+`hlo_baseline.json` holds SHA-256 digests of every entry point's lowered
+HLO captured from the PRE-swarmcheck tree (same builders, same tier-1
+grid). `verify_zero_cost_off` re-lowers every entry with the sanitizer
+off (`check_mode='off'`, no `InvariantState` in any carry) and asserts
+digest equality — the instrumented source compiles to the bit-identical
+program. The lowered text carries no source locations (verified), so
+unrelated edits to the same files cannot perturb it; only a real change
+to the compiled surface can, and then the baseline must be consciously
+regenerated with ``python -m aclswarm_tpu.analysis.trace_audit
+--write-hlo-baseline`` (a reviewable artifact diff).
 """
 from __future__ import annotations
 
 import contextlib
 import dataclasses
+import hashlib
+import json
 from functools import partial
+from pathlib import Path
 from typing import Callable, Iterable
 
 import numpy as np
@@ -53,6 +68,8 @@ import numpy as np
 __all__ = [
     "GridPoint", "AuditReport", "Skip", "ENTRY_POINTS", "register_entry",
     "audit_entry", "audit_all", "iter_grid", "f32_mode",
+    "entry_hlo", "hlo_digest", "grid_key", "verify_zero_cost_off",
+    "write_hlo_baseline", "HLO_BASELINE_PATH",
 ]
 
 
@@ -79,6 +96,9 @@ class EntryPoint:
     build: Callable[[GridPoint], tuple]
     # which grid axes this entry actually varies over (grid dedup)
     axes: tuple = ("n",)
+    # participates in the zero-cost-off HLO baseline (False for the
+    # [checked] sanitizer-on variants — those are *expected* to differ)
+    baseline: bool = True
 
 
 @dataclasses.dataclass
@@ -100,10 +120,11 @@ ENTRY_POINTS: list[EntryPoint] = []
 
 def register_entry(name: str, fn: Callable, *, build: Callable,
                    static_argnames: tuple = (),
-                   axes: tuple = ("n",)) -> None:
+                   axes: tuple = ("n",), baseline: bool = True) -> None:
     ENTRY_POINTS.append(EntryPoint(name=name, fn=fn,
                                    static_argnames=tuple(static_argnames),
-                                   build=build, axes=tuple(axes)))
+                                   build=build, axes=tuple(axes),
+                                   baseline=baseline))
 
 
 @contextlib.contextmanager
@@ -168,41 +189,48 @@ def _faults(gp: GridPoint, seed: int = 0):
         link_loss=0.1)
 
 
-def _sim_state(gp: GridPoint, seed: int = 0):
+def _sim_state(gp: GridPoint, seed: int = 0, checks: bool = False):
     from aclswarm_tpu import sim
     return sim.init_state(_scatter(gp.n, seed),
                           localization=(gp.localization == "flooded"),
-                          faults=_faults(gp, seed))
+                          faults=_faults(gp, seed), checks=checks)
 
 
 _TICKS = 4
 
 
-def _build_rollout(gp: GridPoint):
+def _build_rollout(gp: GridPoint, check: bool = False):
     from aclswarm_tpu.core.types import ControlGains
-    args = (_sim_state(gp), _formation(gp.n), ControlGains(), _sparams())
-    return args, {"cfg": _sim_cfg(gp), "n_ticks": _TICKS}
+    args = (_sim_state(gp, checks=check), _formation(gp.n), ControlGains(),
+            _sparams())
+    cfg = _sim_cfg(gp)
+    if check:
+        cfg = cfg.replace(check_mode="on")
+    return args, {"cfg": cfg, "n_ticks": _TICKS}
 
 
-def _build_batched_rollout(gp: GridPoint):
+def _build_batched_rollout(gp: GridPoint, check: bool = False):
     import jax
     import jax.numpy as jnp
 
     from aclswarm_tpu.core.types import ControlGains
-    states = [_sim_state(gp, seed=b) for b in range(gp.B)]
+    states = [_sim_state(gp, seed=b, checks=check) for b in range(gp.B)]
     forms = [_formation(gp.n) for _ in range(gp.B)]
     stack = lambda *xs: jnp.stack(xs)                      # noqa: E731
     state = jax.tree.map(stack, *states)
     form = jax.tree.map(stack, *forms)
     args = (state, form, ControlGains(), _sparams())
-    return args, {"cfg": _sim_cfg(gp), "n_ticks": _TICKS}
+    cfg = _sim_cfg(gp)
+    if check:
+        cfg = cfg.replace(check_mode="on")
+    return args, {"cfg": cfg, "n_ticks": _TICKS}
 
 
-def _build_rollout_summary(gp: GridPoint):
+def _build_rollout_summary(gp: GridPoint, check: bool = False):
     import jax.numpy as jnp
 
     from aclswarm_tpu.sim import summary
-    args, statics = _build_batched_rollout(gp)
+    args, statics = _build_batched_rollout(gp, check=check)
     carry = summary.init_carry(gp.n, window=3, dtype=jnp.float32,
                                batch=gp.B)
     statics.update(window=3, pose_every=0)
@@ -315,6 +343,22 @@ def _install_default_registry() -> None:
     register_entry("interop.planner.tick", planner._tick,
                    static_argnames=("cfg",), build=_build_planner_tick,
                    axes=("n", "solver", "localization"))
+    # swarmcheck-ON variants: the sanitized programs themselves must be
+    # transfer-free, cache-stable, and f64-clean — the "no host syncs in
+    # the happy path" half of the sanitizer contract. Excluded from the
+    # zero-cost baseline (they differ from it by construction).
+    register_entry("sim.engine.rollout[checked]", engine.rollout,
+                   static_argnames=("n_ticks", "cfg"),
+                   build=partial(_build_rollout, check=True),
+                   axes=("n", "solver", "faults", "localization"),
+                   baseline=False)
+    register_entry("sim.summary.batched_rollout_summary[checked]",
+                   summary.batched_rollout_summary,
+                   static_argnames=("cfg", "n_ticks", "window",
+                                    "pose_every"),
+                   build=partial(_build_rollout_summary, check=True),
+                   axes=("n", "B", "solver", "faults", "localization"),
+                   baseline=False)
 
 
 _install_default_registry()
@@ -402,6 +446,140 @@ def iter_grid(slow: bool = False) -> Iterable[GridPoint]:
                                     faults=faults, localization=loc)
 
 
+# ---------------------------------------------------------------------------
+# zero-cost-off proof (swarmcheck; docs/STATIC_ANALYSIS.md runtime tier)
+
+HLO_BASELINE_PATH = Path(__file__).resolve().parent / "hlo_baseline.json"
+
+
+def grid_key(entry: EntryPoint, gp: GridPoint) -> str:
+    """Stable baseline key: entry name + the axes it varies over."""
+    return f"{entry.name}|" + ",".join(
+        f"{a}={getattr(gp, a)}" for a in entry.axes)
+
+
+def entry_hlo(entry: EntryPoint, gp: GridPoint) -> str:
+    """Lower one entry at one grid point (f32 mode, abstract inputs) and
+    return the HLO text. The text carries no source locations or
+    metadata (verified at baseline capture), so editing the defining
+    files without changing the traced computation cannot perturb it."""
+    import jax
+
+    with f32_mode():
+        fn = getattr(entry.fn, "__wrapped__", entry.fn)
+        wrapper = jax.jit(partial(fn),
+                          static_argnames=entry.static_argnames)
+        args, statics = entry.build(gp)
+        args = _commit(args)
+        return wrapper.lower(*_shape_only(args), **statics).as_text()
+
+
+def hlo_digest(entry: EntryPoint, gp: GridPoint) -> str:
+    return hashlib.sha256(entry_hlo(entry, gp).encode()).hexdigest()
+
+
+def _iter_baseline_cells(slow: bool = False):
+    """(entry, gp, key) for every baseline-participating grid cell."""
+    for entry in ENTRY_POINTS:
+        if not entry.baseline:
+            continue
+        seen = set()
+        for gp in iter_grid(slow):
+            dedup = tuple(getattr(gp, a) for a in entry.axes)
+            if dedup in seen:
+                continue
+            seen.add(dedup)
+            yield entry, gp, grid_key(entry, gp)
+
+
+def verify_zero_cost_off(slow: bool = False) -> dict:
+    """PROVE check_mode=off is free: every baseline entry's lowered HLO
+    digest must equal the committed pre-swarmcheck capture.
+
+    Returns ``{"skipped": reason | None, "checked": int,
+    "mismatches": [key, ...], "uncovered": [key, ...],
+    "unverified": [key, ...]}`` — ``skipped`` is set (and nothing
+    compared) when the environment cannot reproduce the baseline
+    (different jax version or backend); ``uncovered`` lists committed
+    digests no registered entry produced, and ``unverified`` lists
+    tier-1 baseline-participating cells with NO committed digest (a
+    newly registered entry point is not proven zero-cost until the
+    baseline is regenerated) — deleting, renaming, or adding entries
+    must regenerate the baseline, never silently change coverage.
+    """
+    import jax
+
+    def skip(reason):
+        return {"skipped": reason, "checked": 0, "mismatches": [],
+                "uncovered": [], "unverified": []}
+
+    if not HLO_BASELINE_PATH.exists():
+        return skip(f"no baseline at {HLO_BASELINE_PATH}")
+    base = json.loads(HLO_BASELINE_PATH.read_text())
+    if base.get("jax_version") != jax.__version__:
+        return skip(f"baseline captured on jax "
+                    f"{base.get('jax_version')}, running "
+                    f"{jax.__version__} (HLO text is version-specific; "
+                    "regenerate with --write-hlo-baseline)")
+    if base.get("backend") != jax.default_backend():
+        return skip(f"baseline captured on {base.get('backend')!r}, "
+                    f"running {jax.default_backend()!r}")
+    digests = base["digests"]
+    mismatches, covered, unverified = [], set(), []
+    checked = 0
+    for entry, gp, key in _iter_baseline_cells(slow):
+        if key not in digests:
+            # a registered baseline entry with no committed digest is
+            # NOT proven zero-cost — surface it, unless the builder
+            # does not support the cell at all (raises Skip: then the
+            # capture legitimately has no digest either). Tier-1 cells
+            # only: the committed baseline deliberately covers the
+            # fast grid.
+            try:
+                with f32_mode():
+                    entry.build(gp)
+            except Skip:
+                continue
+            if not slow or key in {
+                    k for _, _, k in _iter_baseline_cells(False)}:
+                unverified.append(key)
+            continue
+        try:
+            d = hlo_digest(entry, gp)
+        except Skip:
+            # a cell with a committed digest that the builder now skips
+            # must surface as `uncovered`, not silently pass — so mark
+            # coverage only AFTER a successful lowering
+            continue
+        covered.add(key)
+        checked += 1
+        if d != digests[key]:
+            mismatches.append(key)
+    return {"skipped": None, "checked": checked, "mismatches": mismatches,
+            "uncovered": sorted(set(digests) - covered),
+            "unverified": sorted(unverified)}
+
+
+def write_hlo_baseline(slow: bool = False) -> int:
+    """(Re)capture the zero-cost-off baseline from the CURRENT tree.
+
+    Only legal when the compiled surface intentionally changed — the
+    committed JSON diff is the review artifact that says so."""
+    import jax
+
+    digests = {}
+    for entry, gp, key in _iter_baseline_cells(slow):
+        try:
+            digests[key] = hlo_digest(entry, gp)
+        except Skip:
+            continue
+    HLO_BASELINE_PATH.write_text(json.dumps(
+        {"jax_version": jax.__version__,
+         "backend": jax.default_backend(), "digests": digests},
+        indent=1, sort_keys=True) + "\n")
+    return len(digests)
+
+
 def audit_all(slow: bool = False) -> list[AuditReport]:
     """Audit every registered entry across the grid (deduplicating grid
     points an entry does not vary over)."""
@@ -420,13 +598,46 @@ def audit_all(slow: bool = False) -> list[AuditReport]:
     return reports
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="jaxcheck layer 2: trace-time compile/transfer audit "
+        "+ swarmcheck zero-cost-off proof")
+    ap.add_argument("--slow", action="store_true",
+                    help="cross the full n=16/B=4 grid")
+    ap.add_argument("--write-hlo-baseline", action="store_true",
+                    help="recapture hlo_baseline.json from the current "
+                    "tree (ONLY when the compiled surface intentionally "
+                    "changed; the JSON diff is the review artifact)")
+    ap.add_argument("--skip-hlo", action="store_true",
+                    help="audit only; skip the zero-cost-off comparison")
+    args = ap.parse_args(argv)
+
+    if args.write_hlo_baseline:
+        n = write_hlo_baseline(slow=args.slow)
+        print(f"wrote {n} digests to {HLO_BASELINE_PATH}")
+        return 0
+
     ok = True
-    for r in audit_all():
+    for r in audit_all(slow=args.slow):
         status = "ok" if r.ok else "FAIL"
         print(f"{status:4s} {r.name} {r.grid} compiles={r.n_compiles} "
               f"f64={list(r.f64_leaves)}")
         ok &= r.ok
+
+    if not args.skip_hlo:
+        z = verify_zero_cost_off(slow=args.slow)
+        if z["skipped"]:
+            print(f"zero-cost-off: SKIPPED ({z['skipped']})")
+        else:
+            status = "ok" if not (z["mismatches"] or z["uncovered"]
+                                  or z["unverified"]) else "FAIL"
+            print(f"{status:4s} zero-cost-off: {z['checked']} entry "
+                  f"cells match the pre-swarmcheck baseline; "
+                  f"mismatches={z['mismatches']} "
+                  f"uncovered={z['uncovered']} "
+                  f"unverified={z['unverified']}")
+            ok &= status == "ok"
     return 0 if ok else 1
 
 

@@ -65,6 +65,22 @@ def sinkhorn_log(cost: jnp.ndarray, tau: float = 0.03,
     return logK + f[:, None] + g[None, :]
 
 
+def marginal_errors(plan_log: jnp.ndarray
+                    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """L1 marginal errors of a log transport plan: ``(row_err, col_err)``
+    where each is ``sum_i |mass_i - 1/n|`` over rows / columns. The
+    swarmcheck `sinkhorn_marginal` contract thresholds these
+    (`analysis.invariants.SINKHORN_MARGINAL_TOL`) — a converged
+    iteration leaves both far below any practical tolerance, a broken
+    one (bad temperature, truncated loop, corrupted cost) does not."""
+    n = plan_log.shape[0]
+    target = 1.0 / n
+    row_mass = jnp.exp(jax.nn.logsumexp(plan_log, axis=1))
+    col_mass = jnp.exp(jax.nn.logsumexp(plan_log, axis=0))
+    return (jnp.sum(jnp.abs(row_mass - target)),
+            jnp.sum(jnp.abs(col_mass - target)))
+
+
 def round_to_permutation(plan_log: jnp.ndarray) -> jnp.ndarray:
     """Greedy rounding: repeatedly take the global max entry, strike its row
     and column. Always yields a valid permutation in n steps."""

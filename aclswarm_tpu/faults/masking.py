@@ -65,7 +65,9 @@ def apply_pin_forbid(c: jnp.ndarray, pin: jnp.ndarray,
     huge constant would stretch its scaling phases for nothing). Single
     home of the magnitude rule — the Sinkhorn path masks both its
     normalized and raw costs through this same helper."""
-    big = 4.0 * (jnp.max(c) + 1.0)
+    # full-fleet max is INTENTIONAL: `big` is a magnitude bound and must
+    # dominate every entry the solver can see, dead rows included
+    big = 4.0 * (jnp.max(c) + 1.0)      # jaxcheck: disable=JC006
     return jnp.where(pin, jnp.zeros((), c.dtype),
                      jnp.where(forbid, big.astype(c.dtype), c))
 

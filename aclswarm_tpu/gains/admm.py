@@ -52,6 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from aclswarm_tpu.analysis import invariants as invlib
 from aclswarm_tpu.gains.reference import AdmmParams
 
 
@@ -158,9 +159,15 @@ def _constraint_system(Q: jnp.ndarray, i_idx: jnp.ndarray,
 
 def _subproblem(Q: jnp.ndarray, i_idx: jnp.ndarray, j_idx: jnp.ndarray,
                 valid: jnp.ndarray, d: int,
-                params: AdmmParams) -> jnp.ndarray:
+                params: AdmmParams, check: bool = False) -> jnp.ndarray:
     """Solve one (2D or 1D) gain subproblem; returns the full-space gains
-    -Q Abar Q^T (`solver.cpp:143,207`)."""
+    -Q Abar Q^T (`solver.cpp:143,207`).
+
+    ``check=True`` additionally threads the swarmcheck `admm_residual`
+    contract through the iteration carry (first/last diffX) and returns
+    ``(gains, code)`` — code 0 unless the loop finished neither
+    converged nor with a net residual decrease. Python-gated: with
+    ``check=False`` the carry and the lowered HLO are unchanged."""
     dtype = Q.dtype
     dm = Q.shape[1]
     mu = params.mu
@@ -311,11 +318,11 @@ def _subproblem(Q: jnp.ndarray, i_idx: jnp.ndarray, j_idx: jnp.ndarray,
     S0 = jnp.zeros_like(X0)
 
     def cond(carry):
-        X, S, it, stop = carry
+        X, S, it, stop = carry[:4]
         return (~stop) & (it < params.max_itr)
 
     def body(carry):
-        X, S, it, _ = carry
+        X, S, it, _ = carry[:4]
         W = W_of(C - S - mu * X) + S
         Snew = psd_part(W)
         Xnew = (Snew - W) / mu
@@ -323,15 +330,28 @@ def _subproblem(Q: jnp.ndarray, i_idx: jnp.ndarray, j_idx: jnp.ndarray,
         tr = jnp.trace(Xnew[dm:, dm:])
         stop = (diffX < params.thresh) | \
                ((tr - dm) / dm < params.thresh_tr)   # signed, solver.cpp:328
-        return Xnew, Snew, it + 1, stop
+        out = (Xnew, Snew, it + 1, stop)
+        if check:
+            out = out + (jnp.where(it == 0, diffX, carry[4]), diffX)
+        return out
 
-    X, S, _, _ = lax.while_loop(cond, body,
-                                (X0, S0, jnp.asarray(0, jnp.int32), jnp.asarray(False)))
+    carry0 = (X0, S0, jnp.asarray(0, jnp.int32), jnp.asarray(False))
+    if check:
+        carry0 = carry0 + (jnp.zeros((), dtype), jnp.zeros((), dtype))
+    fin = lax.while_loop(cond, body, carry0)
+    X, S = fin[0], fin[1]
 
     # final projection with S = 0 (`solver.cpp:333-346`)
     W = W_of(C - mu * X)
     X22 = (-W / mu)[dm:, dm:]
-    return -(Q @ X22 @ Q.T)
+    gains = -(Q @ X22 @ Q.T)
+    if check:
+        code = jnp.where(
+            invlib.admm_residual_violated(fin[4], fin[5], fin[3]),
+            jnp.asarray(invlib.CODES["admm_residual"], jnp.int32),
+            jnp.zeros((), jnp.int32))
+        return gains, code
+    return gains
 
 
 def _kernel_2d(pts_xy: jnp.ndarray) -> jnp.ndarray:
@@ -360,14 +380,22 @@ def _kernel_1d(pts_z: jnp.ndarray, planar: bool) -> jnp.ndarray:
     return U[:, N.shape[1]:]
 
 
-@partial(jax.jit, static_argnames=("planar", "params"))
+@partial(jax.jit, static_argnames=("planar", "params", "check_mode"))
 def _solve_jit(points: jnp.ndarray, i_idx: jnp.ndarray, j_idx: jnp.ndarray,
                valid: jnp.ndarray, adjmask: jnp.ndarray, planar: bool,
-               params: AdmmParams) -> jnp.ndarray:
-    A2d = _subproblem(_kernel_2d(points[:, :2]), i_idx, j_idx, valid, 2,
-                      params)
-    A1d = _subproblem(_kernel_1d(points[:, 2], planar), i_idx, j_idx, valid,
-                      1, params)
+               params: AdmmParams,
+               check_mode: str = "off") -> jnp.ndarray:
+    check = check_mode == "on"
+    if check:
+        A2d, code2 = _subproblem(_kernel_2d(points[:, :2]), i_idx, j_idx,
+                                 valid, 2, params, check=True)
+        A1d, code1 = _subproblem(_kernel_1d(points[:, 2], planar), i_idx,
+                                 j_idx, valid, 1, params, check=True)
+    else:
+        A2d = _subproblem(_kernel_2d(points[:, :2]), i_idx, j_idx, valid, 2,
+                          params)
+        A1d = _subproblem(_kernel_1d(points[:, 2], planar), i_idx, j_idx,
+                          valid, 1, params)
     n = points.shape[0]
     out = jnp.zeros((n, 3, n, 3), points.dtype)
     out = out.at[:, :2, :, :2].set(A2d.reshape(n, 2, n, 2))
@@ -379,11 +407,15 @@ def _solve_jit(points: jnp.ndarray, i_idx: jnp.ndarray, j_idx: jnp.ndarray,
     out = jnp.where(adjmask[:, None, :, None], out, 0.0)
     flat = out.reshape(3 * n, 3 * n)
     # kill numerically-zero entries (`solver.cpp:144,208`)
-    return jnp.where(jnp.abs(flat) > params.thr_sparse_zero, flat, 0.0)
+    flat = jnp.where(jnp.abs(flat) > params.thr_sparse_zero, flat, 0.0)
+    if check:
+        return flat, jnp.maximum(code2, code1)
+    return flat
 
 
 def solve_gains(points, adj, params: AdmmParams | None = None,
-                max_nonedges: int | None = None) -> jnp.ndarray:
+                max_nonedges: int | None = None,
+                check_mode: str = "off") -> jnp.ndarray:
     """Design (3n, 3n) formation gains on device.
 
     The graph enters as *traced* padded index arrays, so one compiled
@@ -393,8 +425,18 @@ def solve_gains(points, adj, params: AdmmParams | None = None,
     reference re-parses its sparse constraint system per formation,
     `solver.cpp:351-694`). Default bucket = the exact non-edge count.
     Planarity stays compile-time (two buckets at most).
+
+    ``check_mode='on'`` compiles the swarmcheck `admm_residual` contract
+    into both subproblem iterations and raises a structured
+    `InvariantViolation` if either finished neither converged nor with a
+    net residual decrease (the host sync this costs sits on the
+    dispatch-time gain-design path, not in a rollout).
     """
     params = params or AdmmParams()
+    if check_mode not in ("off", "on"):
+        # same contract as engine.step: a typo'd mode must not silently
+        # run unchecked while the caller believes it sanitized
+        raise ValueError(f"unknown check_mode {check_mode!r}")
     adj_np = np.asarray(adj)  # the graph is always concrete (host config)
     n = adj_np.shape[0]
     iu, ju = np.triu_indices(n, k=1)
@@ -418,6 +460,16 @@ def solve_gains(points, adj, params: AdmmParams | None = None,
     else:
         planar = bool(np.std(np.asarray(points)[:, 2], ddof=1)
                       < params.thr_planar)
+    if check_mode == "on":
+        gains, code = _solve_jit(jnp.asarray(points), jnp.asarray(i_idx),
+                                 jnp.asarray(j_idx), jnp.asarray(valid),
+                                 jnp.asarray(adjmask), planar, params,
+                                 check_mode="on")
+        code = int(code)   # deliberate host sync: dispatch-time path
+        if code:
+            raise invlib.InvariantViolation(invlib.contract_of(code),
+                                            tick=-1)
+        return gains
     return _solve_jit(jnp.asarray(points), jnp.asarray(i_idx),
                       jnp.asarray(j_idx), jnp.asarray(valid),
                       jnp.asarray(adjmask), planar, params)

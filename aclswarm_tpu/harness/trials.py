@@ -82,6 +82,11 @@ class TrialConfig:
     # accept-if-better-by margin for centralized auctions (see
     # `SimConfig.assign_eps`; 0.0 = reference accept-any-different)
     assign_eps: float = 0.0
+    # swarmcheck sanitizer ('off' | 'on', `SimConfig.check_mode`): 'on'
+    # compiles the invariant contracts into the rollout and raises a
+    # structured `InvariantViolation` (trial + tick + contract) the
+    # moment a chunk's synced codes show one. 'off' is proven zero-cost.
+    check_mode: str = "off"
     colavoid_neighbors: Optional[int] = None
     chunk_ticks: int = 50           # FSM action latency bound (0.5 s)
     # initial-condition sampling (trial.sh:7-9: 20 x 20 area, r=0.75)
@@ -240,6 +245,7 @@ def _engine_kw(cfg: TrialConfig) -> dict:
                 colavoid_neighbors=cfg.colavoid_neighbors,
                 assign_eps=cfg.assign_eps,
                 cbaa_task_block=cfg.cbaa_task_block,
+                check_mode=cfg.check_mode,
                 flight_fsm=True)
 
 
@@ -298,7 +304,8 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
     gains_cache: dict[int, np.ndarray] = {}
 
     state = sim.init_state(q0, flying=False,
-                           localization=cfg.localization == "flooded")
+                           localization=cfg.localization == "flooded",
+                           checks=cfg.check_mode == "on")
     fsm = TrialFSM(n, len(specs), takeoff_alt=sparams.takeoff_alt,
                    dt=cfg.control_dt, trial_timeout=trial_timeout)
     cgains = _trial_cgains(cfg)
@@ -313,6 +320,7 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
     chunk = cfg.chunk_ticks
     max_ticks = int(trial_timeout / cfg.control_dt) + 10 * chunk
     recorded: list = []
+    ticks_done = 0
 
     for _ in range(max_ticks // chunk + 1):
         if fsm.done:
@@ -330,6 +338,14 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
                                      cur_cfg, chunk, inputs)
         if cfg.record_dir is not None:
             recorded.append(metrics)
+        if cfg.check_mode == "on":
+            # the codes ride the metric stack this driver already syncs;
+            # tick0 is the trial's wall tick (the engine's own per-trial
+            # tick counter re-phases at each formation dispatch)
+            from aclswarm_tpu.analysis import invariants as invlib
+            invlib.raise_on_violation(np.asarray(metrics.inv_code),
+                                      trial=trial_idx, tick0=ticks_done)
+        ticks_done += chunk
         q = np.asarray(metrics.q)
         dn = np.asarray(metrics.distcmd_norm)
         ca = np.asarray(metrics.ca_active)
@@ -464,7 +480,9 @@ def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
                          "be a multiple of flood_every (shared flood "
                          "phase)")
 
-    states = [sim.init_state(q0, flying=False, localization=flooded)
+    checks = cfg.check_mode == "on"
+    states = [sim.init_state(q0, flying=False, localization=flooded,
+                             checks=checks)
               for q0 in q0s]
     bstate = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
     # pre-dispatch: auctions off per trial (the batch shares ONE compiled
@@ -490,11 +508,13 @@ def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
                             trial_timeout=trial_timeout)
             for _ in range(B)]
     all_fsms = list(fsms)       # original trial order, for the return
+    torig = list(trial_indices)  # original trial index per current row
     scarry = sumlib.init_carry(n, window, dtype=dtype, batch=B)
     gains_cache: list[dict] = [dict() for _ in range(B)]
     pending_go = [False] * B
     pending_dispatch: list[Optional[int]] = [None] * B
     max_ticks = int(trial_timeout / dt) + 10 * chunk
+    ticks_done = 0
     joy_vel = jnp.zeros((chunk, B, n, 3), dtype)
     joy_yawrate = jnp.zeros((chunk, B, n), dtype)
     joy_active = jnp.zeros((chunk, B, n), bool)
@@ -516,6 +536,7 @@ def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
             bform = jax.tree.map(lambda x: x[idx], bform)
             scarry = jax.tree.map(lambda x: x[idx], scarry)
             fsms = [fsms[k] for k in keep]
+            torig = [torig[k] for k in keep]
             specs_per = [specs_per[k] for k in keep]
             gains_cache = [gains_cache[k] for k in keep]
             pending_go = [pending_go[k] for k in keep]
@@ -539,6 +560,18 @@ def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
             inputs, 0, window=window, takeoff_alt=takeoff_alt)
 
         # the chunk's ONLY host sync: O(B*chunk) bools + (B, n) distances
+        if checks:
+            # swarmcheck codes ride that same sync ((B, T) int32); the
+            # first live trial with a violation aborts the wave with
+            # per-trial attribution
+            from aclswarm_tpu.analysis import invariants as invlib
+            inv_codes = np.asarray(summ.inv_code)
+            for b, fsm in enumerate(fsms):
+                if not fsm.done:
+                    invlib.raise_on_violation(inv_codes[b],
+                                              trial=torig[b],
+                                              tick0=ticks_done)
+        ticks_done += chunk
         conv = np.asarray(summ.conv_all)
         grid = np.asarray(summ.grid_any)
         toff = np.asarray(summ.taken_off)

@@ -56,7 +56,8 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def sim_state_sharding(mesh: Mesh, localization: bool = False,
-                       faults: bool = False) -> sim.SimState:
+                       faults: bool = False,
+                       checks: bool = False) -> sim.SimState:
     """Sharding pytree for `sim.SimState`: per-agent leaves row-sharded.
 
     ``localization=True`` matches states built with
@@ -69,7 +70,13 @@ def sim_state_sharding(mesh: Mesh, localization: bool = False,
     ``faults=True`` matches states carrying a `FaultSchedule`: the
     per-vehicle timelines and the (n, n) link-loss matrix shard on the
     vehicle/receiver axis; the trial seed replicates (every shard draws
-    the identical per-tick link lottery)."""
+    the identical per-tick link lottery).
+
+    ``checks=True`` matches states built with
+    ``init_state(..., checks=True)``: the swarmcheck error carry is a
+    pair of scalars, replicated (every shard records the identical
+    first-violation code)."""
+    from aclswarm_tpu.analysis.invariants import InvariantState
     from aclswarm_tpu.faults import FaultSchedule
 
     row = row_sharding(mesh)
@@ -83,7 +90,8 @@ def sim_state_sharding(mesh: Mesh, localization: bool = False,
         v2f=row, tick=rep,
         flight=sim.FlightState(mode=row, ticks_in_mode=row,
                                initial_alt=row, takeoff_alt=row),
-        loc=loc, first_auction=rep, assign_enabled=rep, faults=fsched)
+        loc=loc, first_auction=rep, assign_enabled=rep, faults=fsched,
+        inv=InvariantState(code=rep, tick=rep) if checks else None)
 
 
 def formation_sharding(mesh: Mesh) -> Formation:
@@ -99,7 +107,8 @@ def formation_sharding(mesh: Mesh) -> Formation:
 def shard_problem(state: sim.SimState, formation, mesh: Mesh):
     """Place a sim state + formation onto the mesh with the standard layout."""
     st_sh = sim_state_sharding(mesh, localization=state.loc is not None,
-                               faults=state.faults is not None)
+                               faults=state.faults is not None,
+                               checks=state.inv is not None)
     f_sh = formation_sharding(mesh)
     return (jax.device_put(state, st_sh), jax.device_put(formation, f_sh),
             st_sh, f_sh)

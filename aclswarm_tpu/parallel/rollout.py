@@ -30,11 +30,20 @@ def _loc_in_sharding(cfg, localization):
         else localization
 
 
+def _checks_in_sharding(cfg, checks):
+    """Same contract as `_loc_in_sharding`, for the swarmcheck error
+    carry: the sharding spec's `inv` entry must match the state's pytree
+    (an `InvariantState` iff built with init_state(checks=True))."""
+    return (cfg.check_mode == "on") if checks is None else checks
+
+
 def sharded_step_fn(mesh, formation_sharded, gains, sparams, cfg,
-                    localization: bool | None = None):
+                    localization: bool | None = None,
+                    checks: bool | None = None):
     """Build a jitted, mesh-sharded single-tick function state -> state."""
     st_sh = meshlib.sim_state_sharding(
-        mesh, localization=_loc_in_sharding(cfg, localization))
+        mesh, localization=_loc_in_sharding(cfg, localization),
+        checks=_checks_in_sharding(cfg, checks))
 
     @partial(jax.jit, in_shardings=(st_sh,),
              out_shardings=(st_sh, meshlib.replicated(mesh)))
@@ -45,10 +54,12 @@ def sharded_step_fn(mesh, formation_sharded, gains, sparams, cfg,
 
 
 def sharded_rollout_fn(mesh, formation_sharded, gains, sparams, cfg,
-                       n_ticks: int, localization: bool | None = None):
+                       n_ticks: int, localization: bool | None = None,
+                       checks: bool | None = None):
     """Build a jitted, mesh-sharded rollout (lax.scan of the sharded step)."""
     st_sh = meshlib.sim_state_sharding(
-        mesh, localization=_loc_in_sharding(cfg, localization))
+        mesh, localization=_loc_in_sharding(cfg, localization),
+        checks=_checks_in_sharding(cfg, checks))
 
     @partial(jax.jit, in_shardings=(st_sh,), static_argnums=())
     def roll(state):
@@ -64,13 +75,15 @@ def _prepend_batch_axis(sharding: NamedSharding) -> NamedSharding:
     return NamedSharding(sharding.mesh, P(*((None,) + tuple(sharding.spec))))
 
 
-def batched_sim_state_sharding(mesh, localization: bool = False):
+def batched_sim_state_sharding(mesh, localization: bool = False,
+                               checks: bool = False):
     """Sharding pytree for a trial-batched `SimState` (leaves (B, ...)):
     batch axis replicated, per-agent axes row-sharded as in
     `mesh.sim_state_sharding`."""
     return jax.tree.map(
         _prepend_batch_axis,
-        meshlib.sim_state_sharding(mesh, localization=localization),
+        meshlib.sim_state_sharding(mesh, localization=localization,
+                                   checks=checks),
         is_leaf=lambda x: isinstance(x, NamedSharding))
 
 
@@ -82,7 +95,8 @@ def batched_formation_sharding(mesh):
 
 
 def batched_rollout_fn(mesh, formation_batched, gains, sparams, cfg,
-                       n_ticks: int, localization: bool | None = None):
+                       n_ticks: int, localization: bool | None = None,
+                       checks: bool | None = None):
     """Build a jitted rollout combining BOTH scaling axes: vmap over the
     trial batch (outer, replicated — trials are independent) and GSPMD
     sharding over the agent axis (inner — the collectives of
@@ -91,7 +105,8 @@ def batched_rollout_fn(mesh, formation_batched, gains, sparams, cfg,
     `StepMetrics`), one compiled program per chunk for B x n_ticks ticks.
     """
     st_sh = batched_sim_state_sharding(
-        mesh, localization=_loc_in_sharding(cfg, localization))
+        mesh, localization=_loc_in_sharding(cfg, localization),
+        checks=_checks_in_sharding(cfg, checks))
 
     @partial(jax.jit, in_shardings=(st_sh,), donate_argnums=(0,))
     def roll(state):

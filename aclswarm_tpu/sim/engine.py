@@ -38,6 +38,8 @@ from flax import struct
 from jax import lax
 
 from aclswarm_tpu import control
+from aclswarm_tpu.analysis import invariants as invlib
+from aclswarm_tpu.analysis.invariants import InvariantState
 from aclswarm_tpu.assignment import auction, cbaa, sinkhorn
 from aclswarm_tpu.core import geometry
 from aclswarm_tpu.core import perm as permutil
@@ -118,6 +120,15 @@ class SimConfig:
     # A 1% margin breaks the loop; genuinely better assignments (trapped
     # agents, gridlock escapes) still pass.
     assign_eps: float = struct.field(pytree_node=False, default=0.0)
+    # swarmcheck sanitizer tier (`aclswarm_tpu.analysis.invariants`):
+    # 'off' = no checks, PROVEN zero-cost (every check site is
+    # Python-gated on this static flag, so the lowered HLO is
+    # bit-identical to the uninstrumented program —
+    # `trace_audit.verify_zero_cost_off`); 'on' = compile the invariant
+    # contracts into the rollout, recording the first violation per
+    # trial into the `SimState.inv` carry (requires
+    # `init_state(..., checks=True)`)
+    check_mode: str = struct.field(pytree_node=False, default="off")
 
 
 @struct.dataclass
@@ -152,6 +163,11 @@ class SimState:
     # data, so batched trials may carry different scripts (and a no-fault
     # schedule is bit-identical to None; tests/test_faults.py).
     faults: FaultSchedule | None = None
+    # swarmcheck error carry (`analysis.invariants`): None = sanitizer
+    # structurally absent (the zero-cost-off mode). An `InvariantState`
+    # records the first contract violation (code + per-trial tick) as
+    # plain data, so batched trials attribute violations per trial.
+    inv: InvariantState | None = None
 
 
 @struct.dataclass
@@ -169,18 +185,26 @@ class StepMetrics:
     # fault observables (None unless the state carries a FaultSchedule)
     alive: jnp.ndarray | None = None        # (n,) bool alive mask this tick
     fault_event: jnp.ndarray | None = None  # () bool: any alive bit flipped
+    # swarmcheck code after the tick (None unless cfg.check_mode='on'):
+    # 0 = clean so far, else the FIRST violated contract's code
+    # (`analysis.invariants.CONTRACTS`) — rides the metric stack so
+    # drivers surface (trial, tick, contract) without extra host syncs
+    inv_code: jnp.ndarray | None = None     # () int32
 
 
 def init_state(q0, v2f0=None, flying: bool = True,
                localization: bool = False,
-               faults: FaultSchedule | None = None) -> SimState:
+               faults: FaultSchedule | None = None,
+               checks: bool = False) -> SimState:
     """``flying=True`` starts airborne in FLYING (historical rollouts);
     ``flying=False`` starts NOT_FLYING on the ground — send CMD_GO via
     `ExternalInputs` to take off (requires ``cfg.flight_fsm``).
     ``localization=True`` allocates the estimate tables (required iff the
     rollout runs with ``cfg.localization='flooded'``).
     ``faults`` attaches a fault script (`aclswarm_tpu.faults`); None keeps
-    the fault-free engine."""
+    the fault-free engine.
+    ``checks=True`` allocates the swarmcheck error carry (required iff
+    the rollout runs with ``cfg.check_mode='on'``)."""
     # explicit strong dtype: a dtype-less asarray would inherit whatever
     # the caller passed (list vs np array vs f32 array), and every distinct
     # aval retraces the whole rollout (jaxcheck JC003)
@@ -196,15 +220,20 @@ def init_state(q0, v2f0=None, flying: bool = True,
         flight=vehicle.init_flight(n, q0.dtype, flying=flying),
         loc=loclib.init_table(q0) if localization else None,
         first_auction=jnp.asarray(True),
-        faults=faults)
+        faults=faults,
+        inv=invlib.init_invariants() if checks else None)
 
 
 def assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
            cfg: SimConfig, est: jnp.ndarray | None = None,
            first: jnp.ndarray | None = None,
            alive: jnp.ndarray | None = None,
-           link_mask: jnp.ndarray | None = None):
-    """One re-assignment: returns (new v2f, valid flag).
+           link_mask: jnp.ndarray | None = None,
+           check: bool = False):
+    """One re-assignment: returns (new v2f, valid flag) — plus a ()
+    int32 swarmcheck code (0 = clean) when ``check`` is set, carrying
+    solver-level contract violations (currently the Sinkhorn marginal
+    tolerance) out of the assignment `lax.cond` branch.
 
     'auction' follows the centralized path (`assignment.py:94-137`): order the
     swarm by the *last* assignment, globally align the formation (d=2), then
@@ -250,6 +279,7 @@ def assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
         take = (cost_new < (1.0 - cfg.assign_eps) * cost_cur) | first
         return jnp.where(take, cand, v2f)
 
+    clean = jnp.zeros((), jnp.int32)
     if cfg.assignment == "auction":
         q_form = permutil.veh_to_formation_order(swarm.q, v2f)
         paligned = geometry.align(formation.points, q_form, d=2)
@@ -258,6 +288,8 @@ def assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
             c = faultmask.mask_cost(c, alive, v2f)
         res = auction.auction_lap(-c)
         new_v2f = jnp.where(res.valid, _hysteresis(res.row_to_col, c), v2f)
+        if check:
+            return new_v2f, res.valid, clean
         return new_v2f, res.valid
     elif cfg.assignment == "sinkhorn":
         q_form = permutil.veh_to_formation_order(swarm.q, v2f)
@@ -274,6 +306,16 @@ def assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
                 c = faultmask.mask_cost(c, alive, v2f)
         else:
             c = None  # cfg is static; skip the matrix when unused
+        if check:
+            # marginal contract on the *transport plan* the rounding
+            # consumed (the rounded permutation itself is covered by the
+            # engine-level assign_perm contract)
+            row_err, col_err = sinkhorn.marginal_errors(res.plan_log)
+            code = jnp.where(
+                invlib.sinkhorn_marginals_violated(row_err, col_err),
+                jnp.asarray(invlib.CODES["sinkhorn_marginal"], jnp.int32),
+                clean)
+            return _hysteresis(res.row_to_col, c), jnp.asarray(True), code
         return _hysteresis(res.row_to_col, c), jnp.asarray(True)
     elif cfg.assignment == "cbaa":
         res = cbaa.cbaa_from_state(swarm.q, formation.points,
@@ -281,8 +323,12 @@ def assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
                                    task_block=cfg.cbaa_task_block,
                                    alive=alive, comm_extra=link_mask)
         new_v2f = jnp.where(res.valid, res.v2f, v2f)
+        if check:
+            return new_v2f, res.valid, clean
         return new_v2f, res.valid
     elif cfg.assignment == "none":
+        if check:
+            return v2f, jnp.asarray(True), clean
         return v2f, jnp.asarray(True)
     raise ValueError(f"unknown assignment mode {cfg.assignment!r}")
 
@@ -311,6 +357,25 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
         inputs = ExternalInputs.none(n, swarm.q.dtype)
     tick_src = state.tick if shared_tick is None else shared_tick
 
+    # --- swarmcheck sanitizer (`analysis.invariants`): every check site
+    # below is Python-gated on the STATIC `cfg.check_mode`, so 'off'
+    # lowers to bit-identical HLO (proven per entry point by
+    # `trace_audit.verify_zero_cost_off`). Recording order = blame
+    # priority (first violation wins; see invariants.CONTRACTS).
+    if cfg.check_mode not in ("off", "on"):
+        raise ValueError(f"unknown check_mode {cfg.check_mode!r}")
+    checks = cfg.check_mode == "on"
+    inv = state.inv
+    if checks:
+        if inv is None:
+            raise ValueError(
+                "cfg.check_mode='on' needs init_state(..., checks=True): "
+                "the sanitizer records violations into the SimState.inv "
+                "carry, which must exist in the state pytree")
+        inv = invlib.record(inv,
+                            invlib.adjacency_asymmetric(formation.adjmat),
+                            "adj_sym", state.tick)
+
     # --- fault model (`aclswarm_tpu.faults`): masks, not control flow ---
     # keyed on the PER-TRIAL `state.tick` (plain data, so batched trials
     # carry different scripts under one vmap), never on the shared
@@ -323,6 +388,10 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
         # draw spares it; receiver-major like every comm mask
         link_mask = link_up & alive[:, None] & alive[None, :]
         fault_event = faultlib.fault_event_at(faults, state.tick)
+        if checks:
+            inv = invlib.record(
+                inv, invlib.alive_mask_stale(alive, faults, state.tick),
+                "mask_consistency", state.tick)
     else:
         alive = link_mask = fault_event = None
 
@@ -377,6 +446,26 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
     if cfg.assignment == "none":
         new_v2f, valid = v2f, jnp.asarray(True)
         take = jnp.asarray(False)
+    elif checks:
+        # checked variant of the cond below: the solver-level contract
+        # code rides out of the branch alongside the candidate (the
+        # no-assign branch reports clean)
+        cand_v2f, cand_valid, cand_code = lax.cond(
+            do_assign,
+            lambda s, f, p, e: assign(s, f, p, cfg, e,
+                                      first=state.first_auction,
+                                      alive=alive, link_mask=link_mask,
+                                      check=True),
+            lambda s, f, p, e: (p, jnp.asarray(True),
+                                jnp.zeros((), jnp.int32)),
+            swarm, formation, v2f, est)
+        take = do_assign & gate
+        new_v2f = jnp.where(take, cand_v2f, v2f)
+        valid = jnp.where(take, cand_valid, True)
+        # a gated-off candidate is discarded, so its violations are too
+        inv = invlib.record_code(
+            inv, jnp.where(take, cand_code, jnp.zeros((), jnp.int32)),
+            state.tick)
     else:
         cand_v2f, cand_valid = lax.cond(
             do_assign,
@@ -392,6 +481,12 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
     auctioned = take
     first_auction = state.first_auction & ~(auctioned & valid)
     v2f = new_v2f
+    if checks:
+        # the permutation contract covers every solver's output AND the
+        # held assignment (a corrupted v2f0 or a bad hysteresis merge
+        # trips here even on non-auction ticks)
+        inv = invlib.record(inv, invlib.perm_violated(v2f),
+                            "assign_perm", state.tick)
 
     # --- distributed control law -> distcmd (§3.3) ---
     rel = None if est is None else loclib.relative_views(loc)
@@ -415,6 +510,10 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
         # the convergence predicate)
         u = jnp.where(alive[:, None], u, 0.0)
     distcmd_norm = jnp.linalg.norm(u, axis=-1)
+    if checks and faults is not None:
+        inv = invlib.record(inv,
+                            invlib.dead_rows_active(distcmd_norm, alive),
+                            "dead_distcmd", state.tick)
 
     # --- safety shim: saturate -> mux -> avoid -> safe trajectory ---
     u = control.saturate_velocity(u, sparams)
@@ -469,16 +568,29 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
             lambda new, old: jnp.where(alive, new, old), fs, state.flight)
         ca = ca & alive
 
+    if checks:
+        if faults is not None:
+            inv = invlib.record(
+                inv, invlib.dead_rows_moved(swarm.q, state.swarm.q, alive),
+                "dead_frozen", state.tick)
+        # finiteness BEFORE bounds: a NaN pose fails the inside test too,
+        # and must be blamed on state_finite (first-wins)
+        inv = invlib.record(inv, invlib.nonfinite_state(swarm, goal),
+                            "state_finite", state.tick)
+        inv = invlib.record(inv, invlib.out_of_bounds(swarm.q, sparams),
+                            "state_bounds", state.tick)
+
     new_state = SimState(swarm=swarm, goal=goal, v2f=v2f,
                          tick=state.tick + 1, flight=fs, loc=loc,
                          first_auction=first_auction,
                          assign_enabled=state.assign_enabled,
-                         faults=faults)
+                         faults=faults, inv=inv)
     return new_state, StepMetrics(distcmd_norm=distcmd_norm, ca_active=ca,
                                   assign_valid=valid, reassigned=reassigned,
                                   auctioned=auctioned, q=swarm.q,
                                   mode=fs.mode, v2f=v2f,
-                                  alive=alive, fault_event=fault_event)
+                                  alive=alive, fault_event=fault_event,
+                                  inv_code=inv.code if checks else None)
 
 
 @partial(jax.jit, static_argnames=("n_ticks", "cfg"))

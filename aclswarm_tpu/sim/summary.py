@@ -91,6 +91,11 @@ class ChunkSummary:
     recovery_ticks: jnp.ndarray | None = None  # (T,) int32 event->conv ticks
     fault_churn: jnp.ndarray | None = None     # (T,) int32 reassigns in that
     #                                            window (accepted changes)
+    # swarmcheck pass-through (None unless the rollout ran with
+    # cfg.check_mode='on'): per-tick first-violation codes — the drivers
+    # decode them with `analysis.invariants.first_violation`, riding the
+    # sync they already do per chunk
+    inv_code: jnp.ndarray | None = None        # (T,) int32
 
 
 def init_carry(n: int, window: int, dtype=jnp.float32,
@@ -219,6 +224,8 @@ def summarize_chunk(metrics: StepMetrics, carry: SummaryCarry,
         pending, since, churn = (carry.rec_pending, carry.rec_since,
                                  carry.rec_churn)
         fault_kw = {}
+    if metrics.inv_code is not None:
+        fault_kw["inv_code"] = metrics.inv_code
 
     summary = ChunkSummary(
         conv_all=conv_all,

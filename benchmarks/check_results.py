@@ -28,6 +28,7 @@ or via the tier-1 test `tests/test_results_schema.py`.
 from __future__ import annotations
 
 import json
+import math
 import sys
 from pathlib import Path
 
@@ -54,7 +55,13 @@ def _check_row(row: dict, path: Path, lineno: int, strict: bool
     has_value = isinstance(row.get("value"), (int, float)) \
         and not isinstance(row.get("value"), bool)
     has_error = isinstance(row.get("error"), str)
-    if strict and not has_value:
+    if has_value and not math.isfinite(row["value"]):
+        # json.loads happily parses NaN/Infinity (non-standard JSON!),
+        # and a NaN value silently poisons every trend comparison that
+        # touches it (NaN compares false against everything) — reject
+        probs.append(f"{where}: non-finite 'value' ({row['value']!r}) — "
+                     "record an 'error' string instead")
+    elif strict and not has_value:
         probs.append(f"{where}: strict artifact row lacks numeric 'value'")
     elif not (has_value or has_error):
         probs.append(f"{where}: neither numeric 'value' nor 'error' string")

@@ -88,7 +88,8 @@ def _problem(n: int, seed: int):
 
 def run_scale(n: int, *, seed: int = 1, drop_tick: int | None = None,
               rejoin_tick: int | None = None, n_ticks: int | None = None,
-              chunk: int = 120, assign_every: int = 60) -> list[dict]:
+              chunk: int = 120, assign_every: int = 60,
+              check_mode: str = "off") -> list[dict]:
     import jax
     import jax.numpy as jnp
 
@@ -109,7 +110,8 @@ def run_scale(n: int, *, seed: int = 1, drop_tick: int | None = None,
                                      rejoin_tick=rejoin_tick,
                                      link_loss=pl, dtype=dtype)
               for i, (df, pl) in enumerate(GRID)]
-    states = [sim.init_state(q0, localization=True, faults=sc)
+    states = [sim.init_state(q0, localization=True, faults=sc,
+                             checks=check_mode == "on")
               for sc in scheds]
     bstate = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
     bform = jax.tree.map(lambda *xs: jnp.stack(xs), *([form] * B))
@@ -118,7 +120,8 @@ def run_scale(n: int, *, seed: int = 1, drop_tick: int | None = None,
         bounds_max=jnp.asarray([100.0, 100.0, 30.0]))
     cfg = sim.SimConfig(assignment="cbaa", assign_every=assign_every,
                         localization="flooded",
-                        colavoid_neighbors=16 if n > 16 else None)
+                        colavoid_neighbors=16 if n > 16 else None,
+                        check_mode=check_mode)
     window = 100                              # 1 s at the 100 Hz tick
     carry = sumlib.init_carry(n, window, dtype=dtype, batch=B)
 
@@ -131,6 +134,14 @@ def run_scale(n: int, *, seed: int = 1, drop_tick: int | None = None,
         bstate, carry, summ = sumlib.batched_rollout_summary(
             bstate, carry, bform, ControlGains(), sparams, cfg, chunk,
             None, 0, window=window, takeoff_alt=2.0)
+        if check_mode == "on":
+            # sanitized run: the swarmcheck codes ride the arrays this
+            # loop already syncs; a violation aborts the sweep with
+            # (trial row, tick, contract) attribution
+            from aclswarm_tpu.analysis import invariants as invlib
+            codes = np.asarray(summ.inv_code)
+            for b in range(B):
+                invlib.raise_on_violation(codes[b], trial=b, tick0=c0)
         conv = np.concatenate([conv, np.asarray(summ.conv_all)], axis=1)
         rec = np.concatenate([rec, np.asarray(summ.recovery_ticks)], axis=1)
         chn = np.concatenate([chn, np.asarray(summ.fault_churn)], axis=1)
@@ -181,6 +192,11 @@ def main(argv=None):
                     help="scale(s) to run (default 10 and 100)")
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--out", default=str(RESULTS / "fault_recovery.json"))
+    ap.add_argument("--check-mode", choices=("off", "on"), default="off",
+                    help="run the sweep with the swarmcheck sanitizer "
+                    "compiled in (aclswarm_tpu.analysis.invariants): a "
+                    "contract violation aborts with trial/tick/contract "
+                    "attribution instead of poisoning the artifact")
     args = ap.parse_args(argv)
 
     import jax
@@ -190,7 +206,8 @@ def main(argv=None):
     all_rows = []
     for n in ns:
         print(f"=== fault sweep n={n} (B={len(GRID)}) ===", flush=True)
-        rows = run_scale(n, seed=args.seed, **kw)
+        rows = run_scale(n, seed=args.seed, check_mode=args.check_mode,
+                         **kw)
         for r in rows:
             r["device"] = jax.default_backend()
             print(json.dumps(r), flush=True)

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# One-shot pre-PR gate: every static/runtime guard the repo ships, in
+# dependency order, failing fast. This is THE command to run before
+# opening a PR (README "Quick start").
+#
+#   scripts/check.sh          # lint -> trace audit (+ zero-cost-off
+#                             # proof) -> artifact schema -> analysis +
+#                             # invariants + schema self-tests
+#
+# Pieces (each runnable standalone):
+#   scripts/lint.sh                                        layer 1 lint
+#   JAX_PLATFORMS=cpu python -m aclswarm_tpu.analysis.trace_audit
+#                                         layer 2 audit + zero-cost-off
+#   python benchmarks/check_results.py            committed artifacts
+#   pytest tests/test_analysis.py tests/test_invariants.py \
+#          tests/test_results_schema.py             guard self-tests
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== jaxcheck layer 1: AST lint (JC001-JC006) =="
+scripts/lint.sh
+
+echo "== jaxcheck layer 2: trace audit + swarmcheck zero-cost-off proof =="
+JAX_PLATFORMS=cpu python -m aclswarm_tpu.analysis.trace_audit
+
+echo "== committed benchmark artifact schema =="
+python benchmarks/check_results.py
+
+echo "== guard self-tests (lint fixtures, audit grid, invariant contracts) =="
+exec env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_analysis.py tests/test_invariants.py \
+    tests/test_results_schema.py \
+    -q -m 'not slow' -p no:cacheprovider

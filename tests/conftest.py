@@ -47,10 +47,18 @@ def pytest_configure(config):
         "(B >= 8) additionally carry `slow` so tier-1 stays on budget")
     config.addinivalue_line(
         "markers",
-        "analysis: jaxcheck static analysis — AST lint (JC001-JC005) + "
+        "analysis: jaxcheck static analysis — AST lint (JC001-JC006) + "
         "trace-time compile/transfer audit of the jitted entry points "
         "(aclswarm_tpu.analysis; docs/STATIC_ANALYSIS.md). The heavy "
         "n=16/B=4 audit grid additionally carries `slow`")
+    config.addinivalue_line(
+        "markers",
+        "invariants: swarmcheck runtime sanitizer — compiled-in "
+        "invariant contracts (aclswarm_tpu.analysis.invariants; "
+        "docs/STATIC_ANALYSIS.md runtime tier): clean-system positives, "
+        "seeded-corruption mutation tests with trial/tick/contract "
+        "attribution, zero-cost-off. The n>=16 full contract grid "
+        "additionally carries `slow`")
 
 
 @pytest.fixture

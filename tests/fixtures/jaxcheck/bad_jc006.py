@@ -1,0 +1,50 @@
+"""JC006 fixture: unmasked reductions in fault-aware code.
+
+This file is not under the fault-aware module prefixes, so it opts in:
+# jaxcheck: fault-aware-file
+"""
+import jax.numpy as jnp
+
+
+def masked_ok(q, alive):
+    dn = jnp.where(alive, jnp.linalg.norm(q, axis=-1), 0.0)
+    return jnp.sum(dn)                  # ok: alive feeds the operand
+
+
+def transitively_ok(cost, alive):
+    pinned = jnp.where(alive[:, None], cost, 0.0)
+    scores = pinned * 2.0
+    return jnp.min(scores)              # ok: alive reaches via two hops
+
+
+def rebinding_ok(cost, pin, forbid):
+    cost = cost + 0.0
+    cost = jnp.where(pin | forbid, 0.0, cost)
+    return jnp.max(cost)                # ok: flow-insensitive rebinding
+
+
+def bad_mean(q, alive):
+    return jnp.mean(q)                  # JC006
+
+
+def bad_argmin(cost, link_mask):
+    idx = jnp.argmin(cost, axis=1)      # JC006
+    return idx
+
+
+def bad_sum_local(q, who):
+    dead = who < 0
+    total = jnp.sum(q)                  # JC006
+    return jnp.where(dead, 0.0, total)
+
+
+def where_kwarg_ok(q, alive):
+    return jnp.sum(q, where=alive)      # ok: native masked reduction
+
+
+def no_mask_in_scope(q):
+    return jnp.max(q)                   # ok: handles no mask -> exempt
+
+
+def suppressed_site(q, alive):
+    return jnp.sum(q)                   # jaxcheck: disable=JC006

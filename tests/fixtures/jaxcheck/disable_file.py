@@ -1,0 +1,21 @@
+"""File-level escape hatch fixture: every rule below would fire, but the
+file-wide pragma silences the named ones for the whole file.
+
+# jaxcheck: disable-file=JC001,JC004
+"""
+import random
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def would_trip_jc001(x):
+    y = np.asarray(x)           # JC001, file-disabled
+    return y.item()             # JC001, file-disabled
+
+
+@jax.jit
+def would_trip_jc004(x):
+    return x * time.time() + random.random()    # JC004 x2, file-disabled

@@ -46,6 +46,7 @@ class TestLintFixtures:
         ("bad_jc003.py", "JC003", 4),
         ("bad_jc004.py", "JC004", 3),
         ("bad_jc005.py", "JC005", 1),
+        ("bad_jc006.py", "JC006", 3),
     ])
     def test_rule_fires(self, fired, fixture, rule, count):
         vs = fired.get(fixture, [])
@@ -65,6 +66,11 @@ class TestLintFixtures:
     def test_escape_hatch_suppresses(self, fired):
         assert "suppressed.py" not in fired
 
+    def test_file_level_pragma_suppresses(self, fired):
+        """`# jaxcheck: disable-file=JC001,JC004` silences those rules
+        for the whole file (the fixture would otherwise fire both)."""
+        assert "disable_file.py" not in fired
+
     def test_host_only_code_not_flagged(self, fired):
         """Reachability matters: host-side code using the same calls is
         legal (the `host_only` defs carry no annotation)."""
@@ -72,6 +78,81 @@ class TestLintFixtures:
             src = (FIXTURES / fname).read_text().splitlines()
             for v in fired[fname]:
                 assert "host_only" not in src[v.line - 1]
+
+
+class TestLintErgonomics:
+    def test_one_report_per_site_across_call_paths(self, tmp_path):
+        """A helper reachable from several jit roots (and via a nested
+        def) reports each offending line ONCE — the (file, line, rule)
+        dedupe plus the nested-def body exclusion."""
+        f = tmp_path / "multipath.py"
+        f.write_text(
+            "import jax\n"
+            "def helper(x):\n"
+            "    return x.item()\n"
+            "@jax.jit\n"
+            "def root_a(x):\n"
+            "    def inner(y):\n"
+            "        return helper(y)\n"
+            "    return inner(x)\n"
+            "@jax.jit\n"
+            "def root_b(x):\n"
+            "    return helper(x)\n")
+        vs = lintmod.lint_paths([f])
+        assert [(v.line, v.rule) for v in vs] == [(3, "JC001")], vs
+
+    def test_disable_file_all_rules(self, tmp_path):
+        f = tmp_path / "vendored.py"
+        f.write_text(
+            "# jaxcheck: disable-file\n"
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x.item()\n")
+        assert lintmod.lint_paths([f]) == []
+
+    def test_nested_def_defaults_still_scanned(self, tmp_path):
+        """A nested def's decorators and argument DEFAULTS evaluate in
+        the enclosing scope during its trace — skipping the nested body
+        (the dedupe fix) must not silence violations that live there."""
+        f = tmp_path / "nested_default.py"
+        f.write_text(
+            "import jax\n"
+            "@jax.jit\n"
+            "def root(x):\n"
+            "    def inner(y=x.item()):\n"
+            "        return y\n"
+            "    return inner()\n")
+        vs = lintmod.lint_paths([f])
+        assert [(v.line, v.rule) for v in vs] == [(4, "JC001")], vs
+
+    def test_jc006_keyword_operand_checked(self, tmp_path):
+        """`jnp.sum(a=q)` (keyword-passed operand) must not escape the
+        rule, and `jnp.sum(q, where=alive)` must pass it."""
+        f = tmp_path / "kw.py"
+        f.write_text(
+            "# jaxcheck: fault-aware-file\n"
+            "import jax.numpy as jnp\n"
+            "def g(q, alive):\n"
+            "    return jnp.sum(a=q)\n")
+        assert [v.rule for v in lintmod.lint_paths([f])] == ["JC006"]
+
+    def test_jc006_module_scope(self, tmp_path):
+        """Without the fault-aware-file opt-in (and outside the scoped
+        subpackages), JC006 stays silent even on mask-handling code."""
+        f = tmp_path / "elsewhere.py"
+        f.write_text(
+            "import jax.numpy as jnp\n"
+            "def g(q, alive):\n"
+            "    return jnp.mean(q)\n")
+        assert lintmod.lint_paths([f]) == []
+        f2 = tmp_path / "opted_in.py"
+        f2.write_text(
+            "# jaxcheck: fault-aware-file\n"
+            "import jax.numpy as jnp\n"
+            "def g(q, alive):\n"
+            "    return jnp.mean(q)\n")
+        assert [v.rule for v in lintmod.lint_paths([f2])] == ["JC006"]
 
 
 class TestLintRepo:
@@ -119,6 +200,72 @@ class TestTraceAudit:
     def test_full_grid(self):
         bad = [r for r in ta.audit_all(slow=True) if not r.ok]
         assert bad == [], bad
+
+
+class TestZeroCostOff:
+    """The swarmcheck guarantee: `check_mode='off'` lowers every
+    registered entry point to HLO bit-identical to the committed
+    pre-swarmcheck baseline (`analysis/hlo_baseline.json`)."""
+
+    def test_off_mode_matches_baseline(self):
+        z = ta.verify_zero_cost_off()
+        if z["skipped"]:
+            pytest.skip(z["skipped"])
+        assert z["checked"] > 0
+        assert z["mismatches"] == [], \
+            "check_mode=off no longer lowers to the pre-swarmcheck " \
+            f"HLO: {z['mismatches']} (if the compiled surface changed " \
+            "INTENTIONALLY, regenerate with `python -m " \
+            "aclswarm_tpu.analysis.trace_audit --write-hlo-baseline` " \
+            "and commit the diff)"
+        assert z["uncovered"] == [], \
+            f"baseline digests with no producing entry: {z['uncovered']}"
+        assert z["unverified"] == [], \
+            "baseline entries with no committed digest (a new entry " \
+            "point is not proven zero-cost until --write-hlo-baseline " \
+            f"runs): {z['unverified']}"
+
+    def test_skipped_builder_surfaces_as_uncovered(self, monkeypatch,
+                                                   tmp_path):
+        """A committed digest whose builder now raises Skip must land in
+        `uncovered` (loud), not silently drop out of the proof."""
+        import json
+        base = {"jax_version": jax.__version__,
+                "backend": jax.default_backend(),
+                "digests": {"fake.entry|n=5": "0" * 64}}
+        p = tmp_path / "hlo_baseline.json"
+        p.write_text(json.dumps(base))
+
+        def skipper(gp):
+            raise ta.Skip("unsupported combo")
+
+        fake = ta.EntryPoint(name="fake.entry", fn=lambda x: x,
+                             static_argnames=(), build=skipper,
+                             axes=("n",))
+        # a second (buildable) entry with NO committed digest: must
+        # surface as unverified, not silently pass — while a Skip-only
+        # cell with no digest stays silent (the capture legitimately
+        # skipped it too)
+        fresh = ta.EntryPoint(
+            name="fresh.entry", fn=lambda x: x, static_argnames=(),
+            build=lambda gp: ((np.zeros((2,), np.float32),), {}),
+            axes=("n",))
+        monkeypatch.setattr(ta, "HLO_BASELINE_PATH", p)
+        monkeypatch.setattr(ta, "ENTRY_POINTS", [fake, fresh])
+        z = ta.verify_zero_cost_off()
+        assert z["skipped"] is None
+        assert z["uncovered"] == ["fake.entry|n=5"]
+        assert z["unverified"] == ["fresh.entry|n=5"]
+
+    def test_checked_mode_differs_from_baseline(self):
+        """Teeth: the sanitizer-on program must NOT equal the baseline
+        program — if it did, the off-mode proof would prove nothing."""
+        on = next(e for e in ta.ENTRY_POINTS
+                  if e.name == "sim.engine.rollout[checked]")
+        off = next(e for e in ta.ENTRY_POINTS
+                   if e.name == "sim.engine.rollout")
+        gp = next(iter(ta.iter_grid()))
+        assert ta.hlo_digest(on, gp) != ta.hlo_digest(off, gp)
 
 
 class TestWeakTypeRegression:

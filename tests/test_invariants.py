@@ -1,0 +1,455 @@
+"""swarmcheck runtime tier: compiled-in invariant contracts.
+
+Four claims (docs/STATIC_ANALYSIS.md, runtime tier):
+
+- **clean-system positives**: every solver x fault combination runs the
+  checked rollout with a zero violation code — the contracts hold on
+  the real system (no false positives), serial and batched;
+- **mutation coverage**: each seeded corruption (duplicate assignment
+  row, NaN pose injected mid-rollout, asymmetric adjacency, stale alive
+  mask after a rejoin) trips EXACTLY its contract, in both serial and
+  B>=2 batched rollouts, attributed to the right trial index and tick;
+- **surfacing**: the per-tick codes ride `StepMetrics`/`ChunkSummary`
+  and the drivers raise a structured `InvariantViolation`;
+- **zero-cost-off** is proven separately in
+  `tests/test_analysis.py::TestZeroCostOff` (HLO digest equality).
+
+The heavy n>=16 full contract grid is marked `slow`.
+"""
+from functools import partial
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from aclswarm_tpu import faults, sim
+from aclswarm_tpu.analysis import invariants as invlib
+from aclswarm_tpu.analysis import trace_audit as ta
+from aclswarm_tpu.core.types import ControlGains
+from aclswarm_tpu.sim import engine
+
+pytestmark = pytest.mark.invariants
+
+N = 5
+TICKS = 6
+
+
+def _problem(n=N, seed=0):
+    return ta._scatter(n, seed), ta._formation(n), ta._sparams()
+
+
+def _cfg(assignment="auction", **kw):
+    kw.setdefault("assign_every", 2)
+    return sim.SimConfig(assignment=assignment, check_mode="on", **kw)
+
+
+def _fresh_rollout():
+    """A private jit wrapper so monkeypatched solver functions are
+    actually traced (the module-level `sim.rollout` caches the honest
+    program)."""
+    return jax.jit(partial(engine.rollout.__wrapped__),
+                   static_argnames=("n_ticks", "cfg"))
+
+
+def _fresh_batched():
+    return jax.jit(partial(engine.batched_rollout.__wrapped__),
+                   static_argnames=("n_ticks", "cfg"))
+
+
+def _first(codes):
+    return invlib.first_violation(np.asarray(codes))
+
+
+def _stack(*trees):
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+
+# ---------------------------------------------------------------------------
+# clean-system positives
+
+class TestCleanSystem:
+    @pytest.mark.parametrize("solver", ["auction", "sinkhorn", "cbaa"])
+    @pytest.mark.parametrize("faulted", [False, True],
+                             ids=["nofaults", "faults"])
+    def test_serial_rollout_clean(self, solver, faulted):
+        q0, form, sp = _problem()
+        sched = faults.sample_schedule(3, N, dropout_frac=0.4, drop_tick=1,
+                                       rejoin_tick=3) if faulted else None
+        state = sim.init_state(q0, faults=sched, checks=True)
+        st, m = sim.rollout(state, form, ControlGains(), sp,
+                            _cfg(solver), TICKS)
+        assert int(st.inv.code) == 0, \
+            f"clean system violated {_first(m.inv_code)}"
+        assert int(st.inv.tick) == -1
+        assert np.all(np.asarray(m.inv_code) == 0)
+
+    def test_batched_rollout_clean(self):
+        q0a, form, sp = _problem(seed=0)
+        q0b = ta._scatter(N, 1)
+        sched = faults.sample_schedule(3, N, dropout_frac=0.4, drop_tick=1,
+                                       rejoin_tick=3)
+        bstate = _stack(
+            sim.init_state(q0a, faults=faults.no_faults(N), checks=True),
+            sim.init_state(q0b, faults=sched, checks=True))
+        bform = _stack(form, form)
+        st, m = sim.batched_rollout(bstate, bform, ControlGains(), sp,
+                                    _cfg(), TICKS)
+        assert np.asarray(st.inv.code).tolist() == [0, 0]
+
+
+# ---------------------------------------------------------------------------
+# mutation coverage, serial
+
+class TestMutationsSerial:
+    def test_duplicate_assignment_row(self, monkeypatch):
+        """A solver bug returning a duplicated row must trip assign_perm
+        the tick the corrupted assignment is taken."""
+        from aclswarm_tpu.assignment import auction
+        orig = auction.auction_lap.__wrapped__ \
+            if hasattr(auction.auction_lap, "__wrapped__") \
+            else auction.auction_lap
+
+        def corrupted(benefit, **kw):
+            res = orig(benefit, **kw)
+            return res._replace(
+                row_to_col=res.row_to_col.at[1].set(res.row_to_col[0]))
+
+        monkeypatch.setattr(auction, "auction_lap", corrupted)
+        q0, form, sp = _problem()
+        state = sim.init_state(q0, checks=True)
+        st, m = _fresh_rollout()(state, form, ControlGains(), sp,
+                                 cfg=_cfg("auction"), n_ticks=TICKS)
+        tick, contract = _first(m.inv_code)
+        assert contract.id == "assign_perm"
+        assert tick == 0            # first auction tick takes the corrupt row
+        assert int(st.inv.tick) == 0
+
+    def test_nan_pose_injection_mid_rollout(self):
+        """A NaN sneaking into the velocity pipeline mid-rollout trips
+        state_finite at the injection tick — and is blamed on
+        state_finite, not the out-of-bounds its NaN comparisons imply."""
+        q0, form, sp = _problem()
+        k = 3
+        joy_vel = np.zeros((TICKS, N, 3), np.float64)
+        joy_vel[k, 0, :] = np.nan
+        joy_active = np.zeros((TICKS, N), bool)
+        joy_active[k, 0] = True
+        inputs = sim.ExternalInputs(
+            cmd=jnp.zeros((TICKS,), jnp.int32),
+            joy_vel=jnp.asarray(joy_vel, q0.dtype),
+            joy_yawrate=jnp.zeros((TICKS, N), q0.dtype),
+            joy_active=jnp.asarray(joy_active))
+        state = sim.init_state(q0, checks=True)
+        st, m = sim.rollout(state, form, ControlGains(), sp, _cfg(), TICKS,
+                            inputs)
+        tick, contract = _first(m.inv_code)
+        assert contract.id == "state_finite"
+        assert tick == k
+        assert int(st.inv.tick) == k
+
+    def test_asymmetric_adjacency(self):
+        q0, form, sp = _problem()
+        adj = np.asarray(form.adjmat).copy()
+        adj[0, 1] = 0.0             # break symmetry
+        state = sim.init_state(q0, checks=True)
+        st, m = sim.rollout(state, form.replace(adjmat=jnp.asarray(adj)),
+                            ControlGains(), sp, _cfg(), TICKS)
+        tick, contract = _first(m.inv_code)
+        assert contract.id == "adj_sym"
+        assert tick == 0
+
+    def test_stale_alive_mask_after_rejoin(self, monkeypatch):
+        """An engine regression feeding a one-tick-stale alive mask must
+        trip mask_consistency at the first mask flip. Works because the
+        contract recomputes the reference mask from the raw schedule
+        leaves instead of calling the (patched) `alive_at`."""
+        from aclswarm_tpu.faults import schedule as faultlib
+        orig = faultlib.alive_at
+
+        def stale(sched, tick):
+            return orig(sched, jnp.asarray(tick, jnp.int32) - 1)
+
+        monkeypatch.setattr(engine.faultlib, "alive_at", stale)
+        q0, form, sp = _problem()
+        drop = 2
+        sched = faults.sample_schedule(3, N, dropout_frac=0.4,
+                                       drop_tick=drop, rejoin_tick=4)
+        state = sim.init_state(q0, faults=sched, checks=True)
+        st, m = _fresh_rollout()(state, form, ControlGains(), sp,
+                                 cfg=_cfg(), n_ticks=TICKS)
+        tick, contract = _first(m.inv_code)
+        assert contract.id == "mask_consistency"
+        assert tick == drop         # the first tick the stale mask differs
+
+
+# ---------------------------------------------------------------------------
+# mutation coverage, batched (B=2; trial 1 corrupted, trial 0 clean)
+
+class TestMutationsBatched:
+    def _assert_trial1_only(self, metrics, contract_id, tick):
+        codes = np.asarray(metrics.inv_code)     # (T, B)
+        assert np.all(codes[:, 0] == 0), "clean trial polluted"
+        got_tick, contract = _first(codes[:, 1])
+        assert contract.id == contract_id
+        assert got_tick == tick
+
+    def test_duplicate_assignment_row(self):
+        """Data-driven: trial 1 starts on a non-permutation with the
+        auto-auction gated off (the hover phase), so nothing repairs it."""
+        q0, form, sp = _problem()
+        s0 = sim.init_state(q0, checks=True)
+        s1 = sim.init_state(ta._scatter(N, 1),
+                            v2f0=np.array([1, 1, 2, 3, 4]), checks=True)
+        bstate = _stack(s0, s1).replace(
+            assign_enabled=jnp.asarray([False, False]))
+        st, m = sim.batched_rollout(bstate, _stack(form, form),
+                                    ControlGains(), sp, _cfg(), TICKS)
+        self._assert_trial1_only(m, "assign_perm", 0)
+        assert np.asarray(st.inv.code).tolist()[0] == 0
+        assert int(np.asarray(st.inv.tick)[1]) == 0
+
+    def test_nan_pose_injection_mid_rollout(self):
+        q0, form, sp = _problem()
+        k = 3
+        joy_vel = np.zeros((TICKS, 2, N, 3), np.float64)
+        joy_vel[k, 1, 0, :] = np.nan
+        joy_active = np.zeros((TICKS, 2, N), bool)
+        joy_active[k, 1, 0] = True
+        inputs = sim.ExternalInputs(
+            cmd=jnp.zeros((TICKS, 2), jnp.int32),
+            joy_vel=jnp.asarray(joy_vel, q0.dtype),
+            joy_yawrate=jnp.zeros((TICKS, 2, N), q0.dtype),
+            joy_active=jnp.asarray(joy_active))
+        bstate = _stack(sim.init_state(q0, checks=True),
+                        sim.init_state(ta._scatter(N, 1), checks=True))
+        st, m = sim.batched_rollout(bstate, _stack(form, form),
+                                    ControlGains(), sp, _cfg(), TICKS,
+                                    inputs)
+        self._assert_trial1_only(m, "state_finite", k)
+
+    def test_asymmetric_adjacency(self):
+        q0, form, sp = _problem()
+        adj = np.asarray(form.adjmat).copy()
+        adj[0, 1] = 0.0
+        form_bad = form.replace(adjmat=jnp.asarray(adj))
+        bstate = _stack(sim.init_state(q0, checks=True),
+                        sim.init_state(ta._scatter(N, 1), checks=True))
+        st, m = sim.batched_rollout(bstate, _stack(form, form_bad),
+                                    ControlGains(), sp, _cfg(), TICKS)
+        self._assert_trial1_only(m, "adj_sym", 0)
+
+    def test_stale_alive_mask_after_rejoin(self, monkeypatch):
+        """Trial 0 carries the no-fault schedule (stale == fresh, never
+        trips); trial 1 has a real drop/rejoin window, so only it sees
+        the stale-mask inconsistency."""
+        from aclswarm_tpu.faults import schedule as faultlib
+        orig = faultlib.alive_at
+
+        def stale(sched, tick):
+            return orig(sched, jnp.asarray(tick, jnp.int32) - 1)
+
+        monkeypatch.setattr(engine.faultlib, "alive_at", stale)
+        q0, form, sp = _problem()
+        drop = 2
+        sched = faults.sample_schedule(3, N, dropout_frac=0.4,
+                                       drop_tick=drop, rejoin_tick=4)
+        bstate = _stack(
+            sim.init_state(q0, faults=faults.no_faults(N), checks=True),
+            sim.init_state(ta._scatter(N, 1), faults=sched, checks=True))
+        st, m = _fresh_batched()(bstate, _stack(form, form),
+                                 ControlGains(), sp, cfg=_cfg(),
+                                 n_ticks=TICKS)
+        self._assert_trial1_only(m, "mask_consistency", drop)
+
+
+# ---------------------------------------------------------------------------
+# surfacing: summary pass-through + driver raise + decode helpers
+
+class TestSurfacing:
+    def test_summary_passes_codes_through(self):
+        from aclswarm_tpu.sim import summary as sumlib
+        q0, form, sp = _problem()
+        adj = np.asarray(form.adjmat).copy()
+        adj[0, 1] = 0.0
+        form_bad = form.replace(adjmat=jnp.asarray(adj))
+        bstate = _stack(sim.init_state(q0, checks=True),
+                        sim.init_state(ta._scatter(N, 1), checks=True))
+        carry = sumlib.init_carry(N, window=3, dtype=q0.dtype, batch=2)
+        st, carry, summ = sumlib.batched_rollout_summary(
+            bstate, carry, _stack(form, form_bad), ControlGains(), sp,
+            _cfg(), TICKS, None, 0, window=3,
+            takeoff_alt=jnp.asarray(1.0, q0.dtype))
+        codes = np.asarray(summ.inv_code)
+        assert codes.shape == (2, TICKS)
+        assert np.all(codes[0] == 0)
+        assert _first(codes[1])[1].id == "adj_sym"
+
+    def test_summary_off_mode_has_no_codes(self):
+        from aclswarm_tpu.sim import summary as sumlib
+        q0, form, sp = _problem()
+        bstate = _stack(sim.init_state(q0),
+                        sim.init_state(ta._scatter(N, 1)))
+        carry = sumlib.init_carry(N, window=3, dtype=q0.dtype, batch=2)
+        st, carry, summ = sumlib.batched_rollout_summary(
+            bstate, carry, _stack(form, form), ControlGains(), sp,
+            sim.SimConfig(assignment="auction", assign_every=2), TICKS,
+            None, 0, window=3, takeoff_alt=jnp.asarray(1.0, q0.dtype))
+        assert summ.inv_code is None
+
+    def test_raise_on_violation(self):
+        codes = np.zeros(10, np.int32)
+        invlib.raise_on_violation(codes, trial=4)      # clean: no-op
+        codes[7] = invlib.CODES["state_finite"]
+        with pytest.raises(invlib.InvariantViolation) as ei:
+            invlib.raise_on_violation(codes, trial=4, tick0=100)
+        e = ei.value
+        assert e.contract.id == "state_finite"
+        assert e.tick == 107 and e.trial == 4
+        assert "trial 4" in str(e) and "tick 107" in str(e)
+        assert "state_finite" in str(e)
+
+    def test_first_violation_decodes_unknown_codes_loudly(self):
+        codes = np.array([0, 99], np.int32)
+        tick, contract = invlib.first_violation(codes)
+        assert tick == 1 and contract.code == 99
+        assert contract.id == "unknown"
+
+    def test_checked_state_required(self):
+        """cfg.check_mode='on' without init_state(checks=True) fails
+        loudly at trace time, mirroring the flooded-localization rule."""
+        q0, form, sp = _problem()
+        state = sim.init_state(q0)          # no carry allocated
+        with pytest.raises(ValueError, match="checks=True"):
+            sim.rollout(state, form, ControlGains(), sp, _cfg(), 2)
+
+    def test_unknown_check_mode_rejected(self):
+        q0, form, sp = _problem()
+        state = sim.init_state(q0, checks=True)
+        cfg = sim.SimConfig(assignment="auction", assign_every=2,
+                            check_mode="sometimes")
+        with pytest.raises(ValueError, match="check_mode"):
+            sim.rollout(state, form, ControlGains(), sp, cfg, 2)
+
+
+# ---------------------------------------------------------------------------
+# solver-level contracts: sinkhorn marginals + admm residual
+
+class TestSolverContracts:
+    def test_sinkhorn_marginals_clean_on_converged_plan(self):
+        from aclswarm_tpu.assignment import sinkhorn
+        rng = np.random.default_rng(0)
+        q = rng.normal(size=(8, 3))
+        p = rng.normal(size=(8, 3))
+        res = sinkhorn.sinkhorn_assign(q, p)
+        row_err, col_err = sinkhorn.marginal_errors(res.plan_log)
+        assert not bool(invlib.sinkhorn_marginals_violated(row_err,
+                                                           col_err))
+
+    def test_sinkhorn_marginals_trip_on_garbage_plan(self):
+        from aclswarm_tpu.assignment import sinkhorn
+        n = 8
+        # "plan" with mass n per row instead of 1/n: marginal errs ~ n
+        garbage = jnp.zeros((n, n))
+        row_err, col_err = sinkhorn.marginal_errors(garbage)
+        assert bool(invlib.sinkhorn_marginals_violated(row_err, col_err))
+
+    def test_marginal_errors_exact_on_uniform_plan(self):
+        from aclswarm_tpu.assignment import sinkhorn
+        n = 8
+        uniform = jnp.full((n, n), -2.0 * np.log(n))
+        row_err, col_err = sinkhorn.marginal_errors(uniform)
+        assert float(row_err) < 1e-9 and float(col_err) < 1e-9
+
+    def test_admm_check_on_equals_off_and_stays_clean(self):
+        from aclswarm_tpu.gains import admm
+        n = 6
+        ang = np.linspace(0, 2 * np.pi, n, endpoint=False)
+        pts = np.stack([3 * np.cos(ang), 3 * np.sin(ang),
+                        np.full(n, 2.0)], 1)
+        adj = np.ones((n, n)) - np.eye(n)
+        adj[0, 2] = adj[2, 0] = 0
+        g_off = np.asarray(admm.solve_gains(pts, adj))
+        g_on = np.asarray(admm.solve_gains(pts, adj, check_mode="on"))
+        assert np.array_equal(g_off, g_on)
+
+    def test_admm_residual_predicate(self):
+        """The projection-form iteration is empirically net-decreasing
+        under every parameterization tried (the contract guards future
+        regressions), so the violation predicate is pinned directly."""
+        t, f = jnp.asarray(True), jnp.asarray(False)
+        one, two = jnp.asarray(1.0), jnp.asarray(2.0)
+        assert bool(invlib.admm_residual_violated(one, two, f))
+        assert not bool(invlib.admm_residual_violated(one, two, t))
+        assert not bool(invlib.admm_residual_violated(two, one, f))
+        assert not bool(invlib.admm_residual_violated(one, one, f))
+
+    def test_admm_unknown_check_mode_rejected(self):
+        from aclswarm_tpu.gains import admm
+        pts = np.zeros((4, 3))
+        adj = np.ones((4, 4)) - np.eye(4)
+        with pytest.raises(ValueError, match="check_mode"):
+            admm.solve_gains(pts, adj, check_mode="On")
+
+    def test_admm_raise_path(self, monkeypatch):
+        """solve_gains(check_mode='on') raises the structured violation
+        when the contract fires (wire test: predicate forced true)."""
+        from aclswarm_tpu.gains import admm
+        monkeypatch.setattr(
+            admm.invlib, "admm_residual_violated",
+            lambda first, last, stopped: jnp.asarray(True))
+        n = 7     # distinct shape: forces a retrace under the patch
+        ang = np.linspace(0, 2 * np.pi, n, endpoint=False)
+        pts = np.stack([3 * np.cos(ang), 3 * np.sin(ang),
+                        np.full(n, 2.0)], 1)
+        adj = np.ones((n, n)) - np.eye(n)
+        adj[0, 2] = adj[2, 0] = 0
+        with pytest.raises(invlib.InvariantViolation) as ei:
+            admm.solve_gains(pts, adj, check_mode="on")
+        assert ei.value.contract.id == "admm_residual"
+
+
+# ---------------------------------------------------------------------------
+# driver integration (serial trials loop with the sanitizer compiled in)
+
+class TestDriverIntegration:
+    def test_run_trial_checked_happy_path(self):
+        """A short checked trial completes its chunk loop without a
+        violation: the driver wiring (init_state(checks=True), per-chunk
+        raise_on_violation) runs on the happy path. The 2 s timeout
+        terminates the trial long before convergence — FSM outcome is
+        irrelevant here, only that the sanitizer stayed quiet."""
+        from aclswarm_tpu.harness import trials as trialmod
+        cfg = trialmod.TrialConfig(formation="swarm4", trials=1,
+                                   seed=1, check_mode="on",
+                                   dynamics="tracking",
+                                   trial_timeout=2.0, verbose=False)
+        fsm = trialmod.run_trial(cfg, 0)
+        assert fsm.done
+
+
+# ---------------------------------------------------------------------------
+# heavy sweep
+
+@pytest.mark.slow
+class TestHeavyGrid:
+    @pytest.mark.parametrize("solver", ["auction", "sinkhorn", "cbaa"])
+    @pytest.mark.parametrize("faulted", [False, True],
+                             ids=["nofaults", "faults"])
+    @pytest.mark.parametrize("loc", ["truth", "flooded"])
+    def test_n16_full_contract_grid(self, solver, faulted, loc):
+        n = 16
+        q0 = ta._scatter(n)
+        form = ta._formation(n)
+        sp = ta._sparams()
+        sched = faults.sample_schedule(
+            7, n, dropout_frac=0.25, drop_tick=2, rejoin_tick=6,
+            link_loss=0.2) if faulted else None
+        state = sim.init_state(q0, localization=loc == "flooded",
+                               faults=sched, checks=True)
+        cfg = sim.SimConfig(assignment=solver, assign_every=2,
+                            localization=loc, flood_every=2,
+                            check_mode="on")
+        st, m = sim.rollout(state, form, ControlGains(), sp, cfg, 10)
+        assert int(st.inv.code) == 0, _first(m.inv_code)

@@ -57,6 +57,31 @@ def test_checker_flags_drift(tmp_path):
     assert len(check_file(empty)) == 1
 
 
+def test_checker_rejects_nonfinite_values(tmp_path):
+    """NaN/Inf `value` fields serialize through json (non-standard
+    extension) and poison trend comparisons — the checker rejects them
+    in both lenient and strict rows; a recorded `error` string is the
+    legal way to log a failed measurement."""
+    bad = tmp_path / "whatever.json"
+    bad.write_text('{"metric": "m", "value": NaN}\n'
+                   '{"metric": "m2", "value": Infinity}\n'
+                   '{"metric": "m3", "value": -Infinity}\n')
+    probs = check_file(bad)
+    assert len(probs) == 3, probs
+    assert all("non-finite" in p for p in probs)
+
+    strict = tmp_path / "fault_recovery.json"
+    strict.write_text('{"name": "m", "n": 10, "value": NaN}\n')
+    probs = check_file(strict)
+    # non-finite AND (strict) no usable value
+    assert any("non-finite" in p for p in probs), probs
+
+    ok = tmp_path / "fine.json"
+    ok.write_text('{"metric": "m", "value": 1e308}\n'
+                  '{"metric": "failed", "error": "diverged to inf"}\n')
+    assert check_file(ok) == []
+
+
 def test_checker_accepts_summary_objects(tmp_path):
     summ = tmp_path / "trials_summary.json"
     summ.write_text(json.dumps({"backend": "cpu", "configs": {}}, indent=1))
