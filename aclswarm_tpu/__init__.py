@@ -22,6 +22,9 @@ Subpackages
 - ``sim``        vehicle dynamics + closed-loop jitted rollouts
 - ``faults``     fault injection & elastic fleet: scripted dropout/rejoin,
                  lossy links, masked re-auction (docs/FAULTS.md)
+- ``resilience`` execution-layer resilience: chunk-boundary checkpoints,
+                 bit-identical resume, retry/degrade, crash injection
+                 (docs/RESILIENCE.md)
 - ``parallel``   agent-axis sharding over device meshes
 - ``harness``    formation library, random formations, supervisor, trials
 - ``interop``    wire-format message types at the host boundary

@@ -121,6 +121,19 @@ class _Buffer:
     def mean(self) -> np.ndarray:
         return np.mean(np.asarray(self.buf), axis=0)
 
+    def snapshot(self):
+        """Stacked (k, ...) array of the buffered samples (None when
+        empty) — the checkpointable form (docs/RESILIENCE.md)."""
+        return np.asarray(list(self.buf)) if self.buf else None
+
+    @classmethod
+    def restore(cls, window: int, snap) -> "_Buffer":
+        b = cls(window)
+        if snap is not None:
+            for row in np.asarray(snap):
+                b.push(row)
+        return b
+
 
 # FSM states (subset relevant post-takeoff, supervisor.py:19-28)
 FLYING, IN_FORMATION, GRIDLOCK, COMPLETE, TERMINATE = range(5)
@@ -523,6 +536,41 @@ class TrialFSM:
         return ([trial] + self.dist.tolist() + list(self.times)
                 + list(self.time_avoidance) + list(self.assignments))
 
+    # -- checkpointing (docs/RESILIENCE.md): the FSM's mutable state as a
+    # plain dict of scalars/lists/arrays — constructor parameters are NOT
+    # snapshotted (the resuming driver rebuilds them from its config, and
+    # the checkpoint manifest's config hash guarantees they agree)
+
+    _SNAP_FIELDS = ("state", "last_state", "timer_ticks", "tick_count",
+                    "curr_formation_idx", "received_assignment",
+                    "is_logging", "times", "time_avoidance", "assignments",
+                    "_log_start_tick", "_grid_enter_tick")
+
+    def snapshot(self) -> dict:
+        snap = {k: getattr(self, k) for k in self._SNAP_FIELDS}
+        snap["dist"] = self.dist.copy()
+        snap["_fx"] = None if self._fx is None else self._fx.copy()
+        snap["_fy"] = None if self._fy is None else self._fy.copy()
+        snap["conv_buf"] = self._conv.snapshot()
+        snap["grid_buf"] = self._grid.snapshot()
+        return snap
+
+    def restore(self, snap: dict) -> "TrialFSM":
+        for k in self._SNAP_FIELDS:
+            setattr(self, k, snap[k])
+        # json round-trips lists, not the originals' copies
+        self.times = list(snap["times"])
+        self.time_avoidance = list(snap["time_avoidance"])
+        self.assignments = list(snap["assignments"])
+        self.dist = np.asarray(snap["dist"]).copy()
+        self._fx = None if snap["_fx"] is None \
+            else np.asarray(snap["_fx"]).copy()
+        self._fy = None if snap["_fy"] is None \
+            else np.asarray(snap["_fy"]).copy()
+        self._conv = _Buffer.restore(self.window, snap["conv_buf"])
+        self._grid = _Buffer.restore(self.window, snap["grid_buf"])
+        return self
+
 
 # ---------------------------------------------------------------------------
 # Summary-driven trial FSM (batched trials: on-device metric reduction)
@@ -829,3 +877,34 @@ class SummaryTrialFSM:
         """Same schema as `TrialFSM.csv_row`."""
         return ([trial] + self.dist.tolist() + list(self.times)
                 + list(self.time_avoidance) + list(self.assignments))
+
+    # -- checkpointing (docs/RESILIENCE.md; same contract as
+    # `TrialFSM.snapshot`: mutable state only, config re-derived)
+
+    _SNAP_FIELDS = ("state", "timer_ticks", "tick_count",
+                    "curr_formation_idx", "is_logging", "_conv_pushes",
+                    "_grid_pushes", "_formation_just_received", "times",
+                    "time_avoidance", "assignments", "_log_start_tick",
+                    "_grid_enter_tick", "_dist_pending")
+
+    def snapshot(self) -> dict:
+        snap = {k: getattr(self, k) for k in self._SNAP_FIELDS}
+        snap["dist"] = self.dist.copy()
+        snap["_last_cumdist"] = (None if self._last_cumdist is None
+                                 else self._last_cumdist.copy())
+        snap["_dist_mark"] = (None if self._dist_mark is None
+                              else self._dist_mark.copy())
+        return snap
+
+    def restore(self, snap: dict) -> "SummaryTrialFSM":
+        for k in self._SNAP_FIELDS:
+            setattr(self, k, snap[k])
+        self.times = list(snap["times"])
+        self.time_avoidance = list(snap["time_avoidance"])
+        self.assignments = list(snap["assignments"])
+        self.dist = np.asarray(snap["dist"]).copy()
+        self._last_cumdist = (None if snap["_last_cumdist"] is None
+                              else np.asarray(snap["_last_cumdist"]).copy())
+        self._dist_mark = (None if snap["_dist_mark"] is None
+                           else np.asarray(snap["_dist_mark"]).copy())
+        return self

@@ -178,6 +178,34 @@ class TrialConfig:
     # per-trial rollout recordings ("bags", `harness.review`): directory
     # for trial_<k>.npz files, or None to skip
     record_dir: Optional[str] = None
+    # resilience (docs/RESILIENCE.md): chunk-boundary checkpoints of the
+    # rollout carries + host FSM, written atomically every
+    # `checkpoint_every` chunks into `checkpoint_dir` (None = off; off
+    # touches nothing — not even the compiled surface). With `resume`,
+    # a matching checkpoint (manifest-validated: config hash, dtype/x64
+    # fingerprint, code version, trial identity) continues the run
+    # BIT-IDENTICALLY; mismatched checkpoints are rejected loudly.
+    # cadence: every 10 chunks (5 s of sim at the 0.5 s default chunk)
+    # keeps measured overhead <5% even on sub-second CPU trials (the
+    # committed resilience_overhead.json artifact); a crash loses at
+    # most `checkpoint_every` chunks of progress
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 10
+    resume: bool = True
+
+
+# config fields that cannot change results — excluded from the
+# checkpoint manifest's config hash so e.g. resuming into a different
+# output CSV stays legal while any engine-visible knob change is caught
+_CKPT_EXCLUDE = ("out", "verbose", "checkpoint_dir", "checkpoint_every",
+                 "resume")
+
+
+def _ckpt_cfg_hash(cfg: "TrialConfig") -> str:
+    from aclswarm_tpu.resilience import checkpoint as ckptlib
+    d = {k: v for k, v in dataclasses.asdict(cfg).items()
+         if k not in _CKPT_EXCLUDE}
+    return ckptlib.config_hash(d)
 
 
 _SIMFORM = re.compile(r"^simform(\d+)$")
@@ -313,6 +341,9 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
     cur_formation, cur_cfg = hover_formation, hover_cfg
     pending_go = False
     pending_dispatch: Optional[int] = None
+    # the last committed formation index (None = pre-dispatch hover) —
+    # enough, with `gains_cache`, to rebuild `cur_formation` on resume
+    committed_idx: Optional[int] = None
     # the first valid auction after a formation commit always counts as an
     # accepted assignment, even if unchanged — the reference's
     # `formation_just_received_` semantics (`auctioneer.cpp:310-316`)
@@ -321,8 +352,44 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
     max_ticks = int(trial_timeout / cfg.control_dt) + 10 * chunk
     recorded: list = []
     ticks_done = 0
+    chunk_idx = 0
 
-    for _ in range(max_ticks // chunk + 1):
+    # --- resilience wiring (docs/RESILIENCE.md) ---
+    from aclswarm_tpu.resilience import (ChunkExecutor, checkpoint as
+                                         ckptlib, maybe_crash)
+    from aclswarm_tpu.utils import get_logger
+    execu = ChunkExecutor(log=get_logger("trials"))
+    ckpt_dir = cfg.checkpoint_dir
+    if ckpt_dir is not None and cfg.record_dir is not None:
+        raise ValueError("checkpoint_dir with record_dir is unsupported: "
+                         "the recorded metric stack does not survive a "
+                         "crash, so a resumed recording would be a lie")
+    stem = f"trial{trial_idx:05d}"
+    cfg_hash = _ckpt_cfg_hash(cfg) if ckpt_dir is not None else None
+    if ckpt_dir is not None and cfg.resume:
+        path = ckptlib.latest_checkpoint(ckpt_dir, stem)
+        if path is not None:
+            payload, man = ckptlib.load_checkpoint(
+                path, expected=ckptlib.expected_manifest(
+                    "trial", cfg_hash, trial=trial_idx))
+            state = ckptlib.restore_tree(state, payload["state"],
+                                         path=path, what="SimState")
+            fsm.restore(payload["fsm"])
+            gains_cache = {int(k): np.asarray(v)
+                           for k, v in payload["gains_cache"].items()}
+            pending_go = payload["pending_go"]
+            pending_dispatch = payload["pending_dispatch"]
+            formation_just_received = payload["formation_just_received"]
+            committed_idx = payload["committed_idx"]
+            ticks_done = payload["ticks_done"]
+            chunk_idx = int(man["chunk"])
+            if committed_idx is not None:
+                spec = specs[committed_idx]
+                cur_formation = make_formation(spec.points, spec.adjmat,
+                                               gains_cache[committed_idx])
+                cur_cfg = fly_cfg
+
+    while chunk_idx < max_ticks // chunk + 1:
         if fsm.done:
             break
         cmd = np.zeros((chunk,), np.int32)
@@ -334,8 +401,10 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
             joy_vel=jnp.zeros((chunk, n, 3), state.swarm.q.dtype),
             joy_yawrate=jnp.zeros((chunk, n), state.swarm.q.dtype),
             joy_active=jnp.zeros((chunk, n), bool))
-        state, metrics = sim.rollout(state, cur_formation, cgains, sparams,
-                                     cur_cfg, chunk, inputs)
+        state, metrics = execu.run(
+            lambda: sim.rollout(state, cur_formation, cgains, sparams,
+                                cur_cfg, chunk, inputs),
+            stage=f"trial{trial_idx}:chunk{chunk_idx}")
         if cfg.record_dir is not None:
             recorded.append(metrics)
         if cfg.check_mode == "on":
@@ -389,7 +458,38 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
                                   tick=jnp.zeros_like(state.tick),
                                   first_auction=jnp.asarray(True))
             formation_just_received = True
+            committed_idx = pending_dispatch
             pending_dispatch = None
+
+        # --- chunk boundary: checkpoint, then the scripted-preemption
+        # hook (checkpoint first, so a crash AT boundary k resumes
+        # from k — the smoke proof's kill point) ---
+        chunk_idx += 1
+        if ckpt_dir is not None and not fsm.done \
+                and chunk_idx % max(1, cfg.checkpoint_every) == 0:
+            payload = {
+                "state": ckptlib.tree_arrays(state),
+                "fsm": fsm.snapshot(),
+                "gains_cache": {str(k): v
+                                for k, v in gains_cache.items()},
+                "pending_go": pending_go,
+                "pending_dispatch": pending_dispatch,
+                "formation_just_received": formation_just_received,
+                "committed_idx": committed_idx,
+                "ticks_done": ticks_done,
+            }
+            ckptlib.write_checkpoint(
+                ckpt_dir, stem, payload,
+                ckptlib.make_manifest("trial", cfg_hash, chunk=chunk_idx,
+                                      trial=trial_idx,
+                                      ticks_done=ticks_done))
+        maybe_crash("trial", chunk_idx)
+
+    if ckpt_dir is not None and fsm.done:
+        # finished: interim checkpoints are dead weight (bounded
+        # retention); the done-marker (`run_trials`) carries the result
+        ckptlib.clear_checkpoints(ckpt_dir, stem)
+    fsm.execution = execu.row_fields()
 
     if cfg.record_dir is not None and recorded:
         import jax
@@ -515,11 +615,55 @@ def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
     pending_dispatch: list[Optional[int]] = [None] * B
     max_ticks = int(trial_timeout / dt) + 10 * chunk
     ticks_done = 0
-    joy_vel = jnp.zeros((chunk, B, n, 3), dtype)
-    joy_yawrate = jnp.zeros((chunk, B, n), dtype)
-    joy_active = jnp.zeros((chunk, B, n), bool)
+    chunk_idx = 0
+    specs_per_orig = list(specs_per)   # original batch order, for resume
 
-    for _ in range(max_ticks // chunk + 1):
+    # --- resilience wiring (docs/RESILIENCE.md; mirrors `run_trial`,
+    # plus batch-compaction safety: the saved `torig` row map restores
+    # per-trial attribution across the power-of-two gathers) ---
+    from aclswarm_tpu.resilience import (ChunkExecutor, checkpoint as
+                                         ckptlib, maybe_crash)
+    from aclswarm_tpu.utils import get_logger
+    execu = ChunkExecutor(log=get_logger("trials"))
+    ckpt_dir = cfg.checkpoint_dir
+    stem = f"wave{trial_indices[0]:05d}_b{B}"
+    cfg_hash = _ckpt_cfg_hash(cfg) if ckpt_dir is not None else None
+    if ckpt_dir is not None and cfg.resume:
+        path = ckptlib.latest_checkpoint(ckpt_dir, stem)
+        if path is not None:
+            payload, man = ckptlib.load_checkpoint(
+                path, expected=ckptlib.expected_manifest(
+                    "trial_batch", cfg_hash,
+                    trials=list(map(int, trial_indices))))
+            # compaction may have shrunk the trial axis: restore against
+            # the full-B templates with a flexible leading axis
+            bstate = ckptlib.restore_tree(bstate, payload["state"],
+                                          batch_flex=True, path=path,
+                                          what="SimState")
+            bform = ckptlib.restore_tree(bform, payload["bform"],
+                                         batch_flex=True, path=path,
+                                         what="Formation")
+            scarry = ckptlib.restore_tree(scarry, payload["scarry"],
+                                          batch_flex=True, path=path,
+                                          what="SummaryCarry")
+            for f, snap in zip(all_fsms, payload["fsms"]):
+                f.restore(snap)
+            live_rows = [int(i) for i in payload["live_rows"]]
+            fsms = [all_fsms[i] for i in live_rows]
+            torig = [trial_indices[i] for i in live_rows]
+            specs_per = [specs_per_orig[i] for i in live_rows]
+            gains_cache = [{int(k): np.asarray(v) for k, v in g.items()}
+                           for g in payload["gains_cache"]]
+            pending_go = list(payload["pending_go"])
+            pending_dispatch = list(payload["pending_dispatch"])
+            ticks_done = payload["ticks_done"]
+            chunk_idx = int(man["chunk"])
+
+    joy_vel = jnp.zeros((chunk, len(fsms), n, 3), dtype)
+    joy_yawrate = jnp.zeros((chunk, len(fsms), n), dtype)
+    joy_active = jnp.zeros((chunk, len(fsms), n), bool)
+
+    while chunk_idx < max_ticks // chunk + 1:
         if all(f.done for f in fsms):
             break
         # compact: once half the rows are dead weight, gather the live
@@ -555,9 +699,11 @@ def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
                                     joy_vel=joy_vel,
                                     joy_yawrate=joy_yawrate,
                                     joy_active=joy_active)
-        bstate, scarry, summ = sumlib.batched_rollout_summary(
-            bstate, scarry, bform, cgains, sparams, fly_cfg, chunk,
-            inputs, 0, window=window, takeoff_alt=takeoff_alt)
+        bstate, scarry, summ = execu.run(
+            lambda: sumlib.batched_rollout_summary(
+                bstate, scarry, bform, cgains, sparams, fly_cfg, chunk,
+                inputs, 0, window=window, takeoff_alt=takeoff_alt),
+            stage=f"wave{trial_indices[0]}:chunk{chunk_idx}")
 
         # the chunk's ONLY host sync: O(B*chunk) bools + (B, n) distances
         if checks:
@@ -613,6 +759,37 @@ def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
                 first_auction=bstate.first_auction.at[b].set(True),
                 assign_enabled=bstate.assign_enabled.at[b].set(True))
             fsm.formation_dispatched()
+
+        # --- chunk boundary: checkpoint (compaction-safe), then the
+        # scripted-preemption hook ---
+        chunk_idx += 1
+        if ckpt_dir is not None and not all(f.done for f in fsms) \
+                and chunk_idx % max(1, cfg.checkpoint_every) == 0:
+            row_of = {t: i for i, t in enumerate(trial_indices)}
+            payload = {
+                "state": ckptlib.tree_arrays(bstate),
+                "bform": ckptlib.tree_arrays(bform),
+                "scarry": ckptlib.tree_arrays(scarry),
+                "fsms": [f.snapshot() for f in all_fsms],
+                "live_rows": [row_of[t] for t in torig],
+                "gains_cache": [{str(k): v for k, v in g.items()}
+                                for g in gains_cache],
+                "pending_go": list(pending_go),
+                "pending_dispatch": list(pending_dispatch),
+                "ticks_done": ticks_done,
+            }
+            ckptlib.write_checkpoint(
+                ckpt_dir, stem, payload,
+                ckptlib.make_manifest(
+                    "trial_batch", cfg_hash, chunk=chunk_idx,
+                    trials=list(map(int, trial_indices)),
+                    ticks_done=ticks_done))
+        maybe_crash("batch", chunk_idx)
+
+    if ckpt_dir is not None and all(f.done for f in all_fsms):
+        ckptlib.clear_checkpoints(ckpt_dir, stem)
+    for f in all_fsms:
+        f.execution = execu.row_fields()
     return all_fsms
 
 
@@ -664,41 +841,144 @@ def print_analysis(stats: dict) -> None:
           f"mean {stats['dist_mean_m']:.2f} / std {stats['dist_std_m']:.2f} m")
 
 
+def _csv_trial_ids(path: str) -> set[int]:
+    """Trial ids (column 0) already appended to the CSV — read ONCE at
+    `run_trials` startup (rows are append-only, so the set plus in-run
+    additions stays exact; a per-trial rescan would be quadratic in
+    trial count). Resumed runs use it to make appends idempotent:
+    re-appending a recomputed (bit-identical) row is the only
+    duplication risk, and this closes it."""
+    p = Path(path)
+    ids: set[int] = set()
+    if not p.exists():
+        return ids
+    with open(p) as f:
+        for line in f:
+            first = line.split(",", 1)[0].strip()
+            try:
+                ids.add(int(float(first)))
+            except ValueError:
+                continue
+    return ids
+
+
+_FSM_CLASSES = {"TrialFSM": TrialFSM, "SummaryTrialFSM": SummaryTrialFSM}
+
+
+def _write_done_marker(cfg: TrialConfig, key: str, pairs: list) -> None:
+    """Persist finished trials (``pairs`` = [(trial_idx, fsm), ...]) so a
+    resumed `run_trials` replays results instead of recomputing them."""
+    from aclswarm_tpu.resilience import checkpoint as ckptlib
+    payload = {"trials": [
+        {"trial": int(t), "cls": type(f).__name__, "snap": f.snapshot(),
+         "ctor": {"n_vehicles": f.n, "n_formations": f.n_formations,
+                  "takeoff_alt": float(f.takeoff_alt), "dt": f.dt,
+                  "trial_timeout": f.trial_timeout}}
+        for t, f in pairs]}
+    ckptlib.write_checkpoint(
+        cfg.checkpoint_dir, f"{key}.done", payload,
+        ckptlib.make_manifest("trials_done", _ckpt_cfg_hash(cfg), chunk=0,
+                              key=key),
+        keep=1)
+
+
+def _load_done_marker(cfg: TrialConfig, key: str):
+    """[(trial_idx, restored fsm), ...] from a done-marker, or None when
+    absent. Mismatched markers raise (`CheckpointMismatch`) — loudly."""
+    from aclswarm_tpu.resilience import checkpoint as ckptlib
+    path = ckptlib.latest_checkpoint(cfg.checkpoint_dir, f"{key}.done")
+    if path is None:
+        return None
+    payload, _ = ckptlib.load_checkpoint(
+        path, expected=ckptlib.expected_manifest(
+            "trials_done", _ckpt_cfg_hash(cfg), key=key))
+    out = []
+    for rec in payload["trials"]:
+        fsm = _FSM_CLASSES[rec["cls"]](**rec["ctor"])
+        fsm.restore(rec["snap"])
+        out.append((int(rec["trial"]), fsm))
+    return out
+
+
 def run_trials(cfg: TrialConfig) -> dict:
     """The `trials.sh` loop: K seeded trials, append completed rows to the
     CSV, print the `analyze_simtrials` summary. Returns the stats dict.
     With ``cfg.batch > 1`` the trials run in waves of `batch` through the
     vmapped rollout (`run_trial_batch`); rows are appended as each trial
     (serial) or wave (batched) finishes, so a crash mid-run keeps the
-    evidence gathered so far — CSV order is trial order either way."""
+    evidence gathered so far — CSV order is trial order either way.
+
+    With ``cfg.checkpoint_dir`` set, every finished trial/wave leaves a
+    done-marker and every in-flight trial checkpoints at chunk
+    boundaries: a killed run resumed with the same config replays
+    finished results and continues the interrupted trial bit-identically
+    (docs/RESILIENCE.md); CSV appends are idempotent by trial id."""
     rows = []
     n = None
+    ckpt = cfg.checkpoint_dir is not None
+    appended_ids = _csv_trial_ids(cfg.out) if ckpt else set()
+    exec_meta: dict = {}
 
-    def _log_and_append(t, fsm):
+    def _note_execution(fsm):
+        ex = getattr(fsm, "execution", None)
+        if ex:
+            exec_meta["retries"] = exec_meta.get("retries", 0) \
+                + ex.get("retries", 0)
+            if ex.get("degraded"):
+                exec_meta["degraded"] = True
+            exec_meta.setdefault("execution_failures", []).extend(
+                ex.get("execution_failures", []))
+
+    def _log_and_append(t, fsm, replayed=False):
         nonlocal n
         n = fsm.n
+        _note_execution(fsm)
         if cfg.verbose:
             times = ", ".join(f"{x:.2f}" for x in fsm.times)
+            replay = " [resumed]" if replayed else ""
             print(f"trial {t} (seed {cfg.seed + t}): {NAMES[fsm.state]}"
-                  f" [conv times: {times}]", flush=True)
+                  f" [conv times: {times}]{replay}", flush=True)
         if fsm.completed:
             row = fsm.csv_row(t)
             rows.append(row)
-            with open(cfg.out, "a", newline="") as f:
-                csv.writer(f).writerow(row)
+            if not (ckpt and t in appended_ids):
+                with open(cfg.out, "a", newline="") as f:
+                    csv.writer(f).writerow(row)
+                appended_ids.add(t)
 
     if cfg.batch > 1:
         for start in range(0, cfg.trials, cfg.batch):
             idxs = list(range(start, min(start + cfg.batch, cfg.trials)))
-            for t, fsm in zip(idxs, run_trial_batch(cfg, idxs)):
+            key = f"wave{idxs[0]:05d}"
+            done = _load_done_marker(cfg, key) \
+                if ckpt and cfg.resume else None
+            if done is not None:
+                for t, fsm in done:
+                    _log_and_append(t, fsm, replayed=True)
+                continue
+            pairs = list(zip(idxs, run_trial_batch(cfg, idxs)))
+            for t, fsm in pairs:
                 _log_and_append(t, fsm)
+            if ckpt:
+                _write_done_marker(cfg, key, pairs)
     else:
         for t in range(cfg.trials):
-            _log_and_append(t, run_trial(cfg, t))
+            key = f"trial{t:05d}"
+            done = _load_done_marker(cfg, key) \
+                if ckpt and cfg.resume else None
+            if done is not None:
+                _log_and_append(*done[0], replayed=True)
+                continue
+            fsm = run_trial(cfg, t)
+            _log_and_append(t, fsm)
+            if ckpt:
+                _write_done_marker(cfg, key, [(t, fsm)])
     if rows:
         stats = analyze(np.asarray(rows, dtype=np.float64), n, cfg.trials)
     else:
         stats = analyze(np.empty((0, 0)), n or 0, cfg.trials)
+    if exec_meta:
+        stats["resilience"] = exec_meta
     if cfg.verbose:
         print_analysis(stats)
     return stats

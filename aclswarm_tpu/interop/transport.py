@@ -117,15 +117,18 @@ def send_reliable(channel: "Channel", msg, grace_s: float = 1.0,
     intent of the reference's subscriptions (`coordination_ros.cpp
     :417-418`) — shared by the bridge daemon and the shm planner client
     for frames that must not vanish (formation commits, KILL broadcasts,
-    one-shot assignments)."""
-    import time
+    one-shot assignments).
 
-    deadline = time.time() + grace_s
-    while not channel.send(msg):
-        if time.time() > deadline:
-            if log is not None:
-                log.warning("DROPPED %s on %s after %ss backpressure",
-                            type(msg).__name__, channel.name, grace_s)
-            return False
-        time.sleep(poll_s)
-    return True
+    The loop itself lives in the unified retry layer
+    (`aclswarm_tpu.utils.retry.poll_until`, docs/RESILIENCE.md): fixed
+    poll cadence — an SPSC ring drains on its own, backoff would only
+    add dispatch latency — against a hard grace deadline."""
+    from aclswarm_tpu.utils.retry import poll_until
+
+    if poll_until(lambda: channel.send(msg), grace_s=grace_s,
+                  poll_s=poll_s):
+        return True
+    if log is not None:
+        log.warning("DROPPED %s on %s after %ss backpressure",
+                    type(msg).__name__, channel.name, grace_s)
+    return False

@@ -58,9 +58,42 @@ def initialize(coordinator_address: str | None = None,
             # genuinely no cluster env: run locally
             return jax.process_count() > 1
     else:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes, process_id=process_id)
+        # explicit-cluster bring-up: the coordinator (process 0) may not
+        # be listening yet when a follower starts — the classic bring-up
+        # race `pod_up.sh` hits when hosts launch in parallel. Retry the
+        # handshake under the unified policy (docs/RESILIENCE.md)
+        # instead of requiring operators to sequence their ssh loops;
+        # non-transient failures (bad address, version skew) surface on
+        # the first attempt.
+        from aclswarm_tpu.utils.retry import RetryPolicy, retry_call
+
+        def _handshake_transient(e: BaseException) -> bool:
+            s = str(e)
+            return isinstance(e, (RuntimeError, ConnectionError)) and any(
+                m in s for m in ("UNAVAILABLE", "DEADLINE", "connect",
+                                 "refused", "unreachable"))
+
+        def _attempt():
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes, process_id=process_id)
+            except BaseException:
+                # jax assigns global_state.client/service BEFORE the
+                # connect, and a second initialize() with them set
+                # raises 'should only be called once' — so a failed
+                # handshake must be torn down or the retry can never
+                # succeed (it would just mask the real error)
+                try:
+                    jax.distributed.shutdown()
+                except Exception:
+                    pass
+                raise
+
+        retry_call(_attempt,
+                   policy=RetryPolicy(attempts=5, base_s=0.5, max_s=4.0,
+                                      budget_s=30.0),
+                   retryable=_handshake_transient)
     return jax.process_count() > 1
 
 

@@ -1,0 +1,47 @@
+"""Resilient execution layer (docs/RESILIENCE.md).
+
+PR 2 made *in-sim* faults first-class (`aclswarm_tpu.faults`: vehicles
+drop, links lose packets — inside the simulated world). This package
+covers the other half: faults of the EXECUTION substrate — a preempted
+host, a wedged device tunnel, a killed benchmark suite. Three pieces:
+
+- ``checkpoint``: chunk-boundary checkpointing of the rollout carries
+  (SimState / summary carry / trial-FSM snapshots) in a dependency-free
+  framed codec with a validated manifest; resume is bit-identical
+  (proven in tier-1, tests/test_resilience.py);
+- ``crash``: scripted preemption (exception or SIGKILL at a chosen
+  chunk/grid boundary, plans-as-data like `FaultSchedule`) driving the
+  resume-equivalence proofs;
+- ``executor``: the chunk-level launch wrapper — transient device
+  failures retry under the unified `utils.retry` policy, exhausted
+  retries degrade to the CPU backend with a loud marker and a
+  structured `ExecutionFailure` record instead of killing the run.
+
+The compiled surface is untouched: checkpoints serialize carries the
+engine already returns at chunk boundaries, so `check_mode`-off HLO
+digests stay on the committed baseline (`trace_audit`)."""
+from aclswarm_tpu.resilience.checkpoint import (CheckpointCorrupt,
+                                                CheckpointError,
+                                                CheckpointMismatch,
+                                                clear_checkpoints,
+                                                config_hash,
+                                                dtype_fingerprint,
+                                                expected_manifest,
+                                                latest_checkpoint,
+                                                load_checkpoint,
+                                                make_manifest,
+                                                restore_tree, tree_arrays,
+                                                write_checkpoint)
+from aclswarm_tpu.resilience.crash import (CrashPlan, InjectedCrash, arm,
+                                           maybe_crash)
+from aclswarm_tpu.resilience.executor import (ChunkExecutor,
+                                              is_transient_device_error)
+
+__all__ = [
+    "CheckpointCorrupt", "CheckpointError", "CheckpointMismatch",
+    "clear_checkpoints", "config_hash", "dtype_fingerprint",
+    "expected_manifest", "latest_checkpoint", "load_checkpoint",
+    "make_manifest", "restore_tree", "tree_arrays", "write_checkpoint",
+    "CrashPlan", "InjectedCrash", "arm", "maybe_crash",
+    "ChunkExecutor", "is_transient_device_error",
+]

@@ -1,0 +1,353 @@
+"""Chunk-boundary checkpoint codec: deterministic resume for rollouts.
+
+A preempted host or a killed suite used to lose every completed chunk of
+a long rollout and every completed cell of a sweep grid. This module is
+the dependency-free container the drivers write at chunk boundaries
+(`harness/trials.py`, `benchmarks/faults_suite.py`) so a resumed run
+continues BIT-IDENTICALLY from where the dead one stopped
+(tests/test_resilience.py pins the equivalence; docs/RESILIENCE.md).
+
+Frame layout (little-endian, `interop/codec.py` idioms — magic, version,
+CRC, length-prefixed sections; no pickle, no third-party deps):
+
+    u32  magic   = 0x4B435341  ("ASCK" in LE byte order)
+    u8   version = FORMAT_VERSION
+    u8   reserved, u16 reserved
+    u32  meta_len
+    u32  n_arrays
+    u32  crc32(everything after this field)
+    meta JSON bytes                  {"manifest": {...}, "payload": spec}
+    per array: u16 dtype_len, dtype str, u8 ndim, u64 shape[ndim],
+               u64 nbytes, raw little-endian bytes
+
+The *payload* is a nested structure of dicts (str keys), lists, scalars
+(int/float/bool/str/None) and numpy arrays; arrays are replaced in the
+JSON spec by ``{"__array__": index}`` references into the array table.
+JSON floats round-trip exactly (repr since py3.1), raw array bytes are
+bit-exact — the codec never perturbs a value.
+
+The **manifest** carries everything that makes a checkpoint *wrong* to
+resume from: the config hash of the producing run, the dtype/x64-mode
+fingerprint, the code + format versions, a ``kind`` tag, and the chunk
+progress. `load` validates an expected subset and raises a structured
+`CheckpointMismatch` — stale or foreign checkpoints are rejected loudly,
+never silently re-traced into wrong results. Truncated or corrupted
+files raise `CheckpointCorrupt` (CRC over the whole body).
+
+Writes are atomic (tmp + `os.replace` in the same directory) with
+bounded retention (`write_checkpoint(..., keep=K)` prunes older files of
+the same stem).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import struct
+from pathlib import Path
+from typing import Any, Optional
+
+import numpy as np
+
+MAGIC = 0x4B435341                   # "ASCK" little-endian
+FORMAT_VERSION = 1
+_HDR = struct.Struct("<IBBHIII")     # magic, ver, r8, r16, meta, narr, crc
+SUFFIX = ".ckpt"
+
+
+class CheckpointError(RuntimeError):
+    """Base class: anything wrong with reading/validating a checkpoint."""
+
+
+class CheckpointCorrupt(CheckpointError):
+    """Truncated, garbled, or CRC-failing checkpoint file."""
+
+    def __init__(self, path, detail: str):
+        self.path = str(path)
+        self.detail = detail
+        super().__init__(f"corrupt checkpoint {path}: {detail}")
+
+
+class CheckpointMismatch(CheckpointError):
+    """Structurally valid checkpoint that must NOT be resumed from:
+    the manifest (or a restored pytree leaf) contradicts the resuming
+    run. ``mismatches`` lists (field, expected, found) triples."""
+
+    def __init__(self, path, mismatches: list):
+        self.path = str(path)
+        self.mismatches = list(mismatches)
+        lines = "; ".join(f"{f}: expected {e!r}, found {g!r}"
+                          for f, e, g in self.mismatches)
+        super().__init__(
+            f"checkpoint {path} rejected ({lines}) — delete it or rerun "
+            "with the producing configuration; resuming would silently "
+            "compute wrong results")
+
+
+# ---------------------------------------------------------------------------
+# payload spec <-> arrays
+
+def _encode(obj, arrays: list) -> Any:
+    if isinstance(obj, np.ndarray):
+        # NOT ascontiguousarray: that helper promotes 0-d to 1-d (shape
+        # () -> (1,)), which would corrupt scalar carry leaves like
+        # SimState.tick — asarray(order="C") preserves 0-d
+        arrays.append(np.asarray(obj, order="C"))
+        return {"__array__": len(arrays) - 1}
+    if isinstance(obj, np.generic):          # numpy scalar -> python scalar
+        return _encode(np.asarray(obj), arrays)
+    if isinstance(obj, dict):
+        if any(not isinstance(k, str) for k in obj):
+            raise TypeError("checkpoint payload dict keys must be str")
+        if "__array__" in obj:
+            raise TypeError("'__array__' is a reserved payload key")
+        return {k: _encode(v, arrays) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_encode(v, arrays) for v in obj]
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    raise TypeError(f"unsupported checkpoint payload type {type(obj)!r}")
+
+
+def _decode(spec, arrays: list) -> Any:
+    if isinstance(spec, dict):
+        if set(spec) == {"__array__"}:
+            return arrays[spec["__array__"]]
+        return {k: _decode(v, arrays) for k, v in spec.items()}
+    if isinstance(spec, list):
+        return [_decode(v, arrays) for v in spec]
+    return spec
+
+
+# ---------------------------------------------------------------------------
+# frame codec
+
+def dumps(payload, manifest: dict) -> bytes:
+    """Serialize one checkpoint frame (see module docstring)."""
+    arrays: list = []
+    spec = _encode(payload, arrays)
+    meta = json.dumps({"manifest": manifest, "payload": spec},
+                      sort_keys=True).encode()
+    parts = [meta]
+    for a in arrays:
+        raw = a.astype(a.dtype.newbyteorder("<"), copy=False).tobytes()
+        parts.append(struct.pack("<H", len(a.dtype.str))
+                     + a.dtype.str.encode()
+                     + struct.pack("<B", a.ndim)
+                     + struct.pack(f"<{a.ndim}Q", *a.shape)
+                     + struct.pack("<Q", len(raw)) + raw)
+    body = b"".join(parts)
+    crc = zlib_crc(body)
+    return _HDR.pack(MAGIC, FORMAT_VERSION, 0, 0, len(meta), len(arrays),
+                     crc) + body
+
+
+def zlib_crc(b: bytes) -> int:
+    import zlib
+    return zlib.crc32(b) & 0xFFFFFFFF
+
+
+def loads(buf: bytes, path="<bytes>") -> tuple[Any, dict]:
+    """Parse one frame; returns (payload, manifest). Raises
+    `CheckpointCorrupt` on any structural damage."""
+    if len(buf) < _HDR.size:
+        raise CheckpointCorrupt(path, "short header")
+    magic, ver, _, _, meta_len, n_arrays, crc = _HDR.unpack_from(buf, 0)
+    if magic != MAGIC:
+        raise CheckpointCorrupt(path, f"bad magic 0x{magic:08X}")
+    if ver != FORMAT_VERSION:
+        # a future format is indistinguishable from corruption to this
+        # reader; the mismatch class gives the actionable message
+        raise CheckpointMismatch(
+            path, [("format_version", FORMAT_VERSION, ver)])
+    body = buf[_HDR.size:]
+    if zlib_crc(body) != crc:
+        raise CheckpointCorrupt(path, "crc mismatch (truncated or "
+                                "bit-rotted body)")
+    try:
+        meta = json.loads(body[:meta_len].decode())
+        off = meta_len
+        arrays = []
+        for _ in range(n_arrays):
+            (dlen,) = struct.unpack_from("<H", body, off)
+            off += 2
+            dtype = np.dtype(body[off:off + dlen].decode())
+            off += dlen
+            (ndim,) = struct.unpack_from("<B", body, off)
+            off += 1
+            shape = struct.unpack_from(f"<{ndim}Q", body, off)
+            off += 8 * ndim
+            (nbytes,) = struct.unpack_from("<Q", body, off)
+            off += 8
+            raw = body[off:off + nbytes]
+            if len(raw) != nbytes:
+                raise ValueError("array data truncated")
+            off += nbytes
+            arrays.append(np.frombuffer(raw, dtype.newbyteorder("<"))
+                          .reshape(shape).astype(dtype, copy=False))
+    except (ValueError, KeyError, struct.error, UnicodeDecodeError) as e:
+        # CRC passed but the body does not parse: still corruption (the
+        # CRC guards bit rot, not a malicious/garbage writer)
+        raise CheckpointCorrupt(path, f"unparseable body ({e})") from e
+    return _decode(meta["payload"], arrays), meta["manifest"]
+
+
+# ---------------------------------------------------------------------------
+# manifest helpers
+
+def code_version() -> str:
+    import aclswarm_tpu
+    return aclswarm_tpu.__version__
+
+
+def dtype_fingerprint() -> str:
+    """The precision mode the producing run compiled under: resuming an
+    f64 rollout in f32 mode would retrace into different numerics."""
+    import jax
+    import jax.numpy as jnp
+    return (f"x64={bool(jax.config.jax_enable_x64)},"
+            f"float={jnp.dtype(jnp.result_type(float)).name}")
+
+
+def config_hash(cfg_dict: dict) -> str:
+    """Canonical-JSON SHA-256 of a configuration dict (callers drop the
+    fields that cannot change results — output paths, verbosity, the
+    checkpoint knobs themselves)."""
+    blob = json.dumps(cfg_dict, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def make_manifest(kind: str, cfg_hash: str, chunk: int, **extra) -> dict:
+    m = {"kind": kind, "config_hash": cfg_hash, "chunk": int(chunk),
+         "format_version": FORMAT_VERSION, "code_version": code_version(),
+         "dtype": dtype_fingerprint()}
+    m.update(extra)
+    return m
+
+
+def check_manifest(path, found: dict, expected: dict) -> None:
+    """Raise `CheckpointMismatch` listing every expected field the found
+    manifest contradicts (missing counts as contradicting)."""
+    bad = [(k, v, found.get(k)) for k, v in expected.items()
+           if found.get(k) != v]
+    if bad:
+        raise CheckpointMismatch(path, bad)
+
+
+def expected_manifest(kind: str, cfg_hash: str, **extra) -> dict:
+    """The validation subset a resuming driver must insist on (progress
+    fields like ``chunk`` are read, not matched)."""
+    e = {"kind": kind, "config_hash": cfg_hash,
+         "format_version": FORMAT_VERSION, "code_version": code_version(),
+         "dtype": dtype_fingerprint()}
+    e.update(extra)
+    return e
+
+
+# ---------------------------------------------------------------------------
+# pytree leaves <-> arrays (template-validated restore)
+
+def tree_arrays(tree) -> list:
+    """Host copies of a jax pytree's leaves, in flatten order (None
+    leaves are empty subtrees in jax and drop out symmetrically)."""
+    import jax
+    return [np.asarray(x) for x in jax.tree.leaves(tree)]
+
+
+def restore_tree(template, arrays: list, *, batch_flex: bool = False,
+                 path="<arrays>", what: str = "tree"):
+    """Rebuild a pytree with ``template``'s structure from checkpointed
+    leaf arrays, validating every leaf's dtype and shape against the
+    template (``batch_flex`` relaxes ONLY axis 0 — the batched drivers'
+    power-of-two compaction legitimately shrinks the trial axis).
+    Validation failure is a `CheckpointMismatch`: a leaf that no longer
+    lines up means the checkpoint predates a structural change and must
+    not be poured into the new carry."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree.flatten(template)
+    bad = []
+    if len(arrays) != len(leaves):
+        raise CheckpointMismatch(
+            path, [(f"{what}.n_leaves", len(leaves), len(arrays))])
+    for i, (t, a) in enumerate(zip(leaves, arrays)):
+        t_dt, a_dt = jnp.asarray(t).dtype, a.dtype
+        if t_dt != a_dt:
+            bad.append((f"{what}[{i}].dtype", str(t_dt), str(a_dt)))
+            continue
+        ts, s = tuple(np.shape(t)), tuple(a.shape)
+        if batch_flex and len(ts) == len(s) and len(ts) >= 1 \
+                and ts[1:] == s[1:]:
+            continue
+        if ts != s:
+            bad.append((f"{what}[{i}].shape", ts, s))
+    if bad:
+        raise CheckpointMismatch(path, bad)
+    return jax.tree.unflatten(treedef, [jnp.asarray(a) for a in arrays])
+
+
+# ---------------------------------------------------------------------------
+# files: atomic write, bounded retention, latest lookup
+
+def _ckpt_name(stem: str, chunk: int) -> str:
+    return f"{stem}.c{chunk:08d}{SUFFIX}"
+
+
+def write_checkpoint(directory, stem: str, payload, manifest: dict,
+                     keep: int = 2) -> Path:
+    """Atomically write ``{stem}.c{chunk:08d}.ckpt`` under ``directory``
+    (tmp + rename, same filesystem) and prune all but the newest
+    ``keep`` checkpoints of the same stem. The manifest's ``chunk``
+    orders retention."""
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    path = directory / _ckpt_name(stem, int(manifest["chunk"]))
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_bytes(dumps(payload, manifest))
+    os.replace(tmp, path)           # atomic on POSIX (same directory)
+    if keep > 0:
+        old = sorted(directory.glob(f"{stem}.c*{SUFFIX}"))[:-keep]
+        for p in old:
+            p.unlink(missing_ok=True)
+    return path
+
+
+def latest_checkpoint(directory, stem: str) -> Optional[Path]:
+    """Newest checkpoint of ``stem`` (by chunk index in the name), or
+    None. A corrupt newest file is the LOADER's loud failure — this
+    lookup never silently falls back to an older file."""
+    directory = Path(directory)
+    if not directory.is_dir():
+        return None
+    found = sorted(directory.glob(f"{stem}.c*{SUFFIX}"))
+    return found[-1] if found else None
+
+
+def load_checkpoint(path, expected: Optional[dict] = None
+                    ) -> tuple[Any, dict]:
+    """Read + validate one checkpoint file; returns (payload, manifest).
+    Raises `CheckpointCorrupt` / `CheckpointMismatch` — loudly, with the
+    offending fields — instead of ever resuming from the wrong state."""
+    path = Path(path)
+    try:
+        buf = path.read_bytes()
+    except OSError as e:
+        raise CheckpointCorrupt(path, f"unreadable ({e})") from e
+    payload, manifest = loads(buf, path)
+    if expected is not None:
+        check_manifest(path, manifest, expected)
+    return payload, manifest
+
+
+def clear_checkpoints(directory, stem: str) -> int:
+    """Delete every checkpoint of ``stem`` (a finished trial's interim
+    files); returns the count removed."""
+    directory = Path(directory)
+    if not directory.is_dir():
+        return 0
+    n = 0
+    for p in directory.glob(f"{stem}.c*{SUFFIX}"):
+        p.unlink(missing_ok=True)
+        n += 1
+    return n

@@ -1,0 +1,94 @@
+"""Scripted preemption: crash plans as data, consulted at boundaries.
+
+The fault subsystem's design rule (docs/FAULTS.md: fault timelines are
+data, never control flow) applied to the EXECUTION layer: a `CrashPlan`
+declares *where* a run dies — a named boundary site ('trial', 'batch',
+'suite') and a boundary index — and *how* (a raised `InjectedCrash`, or
+a real ``SIGKILL`` for the nothing-survives proof). Drivers call
+`maybe_crash(site, k)` at every checkpoint boundary; unarmed it is a
+no-op, armed it kills the run exactly once, deterministically.
+
+This exists to PROVE resume: the tier-1 equivalence tests and the
+`scripts/check.sh` smoke (`python -m aclswarm_tpu.resilience.smoke`)
+kill a run at a chosen chunk, resume from the checkpoint, and assert
+bit-identical results against an uninterrupted run.
+
+Arming: in-process via `arm(CrashPlan(...))` (tests), or across a
+process boundary via the ``ACLSWARM_CRASH`` environment variable
+(``site:boundary[:kind]``, e.g. ``trial:1:kill``) — the subprocess
+SIGKILL proofs use the env form.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+from typing import Optional
+
+ENV_VAR = "ACLSWARM_CRASH"
+KINDS = ("raise", "kill")
+
+
+class InjectedCrash(RuntimeError):
+    """The scripted preemption (exception form). Deliberately NOT a
+    transient device error: the retry layer must let it through —
+    a preemption is survived by checkpoint/resume, not by retrying."""
+
+
+@dataclasses.dataclass(frozen=True)
+class CrashPlan:
+    """Die at boundary ``boundary`` of site ``site`` (0-based count of
+    completed chunks/cells at the moment the driver consults us)."""
+
+    site: str
+    boundary: int
+    kind: str = "raise"          # 'raise' -> InjectedCrash, 'kill' -> SIGKILL
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"crash kind must be one of {KINDS}, "
+                             f"got {self.kind!r}")
+
+    def encode(self) -> str:
+        return f"{self.site}:{self.boundary}:{self.kind}"
+
+    @classmethod
+    def decode(cls, s: str) -> "CrashPlan":
+        parts = s.split(":")
+        if len(parts) not in (2, 3):
+            raise ValueError(f"bad {ENV_VAR} spec {s!r} "
+                             "(want site:boundary[:kind])")
+        return cls(site=parts[0], boundary=int(parts[1]),
+                   kind=parts[2] if len(parts) == 3 else "raise")
+
+
+_armed: Optional[CrashPlan] = None
+
+
+def arm(plan: Optional[CrashPlan]) -> None:
+    """Install (or with None, clear) the in-process crash plan."""
+    global _armed
+    _armed = plan
+
+
+def active_plan() -> Optional[CrashPlan]:
+    """The in-process plan, else the ``ACLSWARM_CRASH`` env plan."""
+    if _armed is not None:
+        return _armed
+    spec = os.environ.get(ENV_VAR)
+    return CrashPlan.decode(spec) if spec else None
+
+
+def maybe_crash(site: str, boundary: int) -> None:
+    """Consulted by drivers at each checkpoint boundary; dies iff the
+    active plan names this exact (site, boundary). One-shot: the plan is
+    disarmed before dying so a resumed in-process run sails past."""
+    plan = active_plan()
+    if plan is None or plan.site != site or plan.boundary != boundary:
+        return
+    arm(None)
+    os.environ.pop(ENV_VAR, None)
+    if plan.kind == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)   # nothing survives this
+    raise InjectedCrash(
+        f"scripted preemption at {site} boundary {boundary}")

@@ -1,0 +1,141 @@
+"""Chunk-level execution wrapper: retry transient device failures,
+degrade to CPU loudly instead of dying.
+
+The trial drivers launch one compiled program per chunk; through a
+remote-device tunnel that launch can fail transiently (connection reset,
+RESOURCE_EXHAUSTED on a busy chip, DEADLINE on a wedged dispatch). The
+old behavior was to die and lose the whole run. `ChunkExecutor` wraps
+each launch with the unified retry policy (`utils/retry.py`) and — when
+retries are exhausted on a non-CPU backend — re-runs the chunk on the
+CPU backend with a LOUD downgrade marker instead of aborting: a slow
+correct answer plus an `ExecutionFailure` record beats a dead run.
+
+What is and is not retryable:
+
+- transient device errors (matched by exception type name + message
+  markers) are retried with backoff;
+- `InjectedCrash` (scripted preemption) and ordinary Python bugs
+  surface immediately — preemption is survived by checkpoint/resume,
+  not by retrying;
+- a retry that trips jax's deleted-buffer error (the chunk's donated
+  carry was already consumed when the failure landed) is NOT retryable
+  either: the executor surfaces the original failure with a record
+  telling the operator to resume from the checkpoint — the carry is
+  gone, only the checkpoint has the state.
+
+Every retry and downgrade lands in ``failures`` /
+``retries``/``degraded`` counters, which the suites commit into their
+results JSON (`benchmarks/check_results.py` validates the fields).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from aclswarm_tpu.resilience.crash import InjectedCrash
+from aclswarm_tpu.utils.retry import (ExecutionFailure, RetryPolicy,
+                                      retry_call)
+
+# message markers of the transient device-failure class (XLA status
+# codes + tunnel/transport symptoms); type names checked alongside so a
+# bare XlaRuntimeError without a code still counts
+TRANSIENT_MARKERS = ("RESOURCE_EXHAUSTED", "UNAVAILABLE", "DEADLINE",
+                     "ABORTED", "DATA_LOSS", "INTERNAL", "connection",
+                     "socket closed", "tunnel")
+TRANSIENT_TYPES = ("XlaRuntimeError",)
+# donated-and-consumed carries cannot be replayed — resume instead
+_DELETED_MARKERS = ("deleted", "donated")
+
+
+def is_transient_device_error(e: BaseException) -> bool:
+    if isinstance(e, InjectedCrash):
+        return False
+    s = str(e)
+    if any(m in s for m in _DELETED_MARKERS):
+        return False
+    return (type(e).__name__ in TRANSIENT_TYPES
+            or any(m in s for m in TRANSIENT_MARKERS))
+
+
+class ChunkExecutor:
+    """Run per-chunk device launches under the unified retry policy.
+
+    One executor per driver run; it accumulates ``retries`` (total
+    retried attempts), ``degraded`` (any chunk fell back to CPU) and
+    ``failures`` (structured records) for the run's results row."""
+
+    def __init__(self, policy: Optional[RetryPolicy] = None,
+                 cpu_fallback: bool = True, log=None,
+                 transient: Callable[[BaseException], bool]
+                 = is_transient_device_error):
+        self.policy = policy or RetryPolicy(attempts=3, base_s=0.2,
+                                            max_s=5.0)
+        self.cpu_fallback = cpu_fallback
+        self.log = log
+        self.transient = transient
+        self.retries = 0
+        self.degraded = False
+        self.failures: list[ExecutionFailure] = []
+
+    def _warn(self, msg: str) -> None:
+        if self.log is not None:
+            self.log.warning(msg)
+
+    def run(self, fn: Callable, *args, stage: str = "chunk"):
+        """Execute ``fn(*args)`` with retry + CPU fallback. The thunk
+        must be replay-safe up to donation: if its donated inputs were
+        consumed before the failure, jax raises the deleted-buffer
+        error, which is classified non-retryable and surfaced with a
+        resume-from-checkpoint record."""
+        t0 = time.monotonic()
+
+        def note_retry(attempt: int, e: BaseException) -> None:
+            self.retries += 1
+            self._warn(f"{stage}: transient device failure "
+                       f"(attempt {attempt + 1}/"
+                       f"{self.policy.attempts}): {e}")
+
+        try:
+            return retry_call(fn, *args, policy=self.policy,
+                              retryable=self.transient,
+                              on_retry=note_retry)
+        except BaseException as e:      # noqa: BLE001 — classified below
+            if isinstance(e, InjectedCrash) or not self.transient(e):
+                raise
+            if not self.cpu_fallback:
+                self.failures.append(ExecutionFailure(
+                    stage=stage, error=str(e),
+                    attempts=self.policy.attempts,
+                    elapsed_s=time.monotonic() - t0))
+                raise
+            # LOUD downgrade: correctness is preserved (same program,
+            # same inputs), speed is not — the marker makes sure nobody
+            # mistakes a degraded artifact for a device measurement
+            self._warn(f"{stage}: device failed after "
+                       f"{self.policy.attempts} attempts ({e}); "
+                       "DEGRADING to the CPU backend for this chunk")
+            self.degraded = True
+            self.failures.append(ExecutionFailure(
+                stage=stage, error=str(e),
+                attempts=self.policy.attempts,
+                elapsed_s=time.monotonic() - t0, fallback="cpu"))
+            return self._run_on_cpu(fn, *args)
+
+    def _run_on_cpu(self, fn: Callable, *args):
+        import jax
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            return fn(*args)
+
+    def row_fields(self) -> dict:
+        """The results-JSON metadata this run earned (empty dict when the
+        happy path held — artifacts stay byte-identical to pre-resilience
+        runs unless something actually happened)."""
+        out: dict = {}
+        if self.retries:
+            out["retries"] = self.retries
+        if self.degraded:
+            out["degraded"] = True
+        if self.failures:
+            out["execution_failures"] = [f.to_row() for f in self.failures]
+        return out

@@ -1,0 +1,197 @@
+"""Unified retry / timeout / backoff policy (docs/RESILIENCE.md).
+
+Before this module every layer that had to survive a transient failure
+grew its own loop: the shm transport's backpressure poll
+(`interop/transport.py send_reliable`), bench.py's watchdog timer +
+subprocess device probe, and the trial FSM's timeout counters. This is
+the single home for that machinery:
+
+- `RetryPolicy` / `delay_for`: exponential backoff with DETERMINISTIC
+  jitter (a pure hash of (seed, attempt) — retries must be reproducible
+  in tests and in resumed runs, so `random` is banned here) and a hard
+  wall-clock budget cap;
+- `retry_call`: bounded retry of a callable under a policy, with a
+  `retryable` predicate so non-transient errors surface immediately;
+- `poll_until`: fixed-interval polling against a grace deadline (the
+  transport backpressure shape: the resource drains on its own, backoff
+  would only add latency);
+- `Watchdog`: a one-shot timer with ATOMIC finish-vs-fire semantics
+  (the bench.py boundary race: a measurement finishing exactly at the
+  timeout must never let the timer claim the output line);
+- `subprocess_probe`: liveness probe in a throwaway subprocess with a
+  hard timeout (a wedged device tunnel hangs the *calling* process
+  inside `jax.devices()` uncancellably — probing must be sacrificial);
+- `ExecutionFailure`: the structured record drivers attach to results
+  JSON when a stage failed, retried, or degraded — evidence, not logs
+  (`benchmarks/check_results.py` validates the schema).
+
+Host-side only: nothing here is jit-reachable (jaxcheck JC004 bans
+`time` in compiled paths; this module IS the host boundary).
+"""
+from __future__ import annotations
+
+import dataclasses
+import subprocess
+import sys
+import threading
+import time
+import zlib
+from typing import Callable, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with deterministic jitter and budget caps.
+
+    Attempt k (0-based) sleeps ``min(base_s * factor**k, max_s)``
+    scaled by ``1 + jitter * u(seed, k)`` with ``u`` a pure hash in
+    [0, 1) — same policy + seed + attempt always yields the same delay
+    (reproducible sweeps; no thundering-herd alignment across trials
+    because each call site folds its own seed).
+    """
+
+    attempts: int = 4          # total tries (1 = no retry)
+    base_s: float = 0.05       # first backoff delay
+    factor: float = 2.0        # exponential growth per attempt
+    max_s: float = 2.0         # per-delay ceiling
+    budget_s: Optional[float] = None   # total wall-clock cap (None = off)
+    jitter: float = 0.25       # fractional deterministic jitter
+    seed: int = 0
+
+
+def _unit_hash(seed: int, attempt: int) -> float:
+    """Pure [0, 1) hash of (seed, attempt) — crc32, not `random`, so
+    delays are identical across processes and resumed runs."""
+    h = zlib.crc32(f"{seed}:{attempt}".encode())
+    return (h & 0xFFFFFF) / float(1 << 24)
+
+
+def delay_for(policy: RetryPolicy, attempt: int) -> float:
+    """Backoff delay before retry number ``attempt`` (0-based)."""
+    d = min(policy.base_s * (policy.factor ** attempt), policy.max_s)
+    return d * (1.0 + policy.jitter * _unit_hash(policy.seed, attempt))
+
+
+@dataclasses.dataclass
+class ExecutionFailure:
+    """One stage's failure record, committed into results JSON so a
+    degraded run is evidence instead of a dead artifact. ``fallback``
+    names what absorbed the failure ('cpu', 'requeued', ...) or None
+    when the stage ultimately failed."""
+
+    stage: str
+    error: str
+    attempts: int = 1
+    elapsed_s: float = 0.0
+    fallback: Optional[str] = None
+
+    def to_row(self) -> dict:
+        """The exact key set `benchmarks/check_results.py` validates —
+        add a field there before adding one here."""
+        return {"stage": self.stage, "error": self.error,
+                "attempts": self.attempts,
+                "elapsed_s": round(self.elapsed_s, 3),
+                "fallback": self.fallback}
+
+
+def retry_call(fn: Callable, *args,
+               policy: RetryPolicy = RetryPolicy(),
+               retryable: Callable[[BaseException], bool] = lambda e: True,
+               on_retry: Optional[Callable[[int, BaseException], None]]
+               = None,
+               sleep: Callable[[float], None] = time.sleep,
+               clock: Callable[[], float] = time.monotonic):
+    """Call ``fn(*args)``, retrying per ``policy`` while ``retryable(exc)``
+    holds and the budget allows. Non-retryable exceptions and the final
+    failure propagate unchanged (callers wrap them into
+    `ExecutionFailure` records with their own stage context)."""
+    t0 = clock()
+    for attempt in range(policy.attempts):
+        try:
+            return fn(*args)
+        except BaseException as e:            # noqa: BLE001 — re-raised
+            last_try = attempt >= policy.attempts - 1
+            if last_try or not retryable(e):
+                raise
+            d = delay_for(policy, attempt)
+            if policy.budget_s is not None \
+                    and clock() - t0 + d > policy.budget_s:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(d)
+    raise AssertionError("unreachable")       # pragma: no cover
+
+
+def poll_until(fn: Callable[[], bool], *, grace_s: float,
+               poll_s: float = 0.001,
+               sleep: Callable[[float], None] = time.sleep,
+               clock: Callable[[], float] = time.monotonic) -> bool:
+    """Fixed-interval poll of ``fn`` until it returns truthy or the grace
+    deadline passes. The backpressure shape (shm ring drain): the first
+    call is immediate, and the deadline bounds TOTAL wait — False means
+    the grace expired with ``fn`` still failing."""
+    deadline = clock() + grace_s
+    while not fn():
+        if clock() > deadline:
+            return False
+        sleep(poll_s)
+    return True
+
+
+class Watchdog:
+    """One-shot watchdog with atomic finish-vs-fire semantics.
+
+    The guarded code calls `finish()` when it completes; the timer calls
+    `fire()` at the deadline. Exactly one of them wins: a lock makes the
+    check-and-claim atomic, so a completion racing the timer boundary can
+    never let both the result and the diagnostic escape (the bench.py
+    one-JSON-line contract)."""
+
+    def __init__(self, on_fire: Callable[[], None]):
+        self.done = threading.Event()
+        self._lock = threading.Lock()
+        self._timer: Optional[threading.Timer] = None
+        self._on_fire = on_fire
+
+    def arm(self, timeout_s: float) -> None:
+        self._timer = threading.Timer(timeout_s, self.fire)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def fire(self) -> None:
+        """Timer callback: runs ``on_fire`` unless `finish` already won.
+        Firing CLAIMS completion (sets ``done`` inside the lock), so a
+        `finish` racing in right after returns False — exactly one side
+        ever wins, even when ``on_fire`` does not exit the process. The
+        callback itself runs outside the lock (an ``on_fire`` that calls
+        `finish` must not deadlock)."""
+        with self._lock:
+            if self.done.is_set():
+                return
+            self.done.set()
+        self._on_fire()
+
+    def finish(self) -> bool:
+        """Claim completion; True iff the watchdog had not fired (the
+        caller may emit its result). Cancels a pending timer."""
+        with self._lock:
+            won = not self.done.is_set()
+            self.done.set()
+        if self._timer is not None:
+            self._timer.cancel()
+        return won
+
+
+def subprocess_probe(code: str, timeout_s: float,
+                     marker: str = "ok", cwd: Optional[str] = None) -> bool:
+    """True iff ``python -c code`` exits 0 printing ``marker`` within the
+    budget. Sacrificial by design: a probe of a wedged resource must hang
+    a throwaway process, never the caller (bench.py device probe)."""
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s, cwd=cwd)
+        return r.returncode == 0 and marker in r.stdout
+    except (subprocess.TimeoutExpired, OSError):
+        return False

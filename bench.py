@@ -28,10 +28,10 @@ Methodology (pinned after round-1 variance, see VERDICT r1 weak #9):
 """
 import json
 import os
-import subprocess
 import sys
-import threading
 from pathlib import Path
+
+from aclswarm_tpu.utils.retry import Watchdog, subprocess_probe
 
 BASELINE_HZ = 100.0  # north-star target at n=1000 (BASELINE.md)
 N = 1000
@@ -50,16 +50,6 @@ PROBE_TIMEOUT_S = 120.0
 _PROBE_CODE = "import jax; jax.devices(); print('ok')"
 
 
-_done = threading.Event()   # set by main before printing: closes the
-#                             boundary race where cancel() cannot stop an
-#                             already-fired Timer callback
-_done_lock = threading.Lock()   # makes check-and-exit vs. set atomic: a
-#                                 timer firing at the measurement boundary
-#                                 either sees _done set (no-op) or wins
-#                                 the lock before main can set it — never
-#                                 a second line after a result line
-
-
 def _error_line(msg: str) -> None:
     print(json.dumps({
         "metric": f"sinkhorn_assign_n{N}_hz",
@@ -70,30 +60,31 @@ def _error_line(msg: str) -> None:
     }), flush=True)
 
 
-def _watchdog():
-    with _done_lock:
-        if _done.is_set():
-            return          # the measurement finished at the boundary
-        _error_line(f"bench did not complete within {WATCHDOG_S:.0f} s — "
-                    "device backend unreachable (tunnel wedge?); see "
-                    "benchmarks/results/scale_tpu.json for the committed "
-                    "measurement")
-        os._exit(2)
+def _on_watchdog_fire() -> None:
+    _error_line(f"bench did not complete within {WATCHDOG_S:.0f} s — "
+                "device backend unreachable (tunnel wedge?); see "
+                "benchmarks/results/scale_tpu.json for the committed "
+                "measurement")
+    os._exit(2)
+
+
+# the finish-vs-fire boundary race (a measurement completing exactly at
+# the timeout must never allow a second output line) lives in the
+# unified retry layer now: `utils.retry.Watchdog` makes the claim atomic
+_wd = Watchdog(on_fire=_on_watchdog_fire)
+_done = _wd.done          # tests poke these exact names
+_watchdog = _wd.fire
 
 
 def _probe_device(timeout_s: float | None = None) -> bool:
     """True iff a subprocess can enumerate jax devices within the budget.
     Run as a separate process because a wedged device tunnel hangs the
-    *calling* process inside jax.devices() uncancellably."""
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", _PROBE_CODE],
-            capture_output=True, text=True,
-            timeout=PROBE_TIMEOUT_S if timeout_s is None else timeout_s,
-            cwd=str(Path(__file__).resolve().parent))
-        return r.returncode == 0 and "ok" in r.stdout
-    except (subprocess.TimeoutExpired, OSError):
-        return False
+    *calling* process inside jax.devices() uncancellably
+    (`utils.retry.subprocess_probe` — the probe is sacrificial)."""
+    return subprocess_probe(
+        _PROBE_CODE,
+        PROBE_TIMEOUT_S if timeout_s is None else timeout_s,
+        cwd=str(Path(__file__).resolve().parent))
 
 
 def main():
@@ -105,17 +96,14 @@ def main():
                     "benchmarks/results/scale_tpu.json for the committed "
                     "measurement")
         return 2
-    timer = threading.Timer(WATCHDOG_S, _watchdog)
-    timer.daemon = True
-    timer.start()
+    _wd.arm(WATCHDOG_S)
     # single source of truth for the measurement lives in benchmarks/scale.py
     sys.path.insert(0, str(Path(__file__).resolve().parent / "benchmarks"))
     from scale import sinkhorn_throughput
 
     sk = sinkhorn_throughput(N, K, reps=5)
-    with _done_lock:        # measurement done: from here the watchdog
-        _done.set()         # can no longer claim the output line
-    timer.cancel()
+    _wd.finish()            # measurement done: from here the watchdog
+    #                         can no longer claim the output line
     print(json.dumps({
         "metric": f"sinkhorn_assign_n{N}_hz",
         "value": round(sk["hz"], 1),

@@ -36,7 +36,54 @@ RESULTS = Path(__file__).resolve().parent / "results"
 
 # artifacts held to the strict {name, n, value} row schema (new-style;
 # everything the faults subsystem and later suites commit goes here)
-STRICT_ROWS = ("fault_recovery.json",)
+STRICT_ROWS = ("fault_recovery.json", "resilience_overhead.json")
+
+# resilience metadata (docs/RESILIENCE.md): optional on any row, but
+# when present the values must be well-formed — a malformed degraded
+# marker is worse than none (it reads as "not degraded")
+_BOOL_FIELDS = ("resume", "degraded")
+# an execution-failure record's exact key set (utils.retry
+# .ExecutionFailure.to_row); unknown keys are rejected so silent schema
+# drift inside the records fails loudly like everywhere else
+_FAILURE_REQUIRED = {"stage", "error"}
+_FAILURE_ALLOWED = _FAILURE_REQUIRED | {"attempts", "elapsed_s",
+                                        "fallback"}
+
+
+def _check_resilience_fields(row: dict, where: str) -> list[str]:
+    probs = []
+    for key in _BOOL_FIELDS:
+        if key in row and not isinstance(row[key], bool):
+            probs.append(f"{where}: '{key}' must be a bool, got "
+                         f"{row[key]!r}")
+    if "retries" in row:
+        r = row["retries"]
+        if not isinstance(r, int) or isinstance(r, bool) or r < 0:
+            probs.append(f"{where}: 'retries' must be a non-negative "
+                         f"int, got {r!r}")
+    if "execution_failures" in row:
+        recs = row["execution_failures"]
+        if not isinstance(recs, list):
+            probs.append(f"{where}: 'execution_failures' must be a list")
+            return probs
+        for j, rec in enumerate(recs):
+            at = f"{where} failure[{j}]"
+            if not isinstance(rec, dict):
+                probs.append(f"{at}: not an object")
+                continue
+            missing = _FAILURE_REQUIRED - set(rec)
+            unknown = set(rec) - _FAILURE_ALLOWED
+            if missing:
+                probs.append(f"{at}: missing {sorted(missing)}")
+            if unknown:
+                probs.append(f"{at}: unknown keys {sorted(unknown)} "
+                             "(schema: stage, error, attempts, "
+                             "elapsed_s, fallback)")
+            if "stage" in rec and not isinstance(rec["stage"], str):
+                probs.append(f"{at}: 'stage' must be a string")
+            if "error" in rec and not isinstance(rec["error"], str):
+                probs.append(f"{at}: 'error' must be a string")
+    return probs
 
 
 def _check_row(row: dict, path: Path, lineno: int, strict: bool
@@ -61,10 +108,15 @@ def _check_row(row: dict, path: Path, lineno: int, strict: bool
         # touches it (NaN compares false against everything) — reject
         probs.append(f"{where}: non-finite 'value' ({row['value']!r}) — "
                      "record an 'error' string instead")
-    elif strict and not has_value:
-        probs.append(f"{where}: strict artifact row lacks numeric 'value'")
+    elif strict and not (has_value or has_error):
+        # strict rows normally carry a numeric value; a per-cell
+        # ExecutionFailure (docs/RESILIENCE.md — the suite continued
+        # past a failing cell) is the one legal substitute
+        probs.append(f"{where}: strict artifact row lacks numeric "
+                     "'value' (or a recorded 'error')")
     elif not (has_value or has_error):
         probs.append(f"{where}: neither numeric 'value' nor 'error' string")
+    probs.extend(_check_resilience_fields(row, where))
     if "n" in row:
         if not isinstance(row["n"], int) or isinstance(row["n"], bool) \
                 or row["n"] <= 0:

@@ -89,13 +89,18 @@ def _problem(n: int, seed: int):
 def run_scale(n: int, *, seed: int = 1, drop_tick: int | None = None,
               rejoin_tick: int | None = None, n_ticks: int | None = None,
               chunk: int = 120, assign_every: int = 60,
-              check_mode: str = "off") -> list[dict]:
+              check_mode: str = "off",
+              checkpoint_dir: str | None = None,
+              resume: bool = True) -> list[dict]:
     import jax
     import jax.numpy as jnp
 
     from aclswarm_tpu import faults, sim
     from aclswarm_tpu.core.types import ControlGains, SafetyParams
+    from aclswarm_tpu.resilience import (ChunkExecutor, checkpoint as
+                                         ckptlib, maybe_crash)
     from aclswarm_tpu.sim import summary as sumlib
+    from aclswarm_tpu.utils import get_logger
 
     assert chunk % assign_every == 0, "shared auction phase"
     drop_tick = SCALES[n]["drop_tick"] if drop_tick is None else drop_tick
@@ -130,10 +135,41 @@ def run_scale(n: int, *, seed: int = 1, drop_tick: int | None = None,
     rec = np.zeros((B, 0), np.int32)
     chn = np.zeros((B, 0), np.int32)
     nal = np.zeros((B, 0), np.int32)
-    for c0 in range(0, n_ticks, chunk):
-        bstate, carry, summ = sumlib.batched_rollout_summary(
-            bstate, carry, bform, ControlGains(), sparams, cfg, chunk,
-            None, 0, window=window, takeoff_alt=2.0)
+
+    # --- resilience (docs/RESILIENCE.md): mid-rollout checkpoint/resume
+    # + retried/degraded chunk launches. The sweep carry is (bstate,
+    # carry) plus the accumulated observable arrays.
+    execu = ChunkExecutor(log=get_logger("faults_suite"))
+    stem = f"faults_n{n}_seed{seed}"
+    cfg_hash = ckptlib.config_hash(dict(
+        n=n, seed=seed, drop_tick=drop_tick, rejoin_tick=rejoin_tick,
+        n_ticks=n_ticks, chunk=chunk, assign_every=assign_every,
+        check_mode=check_mode, grid=GRID))
+    c0_start = 0
+    resumed = False
+    if checkpoint_dir is not None and resume:
+        path = ckptlib.latest_checkpoint(checkpoint_dir, stem)
+        if path is not None:
+            payload, man = ckptlib.load_checkpoint(
+                path, expected=ckptlib.expected_manifest(
+                    "faults_suite", cfg_hash))
+            bstate = ckptlib.restore_tree(bstate, payload["state"],
+                                          path=path, what="SimState")
+            carry = ckptlib.restore_tree(carry, payload["carry"],
+                                         path=path, what="SummaryCarry")
+            conv = np.asarray(payload["conv"], bool)
+            rec = np.asarray(payload["rec"], np.int32)
+            chn = np.asarray(payload["chn"], np.int32)
+            nal = np.asarray(payload["nal"], np.int32)
+            c0_start = int(man["c0_next"])
+            resumed = True
+
+    for c0 in range(c0_start, n_ticks, chunk):
+        bstate, carry, summ = execu.run(
+            lambda: sumlib.batched_rollout_summary(
+                bstate, carry, bform, ControlGains(), sparams, cfg,
+                chunk, None, 0, window=window, takeoff_alt=2.0),
+            stage=f"faults_n{n}:c{c0}")
         if check_mode == "on":
             # sanitized run: the swarmcheck codes ride the arrays this
             # loop already syncs; a violation aborts the sweep with
@@ -146,6 +182,18 @@ def run_scale(n: int, *, seed: int = 1, drop_tick: int | None = None,
         rec = np.concatenate([rec, np.asarray(summ.recovery_ticks)], axis=1)
         chn = np.concatenate([chn, np.asarray(summ.fault_churn)], axis=1)
         nal = np.concatenate([nal, np.asarray(summ.n_alive)], axis=1)
+        if checkpoint_dir is not None and c0 + chunk < n_ticks:
+            ckptlib.write_checkpoint(
+                checkpoint_dir, stem,
+                {"state": ckptlib.tree_arrays(bstate),
+                 "carry": ckptlib.tree_arrays(carry),
+                 "conv": conv, "rec": rec, "chn": chn, "nal": nal},
+                ckptlib.make_manifest("faults_suite", cfg_hash,
+                                      chunk=(c0 + chunk) // chunk,
+                                      c0_next=c0 + chunk))
+        maybe_crash("suite", (c0 + chunk) // chunk)
+    if checkpoint_dir is not None:
+        ckptlib.clear_checkpoints(checkpoint_dir, stem)
     wall = time.time() - t0
 
     def first_recovery(b, after, before):
@@ -160,6 +208,10 @@ def run_scale(n: int, *, seed: int = 1, drop_tick: int | None = None,
                 drop_tick=drop_tick, rejoin_tick=rejoin_tick,
                 assignment="cbaa", localization="flooded",
                 wall_s=round(wall, 1))
+    if resumed:
+        # wall_s covers only the post-resume tail then — mark it
+        base["resume"] = True
+    base.update(execu.row_fields())
     for b, (df, pl) in enumerate(GRID):
         tag = f"n{n}_drop{int(df * 100):02d}_loss{int(pl * 100):02d}"
         if df == 0.0 and pl == 0.0:
@@ -197,6 +249,12 @@ def main(argv=None):
                     "compiled in (aclswarm_tpu.analysis.invariants): a "
                     "contract violation aborts with trial/tick/contract "
                     "attribution instead of poisoning the artifact")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="chunk-boundary checkpoints: a killed sweep "
+                    "resumes mid-rollout AND mid-grid from here "
+                    "(docs/RESILIENCE.md)")
+    ap.add_argument("--no-resume", action="store_true",
+                    help="ignore existing checkpoints (fresh run)")
     args = ap.parse_args(argv)
 
     import jax
@@ -204,10 +262,31 @@ def main(argv=None):
     kw = dict(drop_tick=300, rejoin_tick=720, n_ticks=1200) if args.quick \
         else {}
     all_rows = []
+    failed_cells = []
+    from aclswarm_tpu.resilience import InjectedCrash
+    from aclswarm_tpu.utils.retry import ExecutionFailure
     for n in ns:
         print(f"=== fault sweep n={n} (B={len(GRID)}) ===", flush=True)
-        rows = run_scale(n, seed=args.seed, check_mode=args.check_mode,
-                         **kw)
+        t0 = time.time()
+        try:
+            rows = run_scale(n, seed=args.seed, check_mode=args.check_mode,
+                             checkpoint_dir=args.checkpoint_dir,
+                             resume=not args.no_resume, **kw)
+        except InjectedCrash:
+            raise          # scripted preemption: die as scripted
+        except Exception as e:      # noqa: BLE001 — recorded, not hidden
+            # one failing scale must not lose the rest of the grid: the
+            # cell's failure becomes a structured artifact row and the
+            # sweep continues (the exit code still fails at the end)
+            failed_cells.append(f"n={n}: {e}")
+            fail = ExecutionFailure(stage=f"fault_sweep_n{n}",
+                                    error=f"{type(e).__name__}: {e}",
+                                    elapsed_s=time.time() - t0)
+            all_rows.append(dict(name=f"fault_sweep_n{n}", n=n,
+                                 error=fail.error, seed=args.seed,
+                                 execution_failures=[fail.to_row()]))
+            print(f"FAILED n={n}: {e} — continuing the sweep", flush=True)
+            continue
         for r in rows:
             r["device"] = jax.default_backend()
             print(json.dumps(r), flush=True)
@@ -227,6 +306,12 @@ def main(argv=None):
         print("SCHEMA DRIFT in freshly written artifact:")
         for p in probs:
             print(f"  {p}")
+        return 1
+    if failed_cells:
+        print(f"{len(failed_cells)} grid cell(s) FAILED "
+              "(recorded as error rows):")
+        for c in failed_cells:
+            print(f"  {c}")
         return 1
     return 0
 

@@ -311,8 +311,25 @@ def trials_throughput(n: int = 100, B: int = 16, m_serial: int | None = None,
     return rows
 
 
+def _committed_metrics(out: str | None) -> set:
+    """Metric names already appended to ``out`` — the mid-grid resume
+    set (docs/RESILIENCE.md): rows append incrementally, so a killed
+    suite resumed with --resume re-measures only the missing rows."""
+    done = set()
+    if out and Path(out).exists():
+        for line in Path(out).read_text().splitlines():
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(row, dict) and "metric" in row:
+                done.add(row["metric"])
+    return done
+
+
 def bench_all(n: int, quick: bool = False, sharded: bool = False,
-              out: str | None = None, gains1000: bool = False):
+              out: str | None = None, gains1000: bool = False,
+              resume: bool = False):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -326,8 +343,22 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
     rng = np.random.default_rng(0)
     results = []
     reps = 2 if quick else 5
+    done_metrics = _committed_metrics(out) if resume else set()
+    if done_metrics:
+        print(f"# --resume: {len(done_metrics)} metrics already in {out}; "
+              "skipping those measurements", flush=True)
+
+    def todo(*metrics) -> bool:
+        """False when every named metric is already committed (resume)."""
+        missing = [m for m in metrics if m not in done_metrics]
+        if not missing:
+            print(f"# skip (resumed): {', '.join(metrics)}", flush=True)
+        return bool(missing)
 
     def emit(metric, value, unit, baseline=None, **extra):
+        if metric in done_metrics:
+            _LAST_SPREAD.clear()
+            return                 # resumed: row already committed
         row = {"metric": metric, "value": round(float(value), 3),
                "unit": unit,
                "device": jax.devices()[0].platform,
@@ -353,31 +384,37 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
                 fh.write(json.dumps(row) + "\n")
 
     # --- full 100 Hz control tick at scale (chained rollout) ---
+    # NOTE for --resume: every rng draw below stays UNCONDITIONAL (array
+    # builds are cheap); only jit + timing are skipped — so a resumed
+    # run measures exactly the instances a fresh run would have
     f, sp, st, k_ca, B = build_bench_problem(n, rng)
-    cfg = sim.SimConfig(assignment="none", colavoid_neighbors=k_ca)
-    ticks = 50 if quick else 200
-    roll = jax.jit(lambda s: sim.rollout(s, f, ControlGains(), sp, cfg,
-                                         ticks)[0])
-    dt = _median_time(roll, st, ticks, reps)
     # the pruning parameter is part of the metric name: with k-neighbor
     # pruning the avoidance kernel is approximate when > k vehicles are
     # inside d_avoid_thresh (see control.collision_avoidance)
     ca_tag = f"_k{k_ca}" if k_ca is not None else ""
-    emit(f"control_tick_n{n}{ca_tag}_hz", 1.0 / dt, "Hz", baseline=100.0,
-         **_roofline(roll, st, dt, ticks))
+    btag = f"_b{B}" if B else ""
+    if todo(f"control_tick_n{n}{ca_tag}_hz"):
+        cfg = sim.SimConfig(assignment="none", colavoid_neighbors=k_ca)
+        ticks = 50 if quick else 200
+        roll = jax.jit(lambda s: sim.rollout(s, f, ControlGains(), sp,
+                                             cfg, ticks)[0])
+        dt = _median_time(roll, st, ticks, reps)
+        emit(f"control_tick_n{n}{ca_tag}_hz", 1.0 / dt, "Hz",
+             baseline=100.0, **_roofline(roll, st, dt, ticks))
 
     # --- streaming re-assignment (north star config 5): the full engine
     # tick with a fresh Sinkhorn assignment EVERY tick — the gridlock-
     # recovery mode where the swarm continuously re-auctions ---
-    stream_cfg = sim.SimConfig(assignment="sinkhorn", assign_every=1,
-                               dynamics="firstorder",
-                               colavoid_neighbors=k_ca)
-    ticks_s = 20 if quick else 100
-    stream = jax.jit(lambda s: sim.rollout(
-        s, f, ControlGains(), sp, stream_cfg, ticks_s)[0])
-    dt = _median_time(stream, st, ticks_s, reps)
-    emit(f"streaming_reassign_n{n}{ca_tag}_hz", 1.0 / dt, "Hz",
-         baseline=100.0)
+    if todo(f"streaming_reassign_n{n}{ca_tag}_hz"):
+        stream_cfg = sim.SimConfig(assignment="sinkhorn", assign_every=1,
+                                   dynamics="firstorder",
+                                   colavoid_neighbors=k_ca)
+        ticks_s = 20 if quick else 100
+        stream = jax.jit(lambda s: sim.rollout(
+            s, f, ControlGains(), sp, stream_cfg, ticks_s)[0])
+        dt = _median_time(stream, st, ticks_s, reps)
+        emit(f"streaming_reassign_n{n}{ca_tag}_hz", 1.0 / dt, "Hz",
+             baseline=100.0)
 
     # --- faithful modes at scale (round-2 weak #4): the real information
     # model (flooded localization, blocked merge) and the decentralized
@@ -385,7 +422,6 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
     # Block sizes keep peak memory O(n^2 B) — the dense (n, n, n) forms
     # need 4 GB at n=1000 and cannot run on one chip. B comes from
     # build_bench_problem (shared with flood_sweep's re-measurements). ---
-    btag = f"_b{B}" if B else ""
     flood_cfg = sim.SimConfig(assignment="none", localization="flooded",
                               flood_block=B, colavoid_neighbors=k_ca)
     st_loc = sim.init_state(
@@ -406,40 +442,44 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
     def _merge_flops(w=None):
         return float(fpal.analytic_flops(n, w))
 
-    froll = jax.jit(lambda s: sim.rollout(s, f, ControlGains(), sp,
-                                          flood_cfg, ticks_f)[0])
-    dt = _median_time(froll, st_loc, ticks_f, reps)
-    emit(f"flooded_tick_n{n}{ca_tag}{btag}_hz", 1.0 / dt, "Hz",
-         baseline=100.0,
-         **_roofline(froll, st_loc, dt, ticks_f,
-                     pallas_flops=_merge_flops() / 2))
+    if todo(f"flooded_tick_n{n}{ca_tag}{btag}_hz"):
+        froll = jax.jit(lambda s: sim.rollout(s, f, ControlGains(), sp,
+                                              flood_cfg, ticks_f)[0])
+        dt = _median_time(froll, st_loc, ticks_f, reps)
+        emit(f"flooded_tick_n{n}{ca_tag}{btag}_hz", 1.0 / dt, "Hz",
+             baseline=100.0,
+             **_roofline(froll, st_loc, dt, ticks_f,
+                         pallas_flops=_merge_flops() / 2))
 
     # the WORST tick of the bulk flood (every 2nd tick does the whole
     # O(n^3) merge; the average above hides the spike): flood_every=1
     # makes every tick a flood-round tick, so the mean IS the spike
-    spike_cfg = sim.SimConfig(assignment="none", localization="flooded",
-                              flood_block=B, colavoid_neighbors=k_ca,
-                              flood_every=1)
-    sroll = jax.jit(lambda s: sim.rollout(s, f, ControlGains(), sp,
-                                          spike_cfg, ticks_f)[0])
-    dt = _median_time(sroll, st_loc, ticks_f, reps)
-    emit(f"flooded_roundtick_n{n}{ca_tag}{btag}_hz", 1.0 / dt, "Hz",
-         baseline=100.0, **_roofline(sroll, st_loc, dt, ticks_f,
-                                     pallas_flops=_merge_flops()))
+    if todo(f"flooded_roundtick_n{n}{ca_tag}{btag}_hz"):
+        spike_cfg = sim.SimConfig(assignment="none",
+                                  localization="flooded",
+                                  flood_block=B, colavoid_neighbors=k_ca,
+                                  flood_every=1)
+        sroll = jax.jit(lambda s: sim.rollout(s, f, ControlGains(), sp,
+                                              spike_cfg, ticks_f)[0])
+        dt = _median_time(sroll, st_loc, ticks_f, reps)
+        emit(f"flooded_roundtick_n{n}{ca_tag}{btag}_hz", 1.0 / dt, "Hz",
+             baseline=100.0, **_roofline(sroll, st_loc, dt, ticks_f,
+                                         pallas_flops=_merge_flops()))
 
     # phased flood (flood_phases=2): the merge's target axis spreads over
     # the 50 Hz window, so EVERY tick carries half a merge and none
     # spikes — per-target cadence unchanged (`localization.tick_phased`)
-    ph_cfg = sim.SimConfig(assignment="none", localization="flooded",
-                           flood_block=B, colavoid_neighbors=k_ca,
-                           flood_phases=2)
-    proll = jax.jit(lambda s: sim.rollout(s, f, ControlGains(), sp,
-                                          ph_cfg, ticks_f)[0])
-    dt = _median_time(proll, st_loc, ticks_f, reps)
-    emit(f"flooded_tick_n{n}{ca_tag}{btag}_phased2_hz", 1.0 / dt, "Hz",
-         baseline=100.0,
-         **_roofline(proll, st_loc, dt, ticks_f,
-                     pallas_flops=_merge_flops(w=(n + 1) // 2)))
+    if todo(f"flooded_tick_n{n}{ca_tag}{btag}_phased2_hz"):
+        ph_cfg = sim.SimConfig(assignment="none", localization="flooded",
+                               flood_block=B, colavoid_neighbors=k_ca,
+                               flood_phases=2)
+        proll = jax.jit(lambda s: sim.rollout(s, f, ControlGains(), sp,
+                                              ph_cfg, ticks_f)[0])
+        dt = _median_time(proll, st_loc, ticks_f, reps)
+        emit(f"flooded_tick_n{n}{ca_tag}{btag}_phased2_hz", 1.0 / dt,
+             "Hz", baseline=100.0,
+             **_roofline(proll, st_loc, dt, ticks_f,
+                         pallas_flops=_merge_flops(w=(n + 1) // 2)))
 
     from aclswarm_tpu.assignment import cbaa as cbaalib
     from aclswarm_tpu.core import perm as permutil
@@ -462,24 +502,27 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
             return c + r.v2f.sum() + r.rounds, None
         return lax.scan(body, jnp.int32(0), qs_c)[0]
 
-    rr = jax.jit(lambda q: cbaalib.cbaa_from_state(
-        q, f.points, f.adjmat, v2f0, task_block=B))(qs_c[0])
-    jc = jax.jit(cchain)
-    dt = _median_time(jc, qs_c, Kc, max(2, reps - 3))
-    # keyed `_earlyexit` since round 4: the pre-round-3 `cbaa_faithful_n*`
-    # key measured the fixed 2n-round budget (now `cbaa_fullbudget_n*`);
-    # distinct keys keep cross-commit artifact comparisons like-for-like
-    emit(f"cbaa_faithful_earlyexit_n{n}{btag}_hz", 1.0 / dt, "Hz", chain_k=Kc,
-         s_per_auction=round(dt, 4), rounds=int(rr.rounds),
-         budget=2 * n, valid=bool(rr.valid),
-         **_roofline(jc, qs_c, dt, Kc))
+    if todo(f"cbaa_faithful_earlyexit_n{n}{btag}_hz"):
+        rr = jax.jit(lambda q: cbaalib.cbaa_from_state(
+            q, f.points, f.adjmat, v2f0, task_block=B))(qs_c[0])
+        jc = jax.jit(cchain)
+        dt = _median_time(jc, qs_c, Kc, max(2, reps - 3))
+        # keyed `_earlyexit` since round 4: the pre-round-3
+        # `cbaa_faithful_n*` key measured the fixed 2n-round budget (now
+        # `cbaa_fullbudget_n*`); distinct keys keep cross-commit
+        # artifact comparisons like-for-like
+        emit(f"cbaa_faithful_earlyexit_n{n}{btag}_hz", 1.0 / dt, "Hz",
+             chain_k=Kc, s_per_auction=round(dt, 4),
+             rounds=int(rr.rounds), budget=2 * n, valid=bool(rr.valid),
+             **_roofline(jc, qs_c, dt, Kc))
 
     # the fixed 2n-round budget is a single ~n^2-round dispatch: beyond
     # n~1000 (9.5 s) it exceeds this environment's device watchdog — a
     # 2x2000-round dispatch (~40 s) CRASHED the TPU worker through the
     # tunnel (measured, round 4). Latency parity is pinned at n<=1000;
     # the early-exit row above is the deployment number at every n.
-    if n <= 1024 and not (quick and n > 512):
+    if n <= 1024 and not (quick and n > 512) \
+            and todo(f"cbaa_fullbudget_n{n}{btag}_hz"):
         Kb = 1 if n > 512 else Kc
 
         def cchain_budget(qs_c):
@@ -497,20 +540,23 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
     # K = 400 bounds the ~108 ms fixed launch floor to ~0.27 ms/instance) ---
     K = 10 if quick else 400
     n_iters = 50
-    sk = sinkhorn_throughput(n, K, reps, n_iters=n_iters)
-    # spreads attached explicitly: sinkhorn_throughput runs TWO timings
-    # (chained + single-shot), so the implicit last-spread would tag the
-    # throughput row with the latency run's jitter
-    emit(f"sinkhorn_assign_n{n}_hz", sk["hz"], "Hz", baseline=100.0,
-         chain_k=K, spread_s=sk["chain_spread_s"],
-         **(sk["roofline"] or {}))
-    # single-shot latency (includes this environment's fixed per-launch
-    # tunnel overhead — see module docstring; honest but pessimistic),
-    # with the floor/on-device decomposition attached
-    emit(f"sinkhorn_assign_n{n}_latency_ms", sk["latency_ms"], "ms",
-         spread_s=sk["latency_spread_s"],
-         decomposition=sk["latency_decomposition"])
-    emit(f"sinkhorn_assign_n{n}_subopt", sk["subopt"], "ratio")
+    if todo(f"sinkhorn_assign_n{n}_hz",
+            f"sinkhorn_assign_n{n}_latency_ms",
+            f"sinkhorn_assign_n{n}_subopt"):
+        sk = sinkhorn_throughput(n, K, reps, n_iters=n_iters)
+        # spreads attached explicitly: sinkhorn_throughput runs TWO
+        # timings (chained + single-shot), so the implicit last-spread
+        # would tag the throughput row with the latency run's jitter
+        emit(f"sinkhorn_assign_n{n}_hz", sk["hz"], "Hz", baseline=100.0,
+             chain_k=K, spread_s=sk["chain_spread_s"],
+             **(sk["roofline"] or {}))
+        # single-shot latency (includes this environment's fixed
+        # per-launch tunnel overhead — see module docstring; honest but
+        # pessimistic), with the floor/on-device decomposition attached
+        emit(f"sinkhorn_assign_n{n}_latency_ms", sk["latency_ms"], "ms",
+             spread_s=sk["latency_spread_s"],
+             decomposition=sk["latency_decomposition"])
+        emit(f"sinkhorn_assign_n{n}_subopt", sk["subopt"], "ratio")
 
     # --- sharded assignment over the device mesh (agent-axis GSPMD) ---
     if sharded and len(jax.devices()) > 1:
@@ -531,10 +577,12 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
                 return c + r.row_to_col.sum(), None
             return lax.scan(body, jnp.int32(0), qs)[0]
 
-        fsh = jax.jit(chain, in_shardings=(row_t,), out_shardings=rep)
-        dt = _median_time(fsh, jax.device_put(qs, row_t), K, reps)
-        emit(f"sinkhorn_assign_n{n}_sharded{ndev}_hz", 1.0 / dt, "Hz",
-             baseline=100.0, chain_k=K)
+        if todo(f"sinkhorn_assign_n{n}_sharded{ndev}_hz"):
+            fsh = jax.jit(chain, in_shardings=(row_t,),
+                          out_shardings=rep)
+            dt = _median_time(fsh, jax.device_put(qs, row_t), K, reps)
+            emit(f"sinkhorn_assign_n{n}_sharded{ndev}_hz", 1.0 / dt,
+                 "Hz", baseline=100.0, chain_k=K)
 
         # staged shardings (docs/SCALING.md): iterations sharded, the
         # sequential rounding/repair loops replicated — one gather instead
@@ -548,22 +596,24 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
                 return c + r.row_to_col.sum(), None
             return lax.scan(body, jnp.int32(0), qs)[0]
 
-        fst = jax.jit(chain_staged, in_shardings=(row_t,),
-                      out_shardings=rep)
-        dt = _median_time(fst, jax.device_put(qs, row_t), K, reps)
-        emit(f"sinkhorn_assign_n{n}_sharded{ndev}_staged_hz", 1.0 / dt,
-             "Hz", baseline=100.0, chain_k=K)
-        # correctness: sharded == single-device rounding decisions
-        v_ref = np.asarray(jax.jit(
-            lambda q: sinkhorn.sinkhorn_assign(
-                q, p, n_iters=n_iters).row_to_col)(qs[0]))
-        v_sh = np.asarray(jax.jit(
-            lambda q: sinkhorn.sinkhorn_assign(
-                q, p, n_iters=n_iters).row_to_col,
-            in_shardings=(meshlib.row_sharding(mesh),))(
-                jax.device_put(qs[0], meshlib.row_sharding(mesh))))
-        emit(f"sinkhorn_assign_n{n}_sharded{ndev}_match", float(
-            np.mean(v_sh == v_ref)), "ratio")
+        if todo(f"sinkhorn_assign_n{n}_sharded{ndev}_staged_hz"):
+            fst = jax.jit(chain_staged, in_shardings=(row_t,),
+                          out_shardings=rep)
+            dt = _median_time(fst, jax.device_put(qs, row_t), K, reps)
+            emit(f"sinkhorn_assign_n{n}_sharded{ndev}_staged_hz",
+                 1.0 / dt, "Hz", baseline=100.0, chain_k=K)
+        if todo(f"sinkhorn_assign_n{n}_sharded{ndev}_match"):
+            # correctness: sharded == single-device rounding decisions
+            v_ref = np.asarray(jax.jit(
+                lambda q: sinkhorn.sinkhorn_assign(
+                    q, p, n_iters=n_iters).row_to_col)(qs[0]))
+            v_sh = np.asarray(jax.jit(
+                lambda q: sinkhorn.sinkhorn_assign(
+                    q, p, n_iters=n_iters).row_to_col,
+                in_shardings=(meshlib.row_sharding(mesh),))(
+                    jax.device_put(qs[0], meshlib.row_sharding(mesh))))
+            emit(f"sinkhorn_assign_n{n}_sharded{ndev}_match", float(
+                np.mean(v_sh == v_ref)), "ratio")
 
     # --- gain design (ADMM), simform100-shape sparse graph ---
     n_g = min(n, 100)
@@ -577,6 +627,8 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
             ("", np.ones((n_g, n_g)) - np.eye(n_g)),
             ("_sparse", formgen.random_adjmat(
                 np.random.default_rng(7), n_g, fc=False))):
+        if not todo(f"admm_gain_design_n{n_g}{tag}_ms"):
+            continue
 
         def gchain(ptss, adj_g=adj_g):
             def body(c, pp):
@@ -594,7 +646,7 @@ def bench_all(n: int, quick: bool = False, sharded: bool = False,
     # (1.2 s auto-auction cadence), not per control tick, so seconds-scale
     # is usable — but nowhere near 100 Hz, reported as-is. Off by default
     # (~2 min compile + ~4 s/solve); enable with --gains1000. ---
-    if gains1000:
+    if gains1000 and todo(f"admm_gain_design_n{n}_s"):
         pts1k = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32) * 30)
         adj1k = np.ones((n, n)) - np.eye(n)
         g1k = jax.jit(lambda p: gl.solve_gains(
@@ -624,6 +676,11 @@ def main():
                     help="(with --trials-batch) trials per launch")
     ap.add_argument("--trials-n", type=int, default=100,
                     help="(with --trials-batch) agents per trial")
+    ap.add_argument("--resume", action="store_true",
+                    help="(with --out) skip metrics the results file "
+                    "already carries — mid-grid resume of a killed "
+                    "suite (docs/RESILIENCE.md); rng draws still run "
+                    "so the remaining instances match a fresh run")
     args = ap.parse_args()
     # the axon TPU plugin ignores JAX_PLATFORMS=cpu; apply it through
     # jax.config so virtual-mesh runs actually land on CPU
@@ -635,7 +692,7 @@ def main():
         trials_throughput(args.trials_n, B=args.batch, out=args.out)
         return
     bench_all(args.n, args.quick, args.sharded, args.out,
-              gains1000=args.gains1000)
+              gains1000=args.gains1000, resume=args.resume)
 
 
 if __name__ == "__main__":

@@ -215,15 +215,23 @@ EXPECTED_PCT = {
 
 
 def run_config(name: str, overrides: dict, m: int, seed: int = 1,
-               batch: int = 1) -> dict:
+               batch: int = 1, checkpoint_dir: str | None = None,
+               resume: bool = False) -> dict:
     # trials append to a TEMP file which atomically replaces the
     # committed CSV only after the config finishes — a crashed or wedged
     # run (observed: the device tunnel can hang before trial 0 ends)
     # must never destroy committed evidence
     out = RESULTS / f"trials_{name}.csv"
     tmp = RESULTS / f".trials_{name}.csv.tmp"
-    tmp.unlink(missing_ok=True)
+    if not (checkpoint_dir and resume):
+        # resuming keeps the crashed run's partial tmp: its rows are the
+        # finished trials the done-markers will replay (idempotent
+        # appends dedupe by trial id — harness.trials.run_trials)
+        tmp.unlink(missing_ok=True)
     overrides = dict(overrides)
+    if checkpoint_dir:
+        overrides["checkpoint_dir"] = str(Path(checkpoint_dir) / name)
+        overrides["resume"] = resume
     if batch > 1:
         # the batched rollout shares the auction phase across trials, so
         # the FSM action latency (chunk) must align to the auction period
@@ -268,39 +276,101 @@ def main(argv=None):
                     help="trials per device launch (> 1 uses the vmapped "
                          "batched rollout; chunk_ticks auto-aligns to "
                          "assign_every)")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="per-config chunk-boundary checkpoints + "
+                    "done-markers (docs/RESILIENCE.md): a killed suite "
+                    "resumes mid-grid AND mid-rollout")
+    ap.add_argument("--resume", action="store_true",
+                    help="skip configs already recorded in "
+                    "trials_summary.json and resume the interrupted one "
+                    "from its checkpoints (needs --checkpoint-dir for "
+                    "mid-rollout resume)")
     args = ap.parse_args(argv)
 
     import jax
+    from aclswarm_tpu.resilience import InjectedCrash
+    from aclswarm_tpu.utils.retry import ExecutionFailure
     RESULTS.mkdir(exist_ok=True)
     summary = {
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),
         "configs": {},
     }
+    path = RESULTS / "trials_summary.json"
+    prior = json.loads(path.read_text()).get("configs", {}) \
+        if path.exists() else {}
+
+    def _flush_summary():
+        # incremental + idempotent: a mid-grid crash keeps every
+        # completed cell's stats (merged over the committed file)
+        merged = dict(prior)
+        merged.update(summary["configs"])
+        path.write_text(json.dumps(dict(summary, configs=merged),
+                                   indent=1))
+
+    def _cell_marker(name):
+        return (Path(args.checkpoint_dir) / f"{name}.cell.done"
+                if args.checkpoint_dir else None)
+
+    failed = []
     for name, overrides, m, mq in CONFIGS:
         if args.only and name != args.only:
             continue
         n_trials = mq if args.quick else m
+        marker = _cell_marker(name)
+        if args.resume and marker is not None and marker.exists():
+            # mid-grid resume: THIS sweep already finished the cell (the
+            # marker lives in the sweep's checkpoint dir — the committed
+            # summary alone is not progress evidence, it carries prior
+            # runs); its stats are in trials_summary.json already
+            print(f"=== {name}: cell marker present, skipping "
+                  "(--resume) ===", flush=True)
+            continue
         print(f"=== {name} (m={n_trials}) ===", flush=True)
-        stats = run_config(name, overrides, n_trials, args.seed,
-                           batch=args.batch)
+        t0 = time.time()
+        try:
+            stats = run_config(name, overrides, n_trials, args.seed,
+                               batch=args.batch,
+                               checkpoint_dir=args.checkpoint_dir,
+                               resume=args.resume)
+        except InjectedCrash:
+            raise          # scripted preemption: die as scripted
+        except Exception as e:      # noqa: BLE001 — recorded, not hidden
+            # one failing cell must not lose the rest of the grid: the
+            # failure is recorded as evidence and the sweep continues,
+            # failing at the end with the summary
+            failed.append(f"{name}: {e}")
+            fail = ExecutionFailure(stage=name,
+                                    error=f"{type(e).__name__}: {e}",
+                                    elapsed_s=time.time() - t0)
+            summary["configs"][name] = {
+                "error": fail.error, "wall_s": round(fail.elapsed_s, 1),
+                "execution_failures": [fail.to_row()]}
+            _flush_summary()
+            print(f"FAILED {name}: {e} — continuing the grid", flush=True)
+            continue
         summary["configs"][name] = stats
+        _flush_summary()
+        if marker is not None:
+            marker.parent.mkdir(parents=True, exist_ok=True)
+            marker.touch()
         print(json.dumps({k: v for k, v in stats.items()
                           if k != "config"}), flush=True)
 
-    path = RESULTS / "trials_summary.json"
-    existing = {}
-    if path.exists():
-        existing = json.loads(path.read_text())
-        existing.get("configs", {}).update(summary["configs"])
-        summary["configs"] = existing.get("configs", summary["configs"])
+    summary["configs"] = {**prior, **summary["configs"]}
     path.write_text(json.dumps(summary, indent=1))
     print(f"wrote {path}")
     bad = [k for k, v in summary["configs"].items()
-           if v["completion_pct"] < EXPECTED_PCT.get(k, 100.0)]
+           if "error" not in v
+           and v["completion_pct"] < EXPECTED_PCT.get(k, 100.0)]
     if bad:
         print(f"below expected completion: {bad}")
-    return 1 if bad else 0
+    if failed:
+        print(f"{len(failed)} grid cell(s) FAILED (recorded in "
+              "trials_summary.json):")
+        for c in failed:
+            print(f"  {c}")
+    return 1 if (bad or failed) else 0
 
 
 if __name__ == "__main__":

@@ -12,8 +12,11 @@
 #   JAX_PLATFORMS=cpu python -m aclswarm_tpu.analysis.trace_audit
 #                                         layer 2 audit + zero-cost-off
 #   python benchmarks/check_results.py            committed artifacts
+#   JAX_PLATFORMS=cpu python -m aclswarm_tpu.resilience.smoke
+#                                         crash-resume smoke (SIGKILL)
 #   pytest tests/test_analysis.py tests/test_invariants.py \
-#          tests/test_results_schema.py             guard self-tests
+#          tests/test_results_schema.py tests/test_resilience.py
+#                                                   guard self-tests
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -26,8 +29,41 @@ JAX_PLATFORMS=cpu python -m aclswarm_tpu.analysis.trace_audit
 echo "== committed benchmark artifact schema =="
 python benchmarks/check_results.py
 
-echo "== guard self-tests (lint fixtures, audit grid, invariant contracts) =="
+echo "== crash-resume smoke: SIGKILL at chunk 1 of an n=5 rollout, =="
+echo "== resume from checkpoint, assert bit-parity (docs/RESILIENCE.md) =="
+JAX_PLATFORMS=cpu python -m aclswarm_tpu.resilience.smoke
+
+# tier-1 duration guard: the verify command (ROADMAP.md) runs under a
+# hard 870 s timeout and tees its log to /tmp/_t1.log; fail loudly once
+# the suite burns >80% of that budget (407 s at PR 4 and climbing) so
+# the timeout is re-planned BEFORE it starts killing runs mid-suite.
+echo "== tier-1 duration guard (last run must be < 80% of 870 s) =="
+T1_LOG=${T1_LOG:-/tmp/_t1.log}
+if [ -f "$T1_LOG" ]; then
+    secs=$(grep -aoE 'in [0-9]+\.[0-9]+s' "$T1_LOG" | tail -1 \
+           | grep -oE '[0-9]+\.[0-9]+' || true)
+    if [ -n "${secs:-}" ]; then
+        python - "$secs" <<'EOF'
+import sys
+secs, budget = float(sys.argv[1]), 870.0
+frac = secs / budget
+print(f"last tier-1 run: {secs:.0f}s = {100 * frac:.0f}% of the "
+      f"{budget:.0f}s timeout budget (guard: 80%)")
+if frac > 0.8:
+    print("FAIL: tier-1 exceeds 80% of its timeout budget — trim or "
+          "re-mark slow tests, or re-plan the budget")
+    sys.exit(1)
+EOF
+    else
+        echo "no pytest duration line in $T1_LOG — skipping (run tier-1 "
+        echo "with the ROADMAP.md command to populate it)"
+    fi
+else
+    echo "no tier-1 log at $T1_LOG — skipping (run tier-1 first)"
+fi
+
+echo "== guard self-tests (lint fixtures, audit grid, invariant contracts, resilience) =="
 exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_analysis.py tests/test_invariants.py \
-    tests/test_results_schema.py \
+    tests/test_results_schema.py tests/test_resilience.py \
     -q -m 'not slow' -p no:cacheprovider

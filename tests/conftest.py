@@ -53,6 +53,12 @@ def pytest_configure(config):
         "n=16/B=4 audit grid additionally carries `slow`")
     config.addinivalue_line(
         "markers",
+        "resilience: resilient execution layer — chunk-boundary "
+        "checkpoint/resume (bit-identical, proven vs uninterrupted "
+        "runs), unified retry/backoff, crash injection "
+        "(aclswarm_tpu.resilience; docs/RESILIENCE.md)")
+    config.addinivalue_line(
+        "markers",
         "invariants: swarmcheck runtime sanitizer — compiled-in "
         "invariant contracts (aclswarm_tpu.analysis.invariants; "
         "docs/STATIC_ANALYSIS.md runtime tier): clean-system positives, "

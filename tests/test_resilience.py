@@ -1,0 +1,586 @@
+"""Resilient execution layer (aclswarm_tpu.resilience; docs/RESILIENCE.md).
+
+The headline guarantee, proven here at every layer: a rollout
+interrupted at a chunk boundary (exception or SIGKILL) and resumed from
+its checkpoint produces BIT-IDENTICAL trajectories, summaries, and
+invariant codes to an uninterrupted run — serial and B>=2 batched, with
+and without a `FaultSchedule`. Plus: the checkpoint codec and its loud
+manifest rejection (wrong config, wrong dtype, version skew, corrupt
+file — never a silent restart-from-zero), the unified retry policy, and
+the chunk executor's degrade-don't-die path.
+"""
+from __future__ import annotations
+
+import dataclasses
+import signal
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from aclswarm_tpu.resilience import checkpoint as ckptlib
+from aclswarm_tpu.resilience import crash as crashlib
+from aclswarm_tpu.resilience import (ChunkExecutor, CheckpointCorrupt,
+                                     CheckpointMismatch, CrashPlan,
+                                     InjectedCrash)
+from aclswarm_tpu.utils import retry as retrylib
+
+REPO = Path(__file__).resolve().parents[1]
+
+pytestmark = pytest.mark.resilience
+
+
+@pytest.fixture(autouse=True)
+def _disarm_crash():
+    yield
+    crashlib.arm(None)
+
+
+# ---------------------------------------------------------------- codec
+
+class TestCodec:
+    def _payload(self):
+        return {"arrays": [np.arange(6, dtype=np.int32).reshape(2, 3),
+                           np.asarray(2.5, np.float64),
+                           np.ones((3,), bool)],
+                "scalar": 7, "f": 0.1, "s": "x", "none": None,
+                "nested": {"deep": [1, 2, {"a": np.float32(1.5)}]}}
+
+    def test_roundtrip_bit_exact(self, tmp_path):
+        p = ckptlib.write_checkpoint(
+            tmp_path, "t", self._payload(),
+            ckptlib.make_manifest("test", "h", chunk=3))
+        payload, man = ckptlib.load_checkpoint(p)
+        assert man["chunk"] == 3 and man["kind"] == "test"
+        ref = self._payload()
+        for a, b in zip(ref["arrays"], payload["arrays"]):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            assert np.array_equal(a, b)
+        assert payload["scalar"] == 7 and payload["f"] == 0.1
+        assert payload["s"] == "x" and payload["none"] is None
+        assert payload["nested"]["deep"][2]["a"] == np.float32(1.5)
+        # atomic write leaves no temp file behind
+        assert not list(tmp_path.glob("*.tmp"))
+
+    def test_truncation_and_corruption_raise(self, tmp_path):
+        p = ckptlib.write_checkpoint(
+            tmp_path, "t", self._payload(),
+            ckptlib.make_manifest("test", "h", chunk=1))
+        buf = p.read_bytes()
+        p.write_bytes(buf[:len(buf) // 2])
+        with pytest.raises(CheckpointCorrupt):
+            ckptlib.load_checkpoint(p)
+        flipped = bytearray(buf)
+        flipped[len(buf) // 2] ^= 0xFF
+        p.write_bytes(bytes(flipped))
+        with pytest.raises(CheckpointCorrupt):
+            ckptlib.load_checkpoint(p)
+        p.write_bytes(b"\x00" * 64)
+        with pytest.raises(CheckpointCorrupt, match="magic"):
+            ckptlib.load_checkpoint(p)
+
+    def test_retention_bounded_and_latest(self, tmp_path):
+        for c in range(5):
+            ckptlib.write_checkpoint(
+                tmp_path, "t", {"c": c},
+                ckptlib.make_manifest("test", "h", chunk=c), keep=2)
+        left = sorted(tmp_path.glob("t.c*.ckpt"))
+        assert len(left) == 2
+        latest = ckptlib.latest_checkpoint(tmp_path, "t")
+        payload, man = ckptlib.load_checkpoint(latest)
+        assert man["chunk"] == 4 and payload["c"] == 4
+        assert ckptlib.clear_checkpoints(tmp_path, "t") == 2
+        assert ckptlib.latest_checkpoint(tmp_path, "t") is None
+
+
+# ------------------------------------------------- manifest rejection
+
+class TestManifestRejection:
+    """Each wrong-checkpoint class fails LOUDLY with the offending
+    fields — never a silent restart-from-zero (satellite #3)."""
+
+    def _write(self, tmp_path, **over):
+        man = ckptlib.make_manifest("trial", "confhash", chunk=2, trial=0)
+        man.update(over)
+        return ckptlib.write_checkpoint(tmp_path, "t", {"x": 1}, man)
+
+    def _expect(self, **over):
+        e = ckptlib.expected_manifest("trial", "confhash", trial=0)
+        e.update(over)
+        return e
+
+    def test_wrong_config_hash(self, tmp_path):
+        p = self._write(tmp_path)
+        with pytest.raises(CheckpointMismatch) as ei:
+            ckptlib.load_checkpoint(p, self._expect(config_hash="other"))
+        assert [m[0] for m in ei.value.mismatches] == ["config_hash"]
+
+    def test_wrong_dtype_fingerprint(self, tmp_path):
+        p = self._write(tmp_path, dtype="x64=False,float=float32")
+        with pytest.raises(CheckpointMismatch) as ei:
+            ckptlib.load_checkpoint(p, self._expect())
+        assert [m[0] for m in ei.value.mismatches] == ["dtype"]
+
+    def test_version_skew(self, tmp_path):
+        p = self._write(tmp_path, code_version="0.0.0-older")
+        with pytest.raises(CheckpointMismatch) as ei:
+            ckptlib.load_checkpoint(p, self._expect())
+        assert [m[0] for m in ei.value.mismatches] == ["code_version"]
+
+    def test_wrong_kind(self, tmp_path):
+        p = self._write(tmp_path)
+        with pytest.raises(CheckpointMismatch):
+            ckptlib.load_checkpoint(
+                p, ckptlib.expected_manifest("trial_batch", "confhash"))
+
+    def test_restore_tree_validates_leaves(self):
+        import jax.numpy as jnp
+        template = {"a": jnp.zeros((2, 3)), "b": jnp.zeros((), jnp.int32)}
+        good = [np.ones((2, 3)), np.asarray(5, np.int32)]
+        out = ckptlib.restore_tree(template, good)
+        assert np.array_equal(np.asarray(out["a"]), np.ones((2, 3)))
+        with pytest.raises(CheckpointMismatch, match="n_leaves"):
+            ckptlib.restore_tree(template, good[:1])
+        with pytest.raises(CheckpointMismatch, match="dtype"):
+            ckptlib.restore_tree(
+                template, [np.ones((2, 3)), np.asarray(5, np.int64)])
+        with pytest.raises(CheckpointMismatch, match="shape"):
+            ckptlib.restore_tree(
+                template, [np.ones((2, 4)), np.asarray(5, np.int32)])
+        # batch_flex relaxes ONLY the leading axis
+        flexed = ckptlib.restore_tree(
+            template, [np.ones((1, 3)), np.asarray(5, np.int32)],
+            batch_flex=True)
+        assert flexed["a"].shape == (1, 3)
+        with pytest.raises(CheckpointMismatch, match="shape"):
+            ckptlib.restore_tree(
+                template, [np.ones((2, 4)), np.asarray(5, np.int32)],
+                batch_flex=True)
+
+
+# ----------------------------------------------------------- retry layer
+
+class TestRetry:
+    def test_deterministic_jitter(self):
+        pol = retrylib.RetryPolicy(base_s=0.1, factor=2.0, max_s=1.0,
+                                   jitter=0.5, seed=3)
+        d = [retrylib.delay_for(pol, k) for k in range(4)]
+        assert d == [retrylib.delay_for(pol, k) for k in range(4)]
+        assert d[1] > d[0] and all(x <= 1.5 for x in d)
+        other = dataclasses.replace(pol, seed=4)
+        assert [retrylib.delay_for(other, k) for k in range(4)] != d
+
+    def test_retry_call_retries_then_succeeds(self):
+        calls, slept = [], []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("UNAVAILABLE: try again")
+            return "ok"
+
+        out = retrylib.retry_call(
+            flaky, policy=retrylib.RetryPolicy(attempts=4),
+            sleep=slept.append)
+        assert out == "ok" and len(calls) == 3 and len(slept) == 2
+
+    def test_retry_call_exhausts_and_respects_predicate(self):
+        def always(): raise RuntimeError("UNAVAILABLE")
+        with pytest.raises(RuntimeError):
+            retrylib.retry_call(
+                always, policy=retrylib.RetryPolicy(attempts=3),
+                sleep=lambda s: None)
+
+        calls = []
+
+        def bug():
+            calls.append(1)
+            raise ValueError("a plain bug")
+
+        with pytest.raises(ValueError):
+            retrylib.retry_call(
+                bug, policy=retrylib.RetryPolicy(attempts=5),
+                retryable=lambda e: "UNAVAILABLE" in str(e),
+                sleep=lambda s: None)
+        assert len(calls) == 1          # non-retryable: no second try
+
+    def test_budget_cap(self):
+        clock = [0.0]
+
+        def always(): raise RuntimeError("x")
+        with pytest.raises(RuntimeError):
+            retrylib.retry_call(
+                always,
+                policy=retrylib.RetryPolicy(attempts=100, base_s=10.0,
+                                            budget_s=5.0),
+                clock=lambda: clock[0], sleep=lambda s: None)
+
+    def test_poll_until(self):
+        clock = [0.0]
+        state = {"n": 0}
+
+        def tick(s):
+            clock[0] += s
+
+        def ready():
+            state["n"] += 1
+            return state["n"] >= 3
+
+        assert retrylib.poll_until(ready, grace_s=10.0, poll_s=1.0,
+                                   clock=lambda: clock[0], sleep=tick)
+        state["n"] = -10**9
+        clock[0] = 0.0
+        assert not retrylib.poll_until(ready, grace_s=3.0, poll_s=1.0,
+                                       clock=lambda: clock[0], sleep=tick)
+
+    def test_watchdog_finish_vs_fire_atomic(self):
+        fired = []
+        wd = retrylib.Watchdog(on_fire=lambda: fired.append(1))
+        assert wd.finish() is True
+        wd.fire()                       # finished first: must be a no-op
+        assert fired == []
+        wd2 = retrylib.Watchdog(on_fire=lambda: fired.append(1))
+        wd2.fire()
+        assert fired == [1]
+        assert wd2.finish() is False    # the fire claimed completion:
+        #                                 the caller must NOT also emit
+        #                                 its result (one-output rule)
+        wd2.fire()                      # and a second fire is a no-op
+        assert fired == [1]
+        # an on_fire that itself calls finish() must not deadlock
+        wd3 = retrylib.Watchdog(on_fire=lambda: wd3.finish())
+        wd3.fire()
+
+    def test_failure_record_matches_checker_schema(self):
+        sys.path.insert(0, str(REPO / "benchmarks"))
+        import check_results
+        row = retrylib.ExecutionFailure(stage="s", error="e").to_row()
+        assert set(row) <= check_results._FAILURE_ALLOWED
+        assert check_results._FAILURE_REQUIRED <= set(row)
+
+
+# -------------------------------------------------------- chunk executor
+
+class TestChunkExecutor:
+    def test_transient_retry_then_success(self):
+        ex = ChunkExecutor(policy=retrylib.RetryPolicy(
+            attempts=3, base_s=0.0, jitter=0.0))
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 2:
+                raise RuntimeError("DEADLINE exceeded through tunnel")
+            return 42
+
+        assert ex.run(flaky, stage="t") == 42
+        assert ex.retries == 1 and not ex.degraded and not ex.failures
+        assert ex.row_fields() == {"retries": 1}
+
+    def test_nontransient_and_injected_crash_pass_through(self):
+        ex = ChunkExecutor()
+        with pytest.raises(ValueError):
+            ex.run(lambda: (_ for _ in ()).throw(ValueError("bug")))
+        with pytest.raises(InjectedCrash):
+            ex.run(lambda: (_ for _ in ()).throw(InjectedCrash("kill")))
+        assert not ex.retries and not ex.degraded
+
+    def test_cpu_fallback_is_loud_and_recorded(self):
+        pol = retrylib.RetryPolicy(attempts=2, base_s=0.0, jitter=0.0)
+        ex = ChunkExecutor(policy=pol)
+        calls = []
+
+        def dies_on_device():
+            calls.append(1)
+            if len(calls) <= pol.attempts:
+                raise RuntimeError("UNAVAILABLE: device wedged")
+            return "cpu result"
+
+        assert ex.run(dies_on_device, stage="chunk3") == "cpu result"
+        assert ex.degraded and ex.retries == pol.attempts - 1
+        [fail] = ex.failures
+        assert fail.fallback == "cpu" and fail.stage == "chunk3"
+        fields = ex.row_fields()
+        assert fields["degraded"] is True
+        assert fields["execution_failures"][0]["fallback"] == "cpu"
+
+    def test_deleted_buffer_not_retried(self):
+        ex = ChunkExecutor()
+        calls = []
+
+        def donated():
+            calls.append(1)
+            raise RuntimeError("Array has been deleted with shape=f32[4]")
+
+        with pytest.raises(RuntimeError, match="deleted"):
+            ex.run(donated)
+        assert len(calls) == 1
+
+
+# --------------------------------------- engine-level resume equivalence
+
+def _leaves(tree):
+    import jax
+    return [np.asarray(x) for x in jax.tree.leaves(tree)]
+
+
+def _assert_trees_equal(a, b, what=""):
+    la, lb = _leaves(a), _leaves(b)
+    assert len(la) == len(lb), what
+    for i, (x, y) in enumerate(zip(la, lb)):
+        assert x.dtype == y.dtype, (what, i)
+        np.testing.assert_array_equal(x, y, err_msg=f"{what} leaf {i}")
+
+
+def _engine_problem(n=5, faults=False, checks=False):
+    import jax.numpy as jnp
+
+    from aclswarm_tpu import sim
+    from aclswarm_tpu.core.types import (ControlGains, SafetyParams,
+                                         make_formation)
+    from aclswarm_tpu.faults import sample_schedule
+    rng = np.random.default_rng(0)
+    ang = np.linspace(0, 2 * np.pi, n, endpoint=False)
+    pts = np.stack([3 * np.cos(ang), 3 * np.sin(ang), np.full(n, 2.0)], 1)
+    form = make_formation(
+        jnp.asarray(pts), jnp.asarray(np.ones((n, n)) - np.eye(n)),
+        jnp.asarray(np.eye(n)[:, :, None, None]
+                    * np.eye(3)[None, None] * 0.01))
+    sp = SafetyParams(bounds_min=jnp.asarray([-50.0, -50.0, 0.0]),
+                      bounds_max=jnp.asarray([50.0, 50.0, 10.0]))
+    sched = sample_schedule(7, n, dropout_frac=0.4, drop_tick=5,
+                            rejoin_tick=25, link_loss=0.2,
+                            dtype=jnp.asarray(pts).dtype) if faults \
+        else None
+    st = sim.init_state(rng.normal(size=(n, 3)) * 2.0 + [0, 0, 2.0],
+                        faults=sched, checks=checks)
+    cfg = sim.SimConfig(assignment="auction", assign_every=10,
+                        check_mode="on" if checks else "off")
+    return st, form, ControlGains(), sp, cfg
+
+
+@pytest.mark.parametrize("faults,checks", [(False, False), (True, False),
+                                           (True, True)])
+def test_engine_chunked_resume_bit_identical(tmp_path, faults, checks):
+    """Serial rollout: save/load at a chunk boundary reproduces the
+    remaining chunks' trajectories (q in StepMetrics), summaries, and
+    invariant codes bit-exactly — with and without a FaultSchedule."""
+    import jax
+
+    from aclswarm_tpu import sim
+    st0, form, cg, sp, cfg = _engine_problem(faults=faults, checks=checks)
+    chunk, cut, total = 10, 2, 4
+
+    state = st0
+    ref = []
+    for k in range(total):
+        state, m = sim.rollout(state, form, cg, sp, cfg, chunk)
+        ref.append(jax.tree.map(np.asarray, m))
+        if k == cut - 1:
+            ckptlib.write_checkpoint(
+                tmp_path, "eng", {"state": ckptlib.tree_arrays(state)},
+                ckptlib.make_manifest("eng", "h", chunk=k + 1))
+    final_ref = state
+
+    payload, man = ckptlib.load_checkpoint(
+        ckptlib.latest_checkpoint(tmp_path, "eng"),
+        expected=ckptlib.expected_manifest("eng", "h"))
+    state = ckptlib.restore_tree(st0, payload["state"], what="SimState")
+    for k in range(int(man["chunk"]), total):
+        state, m = sim.rollout(state, form, cg, sp, cfg, chunk)
+        _assert_trees_equal(m, ref[k], f"metrics chunk {k}")
+    _assert_trees_equal(state, final_ref, "final state")
+
+
+@pytest.mark.parametrize("faults", [False, True])
+def test_batched_summary_resume_bit_identical(tmp_path, faults):
+    """Batched (B=2, per-trial fault scripts) fused rollout+summary:
+    (state, carry) checkpoint round trip reproduces the remaining
+    chunks' ChunkSummary bit-exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    from aclswarm_tpu.faults import no_faults, sample_schedule
+    from aclswarm_tpu.sim import summary as sumlib
+
+    sts, forms = [], []
+    for b in range(2):
+        st, form, cg, sp, cfg = _engine_problem()
+        if faults:
+            dtype = st.swarm.q.dtype
+            sched = sample_schedule(b + 1, 5, dropout_frac=0.4,
+                                    drop_tick=3 + b, rejoin_tick=20,
+                                    link_loss=0.1, dtype=dtype) \
+                if b else no_faults(5, dtype)
+            st = st.replace(faults=sched)
+        sts.append(st)
+        forms.append(form)
+    bstate = jax.tree.map(lambda *xs: jnp.stack(xs), *sts)
+    bform = jax.tree.map(lambda *xs: jnp.stack(xs), *forms)
+    window = 5
+    carry0 = sumlib.init_carry(5, window, dtype=bstate.swarm.q.dtype,
+                               batch=2)
+    alt = jnp.asarray(2.0, bstate.swarm.q.dtype)
+    chunk, cut, total = 10, 1, 3
+
+    state, carry = bstate, carry0
+    ref = []
+    for k in range(total):
+        state, carry, summ = sumlib.batched_rollout_summary(
+            state, carry, bform, cg, sp, cfg, chunk, None, 0,
+            window=window, takeoff_alt=alt)
+        ref.append(jax.tree.map(np.asarray, summ))
+        if k == cut - 1:
+            ckptlib.write_checkpoint(
+                tmp_path, "bat",
+                {"state": ckptlib.tree_arrays(state),
+                 "carry": ckptlib.tree_arrays(carry)},
+                ckptlib.make_manifest("bat", "h", chunk=k + 1))
+    final_ref = state
+
+    # donation consumed the originals: rebuild fresh templates
+    sts2 = [s for s in sts]
+    bstate2 = jax.tree.map(lambda *xs: jnp.stack(xs), *sts2)
+    carry_t = sumlib.init_carry(5, window, dtype=bstate2.swarm.q.dtype,
+                                batch=2)
+    payload, man = ckptlib.load_checkpoint(
+        ckptlib.latest_checkpoint(tmp_path, "bat"),
+        expected=ckptlib.expected_manifest("bat", "h"))
+    state = ckptlib.restore_tree(bstate2, payload["state"],
+                                 batch_flex=True, what="SimState")
+    carry = ckptlib.restore_tree(carry_t, payload["carry"],
+                                 batch_flex=True, what="SummaryCarry")
+    for k in range(int(man["chunk"]), total):
+        state, carry, summ = sumlib.batched_rollout_summary(
+            state, carry, bform, cg, sp, cfg, chunk, None, 0,
+            window=window, takeoff_alt=alt)
+        _assert_trees_equal(summ, ref[k], f"summary chunk {k}")
+    _assert_trees_equal(state, final_ref, "final batched state")
+
+
+# --------------------------------------- driver-level resume equivalence
+
+def _fsm_signature(fsm, t):
+    return (fsm.state, fsm.tick_count, fsm.times, fsm.time_avoidance,
+            fsm.assignments, fsm.csv_row(t))
+
+
+class TestTrialDriverResume:
+    CFG = dict(formation="simform6", trials=1, seed=1, verbose=False,
+               out="/dev/null")
+
+    def test_serial_crash_resume_bit_identical(self, tmp_path):
+        from aclswarm_tpu.harness import trials as triallib
+        ref = triallib.run_trial(triallib.TrialConfig(**self.CFG), 0)
+
+        cfg = triallib.TrialConfig(checkpoint_dir=str(tmp_path),
+                                   checkpoint_every=1, **self.CFG)
+        crashlib.arm(CrashPlan("trial", 2))
+        with pytest.raises(InjectedCrash):
+            triallib.run_trial(cfg, 0)
+        assert ckptlib.latest_checkpoint(tmp_path, "trial00000")
+        resumed = triallib.run_trial(cfg, 0)
+        assert resumed.completed == ref.completed
+        assert _fsm_signature(resumed, 0) == _fsm_signature(ref, 0)
+        np.testing.assert_array_equal(resumed.dist, ref.dist)
+        # finished: interim checkpoints pruned (bounded retention)
+        assert ckptlib.latest_checkpoint(tmp_path, "trial00000") is None
+
+    def test_batch_crash_resume_bit_identical(self, tmp_path):
+        from aclswarm_tpu.harness import trials as triallib
+        base = dict(self.CFG, trials=2, batch=2, chunk_ticks=120)
+        refs = triallib.run_trial_batch(triallib.TrialConfig(**base),
+                                        [0, 1])
+
+        cfg = triallib.TrialConfig(checkpoint_dir=str(tmp_path),
+                                   checkpoint_every=1, **base)
+        crashlib.arm(CrashPlan("batch", 2))
+        with pytest.raises(InjectedCrash):
+            triallib.run_trial_batch(cfg, [0, 1])
+        resumed = triallib.run_trial_batch(cfg, [0, 1])
+        for t, (a, b) in enumerate(zip(resumed, refs)):
+            assert a.completed == b.completed
+            assert _fsm_signature(a, t) == _fsm_signature(b, t), t
+            np.testing.assert_array_equal(a.dist, b.dist)
+
+    def test_run_trials_resume_skips_done_and_dedupes_csv(self, tmp_path):
+        from aclswarm_tpu.harness import trials as triallib
+        out_ref = tmp_path / "ref.csv"
+        cfg_ref = triallib.TrialConfig(
+            **dict(self.CFG, trials=2, out=str(out_ref)))
+        triallib.run_trials(cfg_ref)
+        ref_rows = out_ref.read_text()
+
+        out = tmp_path / "resumed.csv"
+        cfg = triallib.TrialConfig(
+            checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=1,
+            **dict(self.CFG, trials=2, out=str(out)))
+        crashlib.arm(CrashPlan("trial", 2))    # dies inside trial 0
+        with pytest.raises(InjectedCrash):
+            triallib.run_trials(cfg)
+        stats = triallib.run_trials(cfg)       # resumes + finishes
+        assert stats["trials_completed"] == 2
+        assert out.read_text() == ref_rows
+        # a third run replays from done-markers without duplicating rows
+        stats = triallib.run_trials(cfg)
+        assert stats["trials_completed"] == 2
+        assert out.read_text() == ref_rows
+
+    def test_changed_config_rejected_loudly(self, tmp_path):
+        from aclswarm_tpu.harness import trials as triallib
+        cfg = triallib.TrialConfig(checkpoint_dir=str(tmp_path),
+                                   checkpoint_every=1, **self.CFG)
+        crashlib.arm(CrashPlan("trial", 1))
+        with pytest.raises(InjectedCrash):
+            triallib.run_trial(cfg, 0)
+        # same checkpoint dir, different engine-visible knob: REJECT
+        cfg2 = dataclasses.replace(cfg, tau=0.2)
+        with pytest.raises(CheckpointMismatch) as ei:
+            triallib.run_trial(cfg2, 0)
+        assert [m[0] for m in ei.value.mismatches] == ["config_hash"]
+        # output-path / verbosity changes do NOT invalidate a checkpoint
+        cfg3 = dataclasses.replace(cfg, out="/dev/null", verbose=False)
+        assert triallib.run_trial(cfg3, 0) is not None
+
+    def test_record_dir_with_checkpoints_rejected(self, tmp_path):
+        from aclswarm_tpu.harness import trials as triallib
+        cfg = triallib.TrialConfig(checkpoint_dir=str(tmp_path),
+                                   record_dir=str(tmp_path / "rec"),
+                                   **self.CFG)
+        with pytest.raises(ValueError, match="record_dir"):
+            triallib.run_trial(cfg, 0)
+
+
+# ------------------------------------------------- SIGKILL subprocess proof
+
+def test_sigkill_smoke_subprocess():
+    """The scripts/check.sh smoke, exercised from tier-1: a child run is
+    SIGKILL'd (env-armed crash plan) at chunk boundary 1, the parent
+    resumes from its checkpoint and proves bit-parity."""
+    r = subprocess.run(
+        [sys.executable, "-m", "aclswarm_tpu.resilience.smoke"],
+        capture_output=True, text=True, timeout=570, cwd=str(REPO),
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, f"\n{r.stdout}\n{r.stderr}"
+    assert "PASS" in r.stdout
+    assert f"SIGKILL'd at chunk boundary {1}" in r.stdout
+
+
+def test_crash_plan_env_roundtrip():
+    plan = CrashPlan("suite", 3, "kill")
+    assert CrashPlan.decode(plan.encode()) == plan
+    assert CrashPlan.decode("trial:2") == CrashPlan("trial", 2, "raise")
+    with pytest.raises(ValueError):
+        CrashPlan.decode("bad")
+    with pytest.raises(ValueError):
+        CrashPlan("s", 0, kind="explode")
+    # unmatched site/boundary: no-op
+    crashlib.arm(CrashPlan("trial", 5))
+    crashlib.maybe_crash("trial", 4)
+    crashlib.maybe_crash("batch", 5)
+    with pytest.raises(InjectedCrash):
+        crashlib.maybe_crash("trial", 5)
+    # one-shot: disarmed after firing
+    crashlib.maybe_crash("trial", 5)

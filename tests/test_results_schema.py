@@ -86,3 +86,62 @@ def test_checker_accepts_summary_objects(tmp_path):
     summ = tmp_path / "trials_summary.json"
     summ.write_text(json.dumps({"backend": "cpu", "configs": {}}, indent=1))
     assert check_file(summ) == []
+
+
+def test_resilience_metadata_validated(tmp_path):
+    """The resume/retries/degraded/execution_failures metadata
+    (docs/RESILIENCE.md) is validated when present: booleans are
+    booleans, retries a non-negative int, and failure records carry
+    exactly the ExecutionFailure schema — unknown keys rejected."""
+    ok = tmp_path / "whatever.json"
+    ok.write_text(json.dumps(
+        {"metric": "m", "value": 1.0, "resume": True, "retries": 2,
+         "degraded": True, "execution_failures": [
+             {"stage": "chunk3", "error": "UNAVAILABLE", "attempts": 3,
+              "elapsed_s": 1.25, "fallback": "cpu"}]}) + "\n")
+    assert check_file(ok) == []
+
+    bad = tmp_path / "bad.json"
+    bad.write_text("\n".join([
+        json.dumps({"metric": "m", "value": 1.0, "resume": "yes"}),
+        json.dumps({"metric": "m", "value": 1.0, "retries": -1}),
+        json.dumps({"metric": "m", "value": 1.0, "retries": True}),
+        json.dumps({"metric": "m", "value": 1.0, "degraded": 1}),
+        json.dumps({"metric": "m", "value": 1.0,
+                    "execution_failures": [{"stage": "s"}]}),       # no error
+        json.dumps({"metric": "m", "value": 1.0,
+                    "execution_failures": [
+                        {"stage": "s", "error": "e", "extra": 1}]}),  # unknown
+    ]) + "\n")
+    probs = check_file(bad)
+    assert len(probs) == 6, probs
+    assert any("unknown keys" in p for p in probs)
+
+
+def test_strict_rows_accept_recorded_cell_failures(tmp_path):
+    """A suite that survives a failing grid cell records it as an error
+    row (the continue-the-sweep fix) — legal in strict artifacts, while
+    a row with neither value nor error still fails."""
+    strict = tmp_path / "fault_recovery.json"
+    strict.write_text(
+        json.dumps({"name": "fault_sweep_n100", "n": 100,
+                    "error": "XlaRuntimeError: RESOURCE_EXHAUSTED",
+                    "execution_failures": [
+                        {"stage": "fault_sweep_n100", "error": "boom"}],
+                    }) + "\n")
+    assert check_file(strict) == []
+    strict.write_text(json.dumps({"name": "x", "n": 10}) + "\n")
+    assert len(check_file(strict)) == 1
+
+
+def test_resilience_overhead_artifact_committed():
+    """The checkpoint-tax evidence (acceptance: <5% at n=10 at the
+    default cadence) is committed and on schema."""
+    path = RESULTS / "resilience_overhead.json"
+    assert path.exists(), "benchmarks/results/resilience_overhead.json " \
+                          "missing (python -m aclswarm_tpu.resilience" \
+                          ".smoke --overhead --out ...)"
+    rows = [json.loads(ln) for ln in path.read_text().strip().splitlines()]
+    by_name = {r["name"]: r for r in rows}
+    head = by_name["checkpoint_overhead_frac_n10"]
+    assert head["n"] == 10 and head["value"] < 0.05
