@@ -25,6 +25,10 @@ Subpackages
 - ``resilience`` execution-layer resilience: chunk-boundary checkpoints,
                  bit-identical resume, retry/degrade, crash injection
                  (docs/RESILIENCE.md)
+- ``serve``      swarmserve: always-on serving layer — admission control,
+                 backpressure, tenant-fair continuous batching, deadlines,
+                 checkpoint-backed preemption, journaled zero-loss
+                 recovery (docs/SERVICE.md)
 - ``parallel``   agent-axis sharding over device meshes
 - ``harness``    formation library, random formations, supervisor, trials
 - ``interop``    wire-format message types at the host boundary

@@ -29,12 +29,13 @@ results JSON (`benchmarks/check_results.py` validates the fields).
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Optional
 
 from aclswarm_tpu.resilience.crash import InjectedCrash
-from aclswarm_tpu.utils.retry import (ExecutionFailure, RetryPolicy,
-                                      retry_call)
+from aclswarm_tpu.utils.retry import (ExecutionFailure, RetryCancelled,
+                                      RetryPolicy, retry_call)
 
 # message markers of the transient device-failure class (XLA status
 # codes + tunnel/transport symptoms); type names checked alongside so a
@@ -81,12 +82,18 @@ class ChunkExecutor:
         if self.log is not None:
             self.log.warning(msg)
 
-    def run(self, fn: Callable, *args, stage: str = "chunk"):
+    def run(self, fn: Callable, *args, stage: str = "chunk",
+            cancel: Optional[threading.Event] = None):
         """Execute ``fn(*args)`` with retry + CPU fallback. The thunk
         must be replay-safe up to donation: if its donated inputs were
         consumed before the failure, jax raises the deleted-buffer
         error, which is classified non-retryable and surfaced with a
-        resume-from-checkpoint record."""
+        resume-from-checkpoint record.
+
+        ``cancel`` propagates into the retry budget (`utils.retry`): a
+        cancelled stage stops backing off immediately and surfaces its
+        failure without the CPU fallback — a torn-down request must not
+        keep burning the device."""
         t0 = time.monotonic()
 
         def note_retry(attempt: int, e: BaseException) -> None:
@@ -98,10 +105,13 @@ class ChunkExecutor:
         try:
             return retry_call(fn, *args, policy=self.policy,
                               retryable=self.transient,
-                              on_retry=note_retry)
+                              on_retry=note_retry, cancel=cancel)
         except BaseException as e:      # noqa: BLE001 — classified below
-            if isinstance(e, InjectedCrash) or not self.transient(e):
+            if isinstance(e, (InjectedCrash, RetryCancelled)) \
+                    or not self.transient(e):
                 raise
+            if cancel is not None and cancel.is_set():
+                raise                   # cancelled mid-retry: no fallback
             if not self.cpu_fallback:
                 self.failures.append(ExecutionFailure(
                     stage=stage, error=str(e),

@@ -1,0 +1,44 @@
+"""swarmserve — the hardened always-on serving layer (docs/SERVICE.md).
+
+ROADMAP open item 2 made concrete: a persistent in-process service over
+the batched rollout engine. A threaded queue front end accepts
+heterogeneous rollout / assignment / gain-design requests from many
+tenants, packs compatible work into shape-bucketed, power-of-two,
+continuously refilled device batches, and streams per-chunk results
+back per request. The robustness contract is the product:
+
+- **admission control + backpressure** — bounded per-tenant and global
+  queues; overload is an explicit `RejectedError` with a drain-rate
+  ``retry_after_s`` hint, never unbounded growth (`serve.admission`);
+- **zero silent losses** — accepted requests are journaled durably
+  before `submit` returns and ALWAYS terminate with a value or a
+  structured `ServeError`, across deadline expiry, preemption, and
+  worker SIGKILL + recovery (`serve.service`, proven by `serve.smoke`
+  and `benchmarks/serve_soak.py`);
+- **deadline enforcement at chunk boundaries** — timed-out work is
+  cancelled with a structured ``deadline_exceeded`` error, not a hang;
+- **per-tenant fair scheduling** — round-robin batch slots; a flooding
+  tenant cannot starve the others;
+- **checkpoint-backed preemption** — long rollouts past their quantum
+  are evicted through the PR-5 checkpoint codec and resume
+  bit-identically (eviction is free);
+- **degraded-mode operation** — transient device failures retry and
+  fall back to CPU with loud markers (`resilience.ChunkExecutor`).
+
+Host-side only: no compiled code is added (HLO baseline unchanged);
+the worker drives the same jitted entry points as the trial drivers.
+"""
+from aclswarm_tpu.serve.api import (COMPLETED, FAILED, PREEMPTED, QUEUED,
+                                    RUNNING, TERMINAL, TIMED_OUT,
+                                    ChunkEvent, RejectedError, Request,
+                                    Result, ServeError, Ticket)
+from aclswarm_tpu.serve.client import probe_backend, submit_and_wait
+from aclswarm_tpu.serve.service import (BUILTIN_KINDS, ServiceConfig,
+                                        SwarmService)
+
+__all__ = [
+    "COMPLETED", "FAILED", "PREEMPTED", "QUEUED", "RUNNING", "TERMINAL",
+    "TIMED_OUT", "ChunkEvent", "RejectedError", "Request", "Result",
+    "ServeError", "Ticket", "probe_backend", "submit_and_wait",
+    "BUILTIN_KINDS", "ServiceConfig", "SwarmService",
+]

@@ -1,0 +1,193 @@
+"""Admission control + tenant-fair scheduling (docs/SERVICE.md).
+
+Two jobs, one lock:
+
+- **Admission** is the only unbounded-growth defense the service has:
+  per-tenant and global queue caps, enforced at `submit` time with an
+  explicit `RejectedError` carrying a drain-rate-based ``retry_after_s``
+  hint. A request the service cannot promise to run is refused at the
+  door — never parked on an unbounded queue that turns deadlines into
+  lies (the Orca/vLLM-style admission posture, PAPERS.md).
+- **Fair pick**: the worker asks for the next batch of same-bucket jobs
+  and gets them round-robin across tenants — the tenant cursor advances
+  every pick, and batch slots are dealt one-per-tenant-per-cycle, so a
+  tenant flooding its (bounded) queue can delay another tenant by at
+  most one batch residency, never starve it. Within a tenant, FIFO.
+
+A *bucket* is the shape-compatibility key (`service._Job.bucket`):
+requests in one device batch must share it. The picker chooses the
+bucket of the first eligible job at the cursor, then fills remaining
+slots with same-bucket work from all tenants (fair cycle first, then
+greedy) — heterogeneous traffic still packs, it just packs per-round.
+
+Re-queueing (preempted or still-running-next-chunk jobs) bypasses the
+caps: those requests were already accepted, and bouncing them would
+convert backpressure into a silent loss.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from aclswarm_tpu.serve.api import E_QUEUE_FULL, RejectedError
+
+
+class AdmissionControl:
+    """Bounded per-tenant FIFO queues with a round-robin batch picker.
+
+    Thread-safety: every public method takes the one internal condition
+    lock; `pick` blocks on it (bounded by ``timeout``) so the worker
+    parks without spinning while the service is idle."""
+
+    def __init__(self, max_per_tenant: int = 8, max_total: int = 32,
+                 clock: Callable[[], float] = time.monotonic):
+        self.max_per_tenant = int(max_per_tenant)
+        self.max_total = int(max_total)
+        self._cv = threading.Condition()
+        self._queues: dict[str, list] = {}   # tenant -> FIFO of jobs
+        self._order: list[str] = []          # tenant round-robin ring
+        self._cursor = 0
+        self._clock = clock
+        # EWMA of per-request service time feeds the retry-after hint;
+        # seeded pessimistically so an empty history still backs off
+        self._ewma_s = 0.25
+
+    # ------------------------------------------------------------- intake
+
+    def admit(self, job, force: bool = False, hold: bool = False) -> None:
+        """Enqueue an incoming job, enforcing the caps. ``force``
+        bypasses them — recovery re-admission and preemption re-queues
+        of ALREADY-accepted work must never bounce. ``hold`` enqueues
+        the job *invisibly to the picker*: the slot counts toward the
+        caps (so racing submits cannot oversubscribe) but the worker
+        cannot start it until `release` — the journaled-service
+        ordering gate (caps checked BEFORE the durable frame is
+        written, frame durable before the worker can run the job)."""
+        with self._cv:
+            q = self._queues.setdefault(job.req.tenant, [])
+            if job.req.tenant not in self._order:
+                self._order.append(job.req.tenant)
+            if not force:
+                total = sum(len(x) for x in self._queues.values())
+                if len(q) >= self.max_per_tenant:
+                    raise RejectedError(
+                        f"{E_QUEUE_FULL}: tenant {job.req.tenant!r} at "
+                        f"its {self.max_per_tenant}-request cap",
+                        self.retry_after())
+                if total >= self.max_total:
+                    raise RejectedError(
+                        f"{E_QUEUE_FULL}: service at its "
+                        f"{self.max_total}-request global cap",
+                        self.retry_after())
+            job.held = hold
+            q.append(job)
+            if not hold:
+                self._cv.notify_all()
+
+    def release(self, job) -> None:
+        """Make a held job visible to the picker (its journal frame is
+        durable — the acceptance promise now exists on disk)."""
+        with self._cv:
+            job.held = False
+            self._cv.notify_all()
+
+    def cancel(self, job) -> None:
+        """Back out an enqueued-but-unpicked job (a failed submit):
+        frees its caps slot. No-op if the job is not queued."""
+        with self._cv:
+            q = self._queues.get(job.req.tenant, [])
+            if job in q:
+                q.remove(job)
+
+    def requeue(self, job) -> None:
+        """Tail re-queue of an accepted job (next chunk / preempted)."""
+        self.admit(job, force=True)
+
+    # ------------------------------------------------------------ picking
+
+    def pick(self, max_jobs: int, timeout: float) -> List:
+        """Dequeue up to ``max_jobs`` same-bucket jobs, tenant-fair.
+        Blocks up to ``timeout`` for work; [] = still idle."""
+        deadline = self._clock() + timeout
+        with self._cv:
+            while True:
+                lead = self._lead_job()
+                if lead is not None:
+                    break
+                remaining = deadline - self._clock()
+                if remaining <= 0 or not self._cv.wait(remaining):
+                    if self._lead_job() is None:
+                        return []
+                    lead = self._lead_job()
+                    break
+            tenant, job0 = lead
+            bucket = job0.bucket
+            take = [job0]
+            self._queues[tenant].remove(job0)
+            # deal remaining slots one-per-tenant-per-cycle, starting
+            # after the lead tenant; fall back to greedy same-bucket
+            # fill once a full cycle adds nothing
+            ring = self._order
+            start = (ring.index(tenant) + 1) % len(ring)
+            progress = True
+            while len(take) < max_jobs and progress:
+                progress = False
+                for k in range(len(ring)):
+                    if len(take) >= max_jobs:
+                        break
+                    t = ring[(start + k) % len(ring)]
+                    j = next((x for x in self._queues.get(t, [])
+                              if x.bucket == bucket and not x.held), None)
+                    if j is not None:
+                        self._queues[t].remove(j)
+                        take.append(j)
+                        progress = True
+            # advance the cursor PAST the lead tenant: the next pick
+            # starts from its neighbor (the fairness rotation)
+            self._cursor = start
+            return take
+
+    def _lead_job(self):
+        """(tenant, job) at the round-robin cursor, else None. Held
+        jobs (mid-submit, journal frame not yet durable) are invisible."""
+        ring = self._order
+        for k in range(len(ring)):
+            t = ring[(self._cursor + k) % len(ring)]
+            j = next((x for x in self._queues.get(t, [])
+                      if not x.held), None)
+            if j is not None:
+                return t, j
+        return None
+
+    # ---------------------------------------------------------- telemetry
+
+    def pending(self) -> int:
+        with self._cv:
+            return sum(len(q) for q in self._queues.values())
+
+    def pending_excluding(self, job) -> int:
+        """Queued work besides ``job``'s own next-chunk re-queue — the
+        preemption trigger (evicting with nobody waiting is pure tax)."""
+        with self._cv:
+            return sum(1 for q in self._queues.values() for x in q
+                       if x is not job)
+
+    def empty(self) -> bool:
+        return self.pending() == 0
+
+    def note_service(self, dt_s: float) -> None:
+        """Fold one request's service time into the drain-rate EWMA."""
+        with self._cv:
+            self._ewma_s = 0.8 * self._ewma_s + 0.2 * max(0.0, dt_s)
+
+    def retry_after(self) -> float:
+        """Backpressure hint: estimated time for the current backlog to
+        drain (EWMA service time x pending), clamped to [0.05, 30] s."""
+        backlog = sum(len(q) for q in self._queues.values())
+        return float(min(30.0, max(0.05, self._ewma_s * max(1, backlog))))
+
+    def wake(self) -> None:
+        """Nudge a parked `pick` (shutdown/drain transitions)."""
+        with self._cv:
+            self._cv.notify_all()

@@ -1,0 +1,108 @@
+"""Client-side conveniences for swarmserve (docs/SERVICE.md).
+
+The service API is `SwarmService.submit` -> `Ticket`; this module adds
+the handful of patterns every caller was about to re-implement:
+
+- `probe_backend`: the sacrificial-subprocess device probe (a wedged
+  tunnel hangs `jax.devices()` *uncancellably* in the calling process —
+  bench.py learned this the hard way in round 5) wrapped in the unified
+  `RetryPolicy`, returning the backend NAME so callers can mark
+  not-the-bench-device runs as degraded instead of publishing them as
+  device measurements;
+- `submit_and_wait`: submit-then-block with every non-answer translated
+  into a structured failed `Result` — admission rejection, bounded
+  client patience (the service still owes the result; the client just
+  stopped waiting), and a DEAD worker (a ticket a dead worker holds
+  will never resolve; journal recovery is how it gets honored) — so
+  callers like `trials_suite.py --serve` treat every path uniformly.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from aclswarm_tpu.serve.api import (E_CLIENT_TIMEOUT, E_QUEUE_FULL,
+                                    E_WORKER_DIED, FAILED, RejectedError,
+                                    Result, ServeError)
+from aclswarm_tpu.utils.retry import (RetryPolicy, retry_call,
+                                      subprocess_output)
+
+PROBE_CODE = "import jax; print('backend=' + jax.default_backend())"
+
+
+def probe_backend(timeout_s: float = 120.0,
+                  code: str = PROBE_CODE,
+                  policy: Optional[RetryPolicy] = None,
+                  cwd: Optional[str] = None) -> Optional[str]:
+    """Backend name (``'tpu'``/``'cpu'``/...) via a throwaway subprocess
+    (`utils.retry.subprocess_output` — the single home for the
+    sacrificial-probe mechanics), retried under the unified policy;
+    None = the backend never answered within the budget (the
+    tunnel-wedge signature)."""
+    policy = policy or RetryPolicy(attempts=2, base_s=1.0, max_s=5.0)
+
+    def _once() -> str:
+        out = subprocess_output(code, timeout_s, cwd=cwd)
+        if out is None:
+            raise RuntimeError("device probe gave no output within "
+                               f"{timeout_s:.0f} s")
+        for line in out.splitlines():
+            if line.startswith("backend="):
+                return line.split("=", 1)[1].strip()
+        raise RuntimeError("device probe exited without a backend line")
+
+    try:
+        return retry_call(_once, policy=policy)
+    except RuntimeError:
+        return None
+
+
+def submit_and_wait(service, kind: str, params: dict, *,
+                    tenant: str = "default",
+                    request_id: Optional[str] = None,
+                    deadline_s: Optional[float] = None,
+                    client_timeout_s: Optional[float] = None,
+                    poll_s: float = 5.0) -> Result:
+    """Submit one request and block for its terminal `Result`. Every
+    non-answer comes back as a structured result (status ``failed``) so
+    callers can treat every path uniformly — only programming errors
+    raise:
+
+    - admission rejection -> ``queue_full`` (with the retry-after hint);
+    - ``client_timeout_s`` lapsing -> ``client_timeout`` (the service
+      STILL owes the result; the client just stopped waiting);
+    - the worker dying with the ticket open -> ``worker_died`` (a dead
+      worker never resolves its tickets — waiting longer is a hang, and
+      journal recovery is how the promise gets honored).
+
+    The wait polls ``service.alive`` every ``poll_s`` — legitimate
+    long-running work is indistinguishable from a hang without it."""
+    try:
+        ticket = service.submit(kind, params, tenant=tenant,
+                                request_id=request_id,
+                                deadline_s=deadline_s)
+    except RejectedError as e:
+        return Result(request_id=request_id or "", status=FAILED,
+                      error=ServeError(
+                          E_QUEUE_FULL, str(e),
+                          detail={"retry_after_s": e.retry_after_s}))
+    deadline = (time.monotonic() + client_timeout_s
+                if client_timeout_s is not None else None)
+    while True:
+        step = poll_s
+        if deadline is not None:
+            step = min(step, max(0.0, deadline - time.monotonic()))
+        try:
+            return ticket.result(timeout=step)
+        except TimeoutError as e:
+            if not service.alive and not ticket.done:
+                return Result(
+                    request_id=ticket.request_id, status=FAILED,
+                    error=ServeError(
+                        E_WORKER_DIED,
+                        "serve worker died with this request in flight "
+                        "(scripted crash?) — journal recovery is how "
+                        "it gets honored"))
+            if deadline is not None and time.monotonic() >= deadline:
+                return Result(request_id=ticket.request_id, status=FAILED,
+                              error=ServeError(E_CLIENT_TIMEOUT, str(e)))

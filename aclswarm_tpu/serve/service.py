@@ -1,0 +1,869 @@
+"""swarmserve: the always-on serving layer over the batched engine
+(docs/SERVICE.md; ROADMAP open item 2).
+
+A `SwarmService` is a threaded queue front end plus ONE device worker
+loop. Clients `submit` heterogeneous requests — chunked rollouts,
+assignment solves, gain designs, registered extension kinds — and hold
+a `Ticket` that streams per-chunk progress and resolves to a terminal
+`Result`. The worker packs compatible rollout requests into
+shape-bucketed, power-of-two-padded device batches (the
+`harness/trials.py` compaction idiom run in reverse: the batch is
+*refilled* from the queue every chunk instead of compacted as trials
+die) and runs them through `sim.batched_rollout` one chunk at a time,
+so every chunk boundary is simultaneously:
+
+- a **scheduling point** (new arrivals join the next round — continuous
+  batching, the Orca-style iteration-level scheduler of PAPERS.md),
+- a **deadline gate** (expired requests terminate with a structured
+  `deadline_exceeded` error instead of hanging),
+- a **preemption point** (a job past its quantum with other work
+  waiting is evicted THROUGH the resilience checkpoint codec and
+  resumes bit-identically — PR 5 made eviction free), and
+- a **durability point** (with a journal, in-flight rollout state is
+  checkpointed so a SIGKILLed worker loses at most one chunk of work,
+  never a request).
+
+Robustness invariants (proven by `serve.smoke`, `tests/test_serve.py`,
+and `benchmarks/serve_soak.py`):
+
+1. bounded queues — admission rejects loudly with a retry-after hint,
+   the service never buffers unboundedly (`serve.admission`);
+2. zero silent losses — an accepted request is journaled before
+   `submit` returns and terminates with a value or structured error,
+   across worker SIGKILL + restart;
+3. bit-identical resume — preempted or crash-recovered rollouts match
+   an uninterrupted run exactly;
+4. degraded, not dead — transient device failures retry and fall back
+   to CPU with loud markers via the shared `ChunkExecutor`.
+
+Host-side only: this module adds no compiled code (the HLO baseline is
+unchanged); it drives the same jitted entry points the trial drivers
+use.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+import uuid
+import zlib
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from aclswarm_tpu.resilience import ChunkExecutor, InjectedCrash, maybe_crash
+from aclswarm_tpu.resilience import checkpoint as ckptlib
+from aclswarm_tpu.serve.admission import AdmissionControl
+from aclswarm_tpu.serve.api import (COMPLETED, E_DEADLINE, E_EXECUTION,
+                                    E_QUEUE_FULL, E_SHUTDOWN, FAILED,
+                                    PREEMPTED, QUEUED, RUNNING, TIMED_OUT,
+                                    ChunkEvent, RejectedError, Request,
+                                    Result, ServeError, Ticket)
+from aclswarm_tpu.utils import get_logger
+from aclswarm_tpu.utils.retry import RetryPolicy
+
+BUILTIN_KINDS = ("rollout", "assign", "gains")
+CRASH_SITE = "serve"        # maybe_crash site: one boundary per round
+
+
+@dataclasses.dataclass(frozen=True)
+class ServiceConfig:
+    """Service-level knobs (per-request knobs live in the params)."""
+
+    max_queue_per_tenant: int = 8     # admission cap per tenant
+    max_queue_total: int = 32         # admission cap across tenants
+    max_batch: int = 4                # device batch slots per round
+    quantum_chunks: int = 2           # chunks before a job is preemptible
+    # journal directory (None = in-memory only: preemption still goes
+    # through the codec, but a killed worker process loses the promise
+    # ledger — production serving always sets this)
+    journal_dir: Optional[str] = None
+    default_deadline_s: Optional[float] = None
+    idle_poll_s: float = 0.05         # worker park interval when idle
+    retry_attempts: int = 3           # ChunkExecutor transient retries
+    cpu_fallback: bool = True         # degrade-don't-die (loud markers)
+    # terminal results kept in memory for duplicate-submit idempotency
+    # (oldest evicted beyond this — an always-on service must not grow
+    # per-request state without bound; journal done-frames persist
+    # regardless, so recovery-time replay is unaffected)
+    done_retention: int = 1024
+
+
+@dataclasses.dataclass
+class _Job:
+    """Service-internal request state (the ticket is the client view)."""
+
+    req: Request
+    ticket: Ticket
+    bucket: tuple
+    status: str = QUEUED
+    spec: Any = None              # parsed rollout problem (lazy-built)
+    state: Any = None             # resident SimState between chunks
+    chunks_total: int = 0
+    chunks_done: int = 0
+    run_chunks: int = 0           # consecutive chunks this residency
+    preemptions: int = 0
+    resumed: bool = False         # continued from a journaled checkpoint
+    crc: int = 0                  # running bit-exact position digest
+    chunk_digests: list = dataclasses.field(default_factory=list)
+    t_accept: float = 0.0         # monotonic (in-process latency split)
+    t_first_run: Optional[float] = None
+    finished: bool = False        # _finish() ran (atomic once-guard)
+    held: bool = False            # caps slot reserved, picker-invisible
+    _ckpt_bytes: Optional[bytes] = None   # journal-less preemption frame
+    _problem: Any = None          # (formation, cgains, sparams, cfg)
+
+
+# ---------------------------------------------------------------------------
+# request parsing / problem building (rollout)
+
+@dataclasses.dataclass
+class _RolloutSpec:
+    n: int
+    chunk_ticks: int
+    n_chunks: int
+    assignment: str
+    assign_every: int
+    seed: int
+    faults_spec: Optional[dict]
+    points: Optional[np.ndarray]
+    adjmat: Optional[np.ndarray]
+    gains: Optional[np.ndarray]
+
+
+def _parse_rollout(params: dict) -> _RolloutSpec:
+    """Validate + normalize rollout params at ADMISSION time: a request
+    the engine cannot run is refused at the door (ValueError), not
+    accepted and failed later."""
+    if "n" not in params or "ticks" not in params:
+        raise ValueError("rollout params require 'n' and 'ticks'")
+    n = int(params["n"])
+    ticks = int(params["ticks"])
+    chunk = int(params.get("chunk_ticks", 20))
+    if n < 2 or ticks < 1 or chunk < 1:
+        raise ValueError(f"bad rollout sizes n={n} ticks={ticks} "
+                         f"chunk_ticks={chunk}")
+    assign_every = int(params.get("assign_every", chunk))
+    if chunk % assign_every:
+        # the batch shares the decimation phase (docs/BATCHED_TRIALS.md):
+        # chunk-aligned auctions are what let heterogeneous requests at
+        # different progress share one compiled program
+        raise ValueError(f"chunk_ticks ({chunk}) must be a multiple of "
+                         f"assign_every ({assign_every})")
+    if ticks % chunk:
+        # every chunk runs full-length (ONE compiled shape per bucket);
+        # rounding up silently would execute MORE ticks than requested
+        # and report a different problem than the one submitted
+        raise ValueError(f"ticks ({ticks}) must be a multiple of "
+                         f"chunk_ticks ({chunk}) — chunks run whole")
+    fspec = params.get("faults")
+    _FKEYS = {"dropout_frac", "drop_tick", "rejoin_tick", "link_loss"}
+    if fspec is not None and (not isinstance(fspec, dict)
+                              or not set(fspec) <= _FKEYS):
+        raise ValueError("rollout 'faults' must be a spec dict with keys "
+                         f"from {sorted(_FKEYS)}, got {fspec!r}")
+    arr = {k: (np.asarray(params[k]) if k in params else None)
+           for k in ("points", "adjmat", "gains")}
+    return _RolloutSpec(
+        n=n, chunk_ticks=chunk,
+        n_chunks=ticks // chunk,
+        assignment=str(params.get("assignment", "auction")),
+        assign_every=assign_every, seed=int(params.get("seed", 0)),
+        faults_spec=fspec, points=arr["points"], adjmat=arr["adjmat"],
+        gains=arr["gains"])
+
+
+def _rollout_problem(spec: _RolloutSpec):
+    """Seeded problem construction (shared with `resilience.smoke`'s
+    idiom): circle formation + complete graph unless the request shipped
+    explicit arrays; initial cloud from the request seed. Deterministic
+    from the spec alone — that is what makes crash re-execution and
+    resume proofs possible."""
+    import jax.numpy as jnp
+
+    from aclswarm_tpu import sim
+    from aclswarm_tpu.core.types import (ControlGains, SafetyParams,
+                                         make_formation)
+    from aclswarm_tpu.faults import schedule as faultlib
+
+    n = spec.n
+    dt = jnp.result_type(float)
+    if spec.points is not None:
+        pts = np.asarray(spec.points, float)
+    else:
+        ang = np.linspace(0, 2 * np.pi, n, endpoint=False)
+        pts = np.stack([3 * np.cos(ang), 3 * np.sin(ang),
+                        np.full(n, 2.0)], 1)
+    adj = (np.asarray(spec.adjmat, float) if spec.adjmat is not None
+           else np.ones((n, n)) - np.eye(n))
+    gains = (np.asarray(spec.gains, float) if spec.gains is not None
+             else np.eye(n)[:, :, None, None] * np.eye(3)[None, None]
+             * 0.01)
+    form = make_formation(jnp.asarray(pts, dt), jnp.asarray(adj, dt),
+                          jnp.asarray(gains, dt))
+    sparams = SafetyParams(
+        bounds_min=jnp.asarray([-50.0, -50.0, 0.0], dt),
+        bounds_max=jnp.asarray([50.0, 50.0, 10.0], dt))
+    rng = np.random.default_rng(spec.seed)
+    q0 = rng.normal(size=(n, 3)) * 2.0 + [0, 0, 2.0]
+    # every serve rollout carries a FaultSchedule (no_faults when the
+    # request scripts none): ONE pytree structure per bucket, so faulted
+    # and fault-free requests stack into the same batch — no_faults is
+    # bit-identical to faults=None (tests/test_faults.py)
+    if spec.faults_spec is not None:
+        fs = faultlib.sample_schedule(spec.seed, n, dtype=dt,
+                                      **spec.faults_spec)
+    else:
+        fs = faultlib.no_faults(n, dtype=dt)
+    state = sim.init_state(q0, faults=fs)
+    cfg = sim.SimConfig(assignment=spec.assignment,
+                        assign_every=spec.assign_every)
+    return state, form, ControlGains(), sparams, cfg
+
+
+# ---------------------------------------------------------------------------
+# journal frames (atomic, codec-framed — no pickle)
+
+def _write_frame(path: Path, payload, manifest: dict) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_bytes(ckptlib.dumps(payload, manifest))
+    os.replace(tmp, path)
+
+
+def _read_frame(path: Path):
+    return ckptlib.loads(path.read_bytes(), path)
+
+
+class SwarmService:
+    """The in-process serving front end + device worker (docs/SERVICE.md).
+
+    Lifecycle::
+
+        svc = SwarmService(ServiceConfig(journal_dir=...))
+        t = svc.submit("rollout", {"n": 5, "ticks": 100}, tenant="a",
+                       deadline_s=30.0)
+        for ev in t.stream(): ...          # per-chunk progress
+        res = t.result(timeout=60)         # value OR structured error
+        svc.close()                        # drain, then stop — clean
+                                           # shutdown once all tenants idle
+
+    ``start=False`` builds the service without launching the worker
+    (admission-control tests and staged recovery drills)."""
+
+    def __init__(self, cfg: ServiceConfig = ServiceConfig(), *,
+                 start: bool = True, log=None):
+        self.cfg = cfg
+        self.log = log or get_logger("serve")
+        self._adm = AdmissionControl(cfg.max_queue_per_tenant,
+                                     cfg.max_queue_total)
+        self._execu = ChunkExecutor(
+            policy=RetryPolicy(attempts=cfg.retry_attempts, base_s=0.2,
+                               max_s=5.0),
+            cpu_fallback=cfg.cpu_fallback, log=self.log)
+        self._kinds: dict[str, Callable[[dict], Any]] = {}
+        self._jobs: dict[str, _Job] = {}
+        self._done_prior: dict[str, Result] = {}   # journal done-cache
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._closed = False          # close()'s sweep ran (under _lock)
+        self._round = 0
+        self.stats = {"accepted": 0, "completed": 0, "rejected": 0,
+                      "preempted": 0, "timed_out": 0, "failed": 0,
+                      "resumed": 0, "chunks": 0, "rounds": 0}
+        self._journal = Path(cfg.journal_dir) if cfg.journal_dir else None
+        self._ckpt_dir = (self._journal / "ckpt"
+                          if self._journal is not None else None)
+        if self._journal is not None:
+            self._recover()
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="swarmserve-worker")
+        if start:
+            self._worker.start()
+
+    # ------------------------------------------------------------ clients
+
+    def register(self, kind: str, fn: Callable[[dict], Any]) -> None:
+        """Install an extension request kind (``fn(params) -> value``,
+        executed on the worker under the retry/degrade executor).
+        `bench.py` and the suites register their measurements here."""
+        if kind in BUILTIN_KINDS:
+            raise ValueError(f"kind {kind!r} is built in")
+        self._kinds[kind] = fn
+
+    def submit(self, kind: str, params: dict, *, tenant: str = "default",
+               request_id: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> Ticket:
+        """Admit one request. Returns the `Ticket` the service now owes
+        a terminal result on; raises `RejectedError` (backpressure /
+        shutdown) or `ValueError` (malformed request) WITHOUT accepting.
+
+        ``request_id`` is the idempotency key: re-submitting an id the
+        service has seen (this process, or this journal — including
+        already-terminal requests from before a crash) returns the
+        existing ticket and never enqueues duplicate work."""
+        if deadline_s is None:
+            deadline_s = self.cfg.default_deadline_s
+        rid = request_id or uuid.uuid4().hex[:12]
+        req = Request(kind=kind, params=params, tenant=tenant,
+                      request_id=rid, deadline_s=deadline_s,
+                      t_submit=time.time())
+        with self._lock:
+            # idempotency first: re-submitting a known id must return
+            # the existing ticket even while the service is draining
+            if request_id is not None:
+                if request_id in self._jobs:
+                    return self._jobs[request_id].ticket
+                prior = self._done_prior.get(request_id)
+                if prior is not None:
+                    t = Ticket(request_id)
+                    t._resolve(prior)
+                    return t
+            if self._stop.is_set() or self._draining.is_set():
+                raise RejectedError(E_SHUTDOWN, 0.0)
+            job = self._make_job(req)      # validates; ValueError = refuse
+            # the id reservation shares the duplicate check's lock: two
+            # racing submits with one request_id cannot both build jobs
+            # — the loser attaches to THIS ticket above
+            self._jobs[rid] = job
+        try:
+            # caps-then-durable-then-runnable: admission HOLDS a caps
+            # slot (picker-invisible) before the journal frame is
+            # written, so rejected work is never journaled — not even
+            # transiently (a crash between frame and rejection cannot
+            # resurrect refused work) — and the frame (the acceptance
+            # promise) is durable before a worker that might crash
+            # mid-chunk can run the job
+            self._adm.admit(job, hold=self._journal is not None)
+            if self._journal is not None:
+                _write_frame(
+                    self._req_path(rid), {"params": params},
+                    ckptlib.make_manifest(
+                        "serve_req", ckptlib.config_hash(params), chunk=0,
+                        request_id=rid, tenant=tenant, req_kind=kind,
+                        deadline_s=deadline_s, t_submit=req.t_submit))
+                self._adm.release(job)
+        except BaseException as e:
+            rejected = isinstance(e, RejectedError)
+            with self._lock:
+                self._jobs.pop(rid, None)
+                if rejected:
+                    self.stats["rejected"] += 1
+            self._adm.cancel(job)
+            if self._journal is not None:
+                self._req_path(rid).unlink(missing_ok=True)
+            # a duplicate submit that attached during the reservation
+            # window holds this ticket: resolve it so it can never
+            # dangle (the primary caller sees the raised error)
+            job.ticket._resolve(Result(
+                request_id=rid, status=FAILED,
+                error=ServeError(
+                    E_QUEUE_FULL if rejected else E_EXECUTION,
+                    f"submit failed before acceptance: {e}")))
+            raise
+        with self._lock:
+            self.stats["accepted"] += 1
+            orphaned = self._closed
+        if orphaned:
+            # close() raced this submit and its cleanup sweep already
+            # ran: nobody is left to schedule the job, so honor the
+            # acceptance promise HERE with a structured error instead of
+            # leaving a ticket that never resolves (the frame stays
+            # un-done for a later recovery)
+            self._finish(job, FAILED,
+                         error=ServeError(E_SHUTDOWN,
+                                          "service closed while this "
+                                          "request was being accepted"),
+                         journal=False)
+        return job.ticket
+
+    def result(self, ticket: Ticket, timeout: Optional[float] = None
+               ) -> Result:
+        return ticket.result(timeout)
+
+    @property
+    def alive(self) -> bool:
+        """True while the worker loop can still make progress. False
+        after a clean exit OR a scripted/unexpected worker death —
+        clients waiting without a timeout should poll this instead of
+        blocking forever on a ticket the dead worker will never
+        resolve (journal recovery is how such tickets get honored)."""
+        return self._worker.is_alive()
+
+    def close(self, drain: bool = True, timeout: float = 120.0) -> None:
+        """Stop the service. ``drain=True`` (the clean shutdown): refuse
+        new work, run every accepted request to a terminal result, then
+        stop once all tenants are idle. ``drain=False``: stop after the
+        current round; still-queued requests resolve with a structured
+        ``service_shutdown`` error (their journal frames stay un-done,
+        so a later recovery can still honor them).
+
+        A drain that cannot finish within ``timeout`` is NOT silent: it
+        is logged loudly (with the count of requests it abandons) and
+        the abandoned tickets resolve with a structured error naming
+        the drain timeout — the promise is downgraded audibly, never
+        dropped."""
+        self._draining.set()
+        if not drain:
+            self._stop.set()
+        self._adm.wake()
+        drain_timed_out = False
+        if self._worker.is_alive():
+            self._worker.join(timeout)
+            drain_timed_out = drain and self._worker.is_alive()
+        self._stop.set()
+        if drain_timed_out:
+            err = ServeError(
+                E_SHUTDOWN,
+                f"close(drain=True) abandoned the drain after {timeout:g}"
+                " s with this request still in flight (journal frame "
+                "stays un-done for recovery)")
+        else:
+            err = ServeError(E_SHUTDOWN, "service closed before this "
+                                         "request was scheduled")
+        with self._lock:
+            # ordering handshake with submit(): a submit that inserts
+            # its job after this flag flips resolves it itself
+            self._closed = True
+            pending = [j for j in self._jobs.values() if not j.finished]
+        if drain_timed_out:
+            self.log.error(
+                "close(drain=True): worker still busy after the %g s "
+                "join — resolving %d still-pending request(s) with a "
+                "structured %s error; results the worker still produces "
+                "are discarded by the finish once-guard",
+                timeout, len(pending), E_SHUTDOWN)
+        for job in pending:
+            self._finish(job, FAILED, error=err, journal=False)
+
+    # --------------------------------------------------------- internals
+
+    def _make_job(self, req: Request) -> _Job:
+        if req.kind == "rollout":
+            spec = _parse_rollout(req.params)
+            job = _Job(req=req, ticket=Ticket(req.request_id),
+                       bucket=("rollout", spec.n, spec.chunk_ticks,
+                               spec.assignment, spec.assign_every),
+                       spec=spec, chunks_total=spec.n_chunks)
+        elif req.kind in BUILTIN_KINDS or req.kind in self._kinds:
+            job = _Job(req=req, ticket=Ticket(req.request_id),
+                       bucket=("single", req.kind), chunks_total=1)
+        else:
+            raise ValueError(f"unknown request kind {req.kind!r} "
+                             f"(builtin: {BUILTIN_KINDS}, registered: "
+                             f"{sorted(self._kinds)})")
+        job.t_accept = time.monotonic()
+        return job
+
+    def _req_path(self, rid: str) -> Path:
+        assert self._journal is not None
+        return self._journal / f"req_{rid}.req"
+
+    def _done_path(self, rid: str) -> Path:
+        assert self._journal is not None
+        return self._journal / f"req_{rid}.done"
+
+    # ------------------------------------------------------- worker loop
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            jobs = self._adm.pick(self.cfg.max_batch,
+                                  timeout=self.cfg.idle_poll_s)
+            if not jobs:
+                if self._draining.is_set() and self._adm.empty():
+                    return                 # all tenants idle: clean exit
+                continue
+            self._round += 1
+            with self._lock:
+                self.stats["rounds"] = self._round
+            try:
+                # the scripted-preemption hook: the soak SIGKILLs HERE,
+                # with the batch picked and its rollouts mid-flight —
+                # the journal + checkpoints are all that survives
+                maybe_crash(CRASH_SITE, self._round)
+                if jobs[0].bucket[0] == "rollout":
+                    self._rollout_round(jobs)
+                else:
+                    for job in jobs:
+                        self._single(job)
+            except InjectedCrash as e:
+                # scripted preemption: the worker dies HERE, mid-batch,
+                # leaving only the journal + checkpoints (quietly — a
+                # thread traceback would just be noise in the drill)
+                self.log.warning("serve worker dying as scripted: %s", e)
+                return
+            except Exception as e:         # noqa: BLE001 — recorded
+                # a round-level bug must not wedge the service: every
+                # job of the round terminates with structured evidence
+                err = ServeError(E_EXECUTION,
+                                 f"{type(e).__name__}: {e}",
+                                 detail=self._execu.row_fields() or None)
+                for job in jobs:
+                    if not job.ticket.done:
+                        self._finish(job, FAILED, error=err)
+
+    # -------------------------------------------------- rollout batching
+
+    def _ensure_state(self, job: _Job) -> None:
+        """Materialize the resident carry: fresh problem at chunk 0, or
+        a template-validated restore of the preemption/crash checkpoint
+        (THE checkpoint-backed path — restore goes through the codec
+        even for in-memory preemption)."""
+        if job.state is not None:
+            return
+        state, form, cgains, sparams, cfg = _rollout_problem(job.spec)
+        job._problem = (form, cgains, sparams, cfg)
+        frame = None
+        if job._ckpt_bytes is not None:
+            frame = ckptlib.loads(job._ckpt_bytes, f"<mem:{job.req.request_id}>")
+            job._ckpt_bytes = None
+        elif self._ckpt_dir is not None:
+            path = ckptlib.latest_checkpoint(self._ckpt_dir,
+                                             self._stem(job))
+            if path is not None:
+                frame = ckptlib.load_checkpoint(
+                    path, expected=ckptlib.expected_manifest(
+                        "serve_rollout",
+                        ckptlib.config_hash(job.req.params),
+                        request_id=job.req.request_id))
+        if frame is not None:
+            payload, man = frame
+            job.state = ckptlib.restore_tree(state, payload["state"],
+                                             path=self._stem(job),
+                                             what="SimState")
+            job.chunks_done = int(man["chunk"])
+            job.crc = int(payload["crc"])
+            job.chunk_digests = [int(d) for d in payload["chunk_digests"]]
+            job.preemptions = int(payload["preemptions"])
+        else:
+            job.state = state
+
+    def _stem(self, job: _Job) -> str:
+        return f"req_{job.req.request_id}"
+
+    def _checkpoint(self, job: _Job, to_disk: bool) -> None:
+        payload = {"state": ckptlib.tree_arrays(job.state),
+                   "crc": int(job.crc),
+                   "chunk_digests": [int(d) for d in job.chunk_digests],
+                   "preemptions": int(job.preemptions)}
+        man = ckptlib.make_manifest(
+            "serve_rollout", ckptlib.config_hash(job.req.params),
+            chunk=job.chunks_done, request_id=job.req.request_id)
+        if to_disk:
+            assert self._ckpt_dir is not None
+            ckptlib.write_checkpoint(self._ckpt_dir, self._stem(job),
+                                     payload, man)
+        else:
+            job._ckpt_bytes = ckptlib.dumps(payload, man)
+
+    def _rollout_round(self, jobs: list) -> None:
+        """One chunk for one shape bucket: deadline gate -> restore ->
+        pad to the power-of-two batch -> ONE `batched_rollout` launch ->
+        unstack, stream, checkpoint, then complete/preempt/requeue."""
+        import jax
+        import jax.numpy as jnp
+
+        from aclswarm_tpu import sim
+
+        live = []
+        for job in jobs:
+            if self._expired(job):
+                self._timeout(job)
+            else:
+                live.append(job)
+        if not live:
+            return
+        for job in live:
+            self._ensure_state(job)
+            job.status = RUNNING
+            if job.t_first_run is None:
+                job.t_first_run = time.monotonic()
+        form, cgains, sparams, cfg = live[0]._problem
+        chunk = live[0].spec.chunk_ticks
+        B = len(live)
+        P = 1
+        while P < B:
+            P *= 2
+        idx = list(range(B)) + [0] * (P - B)   # pow-2 pad: bounded shapes
+        bstate = jax.tree.map(
+            lambda *xs: jnp.stack(xs), *[live[i].state for i in idx])
+        bform = jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *[live[i]._problem[0] for i in idx])
+        t0 = time.monotonic()
+        bstate, metrics = self._execu.run(
+            lambda: sim.batched_rollout(bstate, bform, cgains, sparams,
+                                        cfg, chunk, None, 0),
+            stage=f"serve:round{self._round}")
+        q_all = np.asarray(metrics.q)          # (T, P, n, 3) — the host sync
+        for i, job in enumerate(live):
+            job.state = jax.tree.map(lambda x: x[i], bstate)
+            qb = np.ascontiguousarray(q_all[:, i])
+            job.crc = zlib.crc32(qb.tobytes(), job.crc) & 0xFFFFFFFF
+            job.chunk_digests.append(job.crc)
+            job.chunks_done += 1
+            job.run_chunks += 1
+            job.ticket._push(ChunkEvent(
+                job.req.request_id, job.chunks_done - 1,
+                {"chunk": job.chunks_done - 1,
+                 "tick_end": job.chunks_done * chunk,
+                 "digest": job.crc,
+                 "batch": B}))
+        with self._lock:
+            self.stats["chunks"] += len(live)
+        self._adm.note_service((time.monotonic() - t0) / max(1, B))
+
+        for job in live:
+            if job.chunks_done >= job.chunks_total:
+                q_final = np.asarray(job.state.swarm.q)
+                self._finish(job, COMPLETED, value={
+                    "q": q_final,
+                    "ticks": job.chunks_done * chunk,
+                    "digest": int(job.crc),
+                    "chunk_digests": [int(d) for d in job.chunk_digests]})
+                if self._ckpt_dir is not None:
+                    ckptlib.clear_checkpoints(self._ckpt_dir,
+                                              self._stem(job))
+                continue
+            if self._expired(job):
+                self._timeout(job)
+                continue
+            # checkpoint-backed preemption: a job past its quantum with
+            # other work waiting is evicted through the codec; the next
+            # residency restores it exactly. The count increments BEFORE
+            # the frame is written — the frame is the job's authoritative
+            # record across restores.
+            preempt = (job.run_chunks >= self.cfg.quantum_chunks
+                       and self._adm.pending_excluding(job) > 0)
+            if preempt:
+                job.preemptions += 1
+                with self._lock:
+                    self.stats["preempted"] += 1
+            # durability checkpoint every chunk when journaled: a
+            # SIGKILL between rounds costs at most one chunk of work
+            if self._ckpt_dir is not None:
+                self._checkpoint(job, to_disk=True)
+            elif preempt:
+                self._checkpoint(job, to_disk=False)
+            if preempt:
+                job.state = None
+                job._problem = None
+                job.status = PREEMPTED
+                job.run_chunks = 0
+            else:
+                job.status = QUEUED
+            self._adm.requeue(job)
+
+    # ---------------------------------------------------- single-shot work
+
+    def _single(self, job: _Job) -> None:
+        """Non-chunked kinds: the only boundaries are start and finish,
+        and the deadline is enforced at both (work that finishes past
+        its deadline is discarded with a structured error — the client
+        was promised the deadline, not a late answer)."""
+        if self._expired(job):
+            self._timeout(job)
+            return
+        job.status = RUNNING
+        job.t_first_run = time.monotonic()
+        kind = job.req.kind
+        fn = {"assign": self._do_assign,
+              "gains": self._do_gains}.get(kind) or self._kinds[kind]
+        t0 = time.monotonic()
+        value = self._execu.run(lambda: fn(job.req.params),
+                                stage=f"{kind}:{job.req.request_id}")
+        self._adm.note_service(time.monotonic() - t0)
+        if self._expired(job):
+            self._timeout(job, late=True)
+            return
+        self._finish(job, COMPLETED, value=value)
+
+    @staticmethod
+    def _do_assign(params: dict):
+        import jax.numpy as jnp
+
+        from aclswarm_tpu.assignment import sinkhorn
+        # the package re-exports the lapjv FUNCTION under the module's
+        # name; import the host solver directly
+        from aclswarm_tpu.assignment.lapjv import solve_assignment_host
+
+        n = int(params.get("n", 16))
+        seed = int(params.get("seed", 0))
+        rng = np.random.default_rng(seed)
+        q = (np.asarray(params["q"], float) if "q" in params
+             else rng.normal(size=(n, 3)) * 10)
+        p = (np.asarray(params["p"], float) if "p" in params
+             else rng.normal(size=(n, 3)) * 10)
+        solver = params.get("solver", "sinkhorn")
+        if solver == "lap":
+            perm = solve_assignment_host(q, p)
+        elif solver == "sinkhorn":
+            dt = jnp.result_type(float)
+            r = sinkhorn.sinkhorn_assign(
+                jnp.asarray(q, dt), jnp.asarray(p, dt),
+                n_iters=int(params.get("n_iters", 50)))
+            perm = np.asarray(r.row_to_col)
+        else:
+            raise ValueError(f"unknown assign solver {solver!r}")
+        return {"perm": np.asarray(perm, np.int64), "solver": solver}
+
+    @staticmethod
+    def _do_gains(params: dict):
+        from aclswarm_tpu import gains as gainslib
+
+        n = int(params.get("n", 6))
+        seed = int(params.get("seed", 0))
+        if "points" in params:
+            pts = np.asarray(params["points"], float)
+            adj = np.asarray(params["adjmat"], float)
+        else:
+            rng = np.random.default_rng(seed)
+            ang = np.linspace(0, 2 * np.pi, n, endpoint=False)
+            pts = np.stack([4 * np.cos(ang), 4 * np.sin(ang),
+                            2.0 + 0.1 * rng.normal(size=n)], 1)
+            adj = np.ones((n, n)) - np.eye(n)
+        g = np.asarray(gainslib.solve_gains(pts, adj))
+        return {"gains": g, "n": n}
+
+    # ------------------------------------------------------ finalization
+
+    def _expired(self, job: _Job) -> bool:
+        td = job.req.t_deadline
+        return td is not None and time.time() > td
+
+    def _timeout(self, job: _Job, late: bool = False) -> None:
+        msg = (f"deadline ({job.req.deadline_s:.3f} s) exceeded at "
+               f"chunk boundary {job.chunks_done}/{job.chunks_total}")
+        if late:
+            msg += " (work completed late; result discarded per contract)"
+        self._finish(job, TIMED_OUT, error=ServeError(E_DEADLINE, msg))
+        if self._ckpt_dir is not None:
+            ckptlib.clear_checkpoints(self._ckpt_dir, self._stem(job))
+
+    def _finish(self, job: _Job, status: str, value=None,
+                error: Optional[ServeError] = None,
+                journal: bool = True) -> None:
+        with self._lock:
+            # atomic once-guard: the close() sweep, the round-level
+            # exception handler, and a racing submit() may all try to
+            # terminate the same job — first caller wins, stats count once
+            if job.finished:
+                return
+            job.finished = True
+        t_done = time.time()
+        queued_s = (((job.t_first_run or time.monotonic()) - job.t_accept)
+                    if job.t_accept else 0.0)
+        res = Result(
+            request_id=job.req.request_id, status=status, value=value,
+            error=error,
+            latency_s=max(0.0, t_done - job.req.t_submit),
+            queued_s=max(0.0, queued_s), chunks=job.chunks_done,
+            preemptions=job.preemptions, resumed=job.resumed)
+        # durable-then-visible: the done-frame is written before the
+        # client can observe the result, so "resolved but not journaled"
+        # is impossible and recovery never re-runs finished work
+        if journal and self._journal is not None:
+            _write_frame(
+                self._done_path(job.req.request_id),
+                {"value": value,
+                 "error": error.to_row() if error else None},
+                ckptlib.make_manifest(
+                    "serve_done", "-", chunk=job.chunks_done,
+                    request_id=job.req.request_id, status=status,
+                    latency_s=res.latency_s, queued_s=res.queued_s,
+                    preemptions=job.preemptions, resumed=job.resumed,
+                    tenant=job.req.tenant, req_kind=job.req.kind,
+                    t_done=t_done))
+        job.status = status
+        with self._lock:
+            key = {COMPLETED: "completed", TIMED_OUT: "timed_out",
+                   FAILED: "failed"}[status]
+            self.stats[key] += 1
+            # retire the request record: an always-on service must not
+            # retain per-request device state (SimState pytree, problem
+            # arrays, checkpoint bytes) or unbounded job maps forever.
+            # The client's ticket keeps the Result alive; the service
+            # keeps only a bounded terminal cache for idempotent
+            # duplicate submits (journal done-frames persist on disk).
+            job.state = None
+            job._problem = None
+            job._ckpt_bytes = None
+            self._jobs.pop(job.req.request_id, None)
+            self._done_prior[job.req.request_id] = res
+            while len(self._done_prior) > max(0, self.cfg.done_retention):
+                self._done_prior.pop(next(iter(self._done_prior)))
+        job.ticket._resolve(res)
+
+    # ---------------------------------------------------------- recovery
+
+    def _recover(self) -> None:
+        """Rebuild the promise ledger from the journal: every accepted
+        request without a done-frame is re-admitted (resuming from its
+        rollout checkpoint when one survived) — the zero-silent-loss
+        half the SIGKILL proof exercises. Already-terminal requests are
+        cached so duplicate submits resolve instantly."""
+        assert self._journal is not None
+        if not self._journal.is_dir():
+            return
+        for done in sorted(self._journal.glob("req_*.done")):
+            payload, man = _read_frame(done)
+            err = payload.get("error")
+            self._done_prior[man["request_id"]] = Result(
+                request_id=man["request_id"], status=man["status"],
+                value=payload.get("value"),
+                error=ServeError(**err) if err else None,
+                latency_s=float(man.get("latency_s", 0.0)),
+                queued_s=float(man.get("queued_s", 0.0)),
+                preemptions=int(man.get("preemptions", 0)),
+                resumed=bool(man.get("resumed", False)))
+        for reqf in sorted(self._journal.glob("req_*.req")):
+            payload, man = _read_frame(reqf)
+            rid = man["request_id"]
+            if rid in self._done_prior:
+                continue
+            req = Request(kind=man["req_kind"], params=payload["params"],
+                          tenant=man["tenant"], request_id=rid,
+                          deadline_s=man.get("deadline_s"),
+                          t_submit=float(man["t_submit"]))
+            try:
+                job = self._make_job(req)
+            except ValueError as e:     # journaled garbage: loud error
+                job = _Job(req=req, ticket=Ticket(rid), bucket=("?",))
+                self._jobs[rid] = job
+                self._finish(job, FAILED,
+                             error=ServeError(E_EXECUTION,
+                                              f"unrecoverable params: {e}"))
+                continue
+            if self._ckpt_dir is not None and ckptlib.latest_checkpoint(
+                    self._ckpt_dir, f"req_{rid}") is not None:
+                job.resumed = True
+                with self._lock:
+                    self.stats["resumed"] += 1
+            self._jobs[rid] = job
+            self._adm.admit(job, force=True)
+            with self._lock:
+                self.stats["accepted"] += 1
+        if self._jobs:
+            self.log.warning(
+                "serve recovery: re-admitted %d unfinished request(s) "
+                "from %s (%d already terminal)", len(self._jobs),
+                self._journal, len(self._done_prior))
+
+    # --------------------------------------------------------- telemetry
+
+    def row_fields(self) -> dict:
+        """Executor + service counters for results-JSON rows (the same
+        shape the suites commit; `benchmarks/check_results.py`)."""
+        out = dict(self._execu.row_fields())
+        out["serve"] = {k: v for k, v in self.stats.items()}
+        return out
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

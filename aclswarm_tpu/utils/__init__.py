@@ -1,12 +1,14 @@
 """Shared utilities: timing/profiling (§5.1), logging (§5.5), and the
 unified retry/timeout/backoff policy (docs/RESILIENCE.md)."""
 from aclswarm_tpu.utils.log import get_logger
-from aclswarm_tpu.utils.retry import (ExecutionFailure, RetryPolicy,
-                                      Watchdog, delay_for, poll_until,
-                                      retry_call, subprocess_probe)
+from aclswarm_tpu.utils.retry import (ExecutionFailure, RetryCancelled,
+                                      RetryPolicy, Watchdog, delay_for,
+                                      poll_until, retry_call,
+                                      subprocess_output, subprocess_probe)
 from aclswarm_tpu.utils.timing import (Stopwatch, median_time,
                                        readback_sync, trace)
 
 __all__ = ["get_logger", "Stopwatch", "median_time", "readback_sync",
-           "trace", "ExecutionFailure", "RetryPolicy", "Watchdog",
-           "delay_for", "poll_until", "retry_call", "subprocess_probe"]
+           "trace", "ExecutionFailure", "RetryCancelled", "RetryPolicy",
+           "Watchdog", "delay_for", "poll_until", "retry_call",
+           "subprocess_output", "subprocess_probe"]

@@ -11,13 +11,17 @@ the single home for that machinery:
   in tests and in resumed runs, so `random` is banned here) and a hard
   wall-clock budget cap;
 - `retry_call`: bounded retry of a callable under a policy, with a
-  `retryable` predicate so non-transient errors surface immediately;
+  `retryable` predicate so non-transient errors surface immediately and
+  a `cancel` event so a caller tearing a stage down (the serve layer's
+  deadline/shutdown paths) stops an in-flight backoff budget instead of
+  sleeping it out;
 - `poll_until`: fixed-interval polling against a grace deadline (the
   transport backpressure shape: the resource drains on its own, backoff
-  would only add latency);
+  would only add latency), also cancellable;
 - `Watchdog`: a one-shot timer with ATOMIC finish-vs-fire semantics
   (the bench.py boundary race: a measurement finishing exactly at the
-  timeout must never let the timer claim the output line);
+  timeout must never let the timer claim the output line), safe to
+  arm/finish/fire from concurrent threads;
 - `subprocess_probe`: liveness probe in a throwaway subprocess with a
   hard timeout (a wedged device tunnel hangs the *calling* process
   inside `jax.devices()` uncancellably — probing must be sacrificial);
@@ -94,19 +98,39 @@ class ExecutionFailure:
                 "fallback": self.fallback}
 
 
+class RetryCancelled(RuntimeError):
+    """A `retry_call` was cancelled before its first attempt could run.
+    Cancellation landing AFTER a failed attempt re-raises that attempt's
+    exception instead — the caller sees the real failure, just without
+    the remaining backoff budget."""
+
+
 def retry_call(fn: Callable, *args,
                policy: RetryPolicy = RetryPolicy(),
                retryable: Callable[[BaseException], bool] = lambda e: True,
                on_retry: Optional[Callable[[int, BaseException], None]]
                = None,
                sleep: Callable[[float], None] = time.sleep,
-               clock: Callable[[], float] = time.monotonic):
+               clock: Callable[[], float] = time.monotonic,
+               cancel: Optional[threading.Event] = None):
     """Call ``fn(*args)``, retrying per ``policy`` while ``retryable(exc)``
     holds and the budget allows. Non-retryable exceptions and the final
     failure propagate unchanged (callers wrap them into
-    `ExecutionFailure` records with their own stage context)."""
+    `ExecutionFailure` records with their own stage context).
+
+    ``cancel`` (optional) propagates an external teardown into the
+    in-flight budget: a set event stops further attempts immediately and
+    interrupts the backoff sleep mid-wait (the event IS the sleeper, so
+    a 5 s backoff ends the moment the canceller fires). Cancellation
+    before the first attempt raises `RetryCancelled`; after a failure it
+    re-raises that failure. It never aborts ``fn`` itself mid-call —
+    attempts are the cancellation boundaries, exactly like the serve
+    layer's chunk boundaries."""
     t0 = clock()
     for attempt in range(policy.attempts):
+        if cancel is not None and cancel.is_set():
+            raise RetryCancelled(
+                f"retry budget cancelled before attempt {attempt}")
         try:
             return fn(*args)
         except BaseException as e:            # noqa: BLE001 — re-raised
@@ -119,24 +143,40 @@ def retry_call(fn: Callable, *args,
                 raise
             if on_retry is not None:
                 on_retry(attempt, e)
-            sleep(d)
+            if cancel is not None:
+                if cancel.wait(d):        # interrupted backoff: surface
+                    raise                 # the real failure, now
+            else:
+                sleep(d)
     raise AssertionError("unreachable")       # pragma: no cover
 
 
 def poll_until(fn: Callable[[], bool], *, grace_s: float,
                poll_s: float = 0.001,
                sleep: Callable[[float], None] = time.sleep,
-               clock: Callable[[], float] = time.monotonic) -> bool:
+               clock: Callable[[], float] = time.monotonic,
+               cancel: Optional[threading.Event] = None) -> bool:
     """Fixed-interval poll of ``fn`` until it returns truthy or the grace
     deadline passes. The backpressure shape (shm ring drain): the first
     call is immediate, and the deadline bounds TOTAL wait — False means
-    the grace expired with ``fn`` still failing."""
+    the grace expired with ``fn`` still failing.
+
+    ``cancel`` (optional) aborts the poll early with False; a set event
+    also cuts the in-flight inter-poll sleep short (event-based wait),
+    so a cancelled poller returns within one poll interval."""
     deadline = clock() + grace_s
-    while not fn():
+    while True:
+        if cancel is not None and cancel.is_set():
+            return False
+        if fn():
+            return True
         if clock() > deadline:
             return False
-        sleep(poll_s)
-    return True
+        if cancel is not None:
+            if cancel.wait(poll_s):
+                return False
+        else:
+            sleep(poll_s)
 
 
 class Watchdog:
@@ -146,21 +186,55 @@ class Watchdog:
     `fire()` at the deadline. Exactly one of them wins: a lock makes the
     check-and-claim atomic, so a completion racing the timer boundary can
     never let both the result and the diagnostic escape (the bench.py
-    one-JSON-line contract)."""
+    one-JSON-line contract).
+
+    Safe for concurrent use (the serve layer arms one per request from
+    client threads while the worker finishes them): `arm` replaces and
+    cancels any pending timer under the lock, a finished/fired watchdog
+    refuses to re-arm, and every `fire`/`finish` combination — including
+    two racing `fire`s from a stale and a fresh timer — resolves to
+    exactly one winner."""
 
     def __init__(self, on_fire: Callable[[], None]):
         self.done = threading.Event()
         self._lock = threading.Lock()
         self._timer: Optional[threading.Timer] = None
+        self._gen = 0           # armed-timer generation (stale-fire guard)
         self._on_fire = on_fire
 
     def arm(self, timeout_s: float) -> None:
-        self._timer = threading.Timer(timeout_s, self.fire)
-        self._timer.daemon = True
-        self._timer.start()
+        """Start (or restart) the countdown. Re-arming cancels the prior
+        timer inside the lock AND bumps a generation counter: a stale
+        timer whose wait already elapsed is past the point where
+        `Timer.cancel` helps, so its callback re-checks the generation
+        under the lock and yields — only the CURRENT deadline can ever
+        claim. Arming after the watchdog already resolved is a no-op,
+        not a resurrection."""
+        with self._lock:
+            if self.done.is_set():
+                return
+            if self._timer is not None:
+                self._timer.cancel()
+            self._gen += 1
+            gen = self._gen
+            t = threading.Timer(timeout_s,
+                                lambda: self._timer_fire(gen))
+            t.daemon = True
+            self._timer = t
+        t.start()
+
+    def _timer_fire(self, gen: int) -> None:
+        """Armed-timer callback: claim only if this timer is still the
+        current generation (a re-arm in the cancel/expiry window
+        otherwise lets the OLD deadline fire)."""
+        with self._lock:
+            if self.done.is_set() or gen != self._gen:
+                return
+            self.done.set()
+        self._on_fire()
 
     def fire(self) -> None:
-        """Timer callback: runs ``on_fire`` unless `finish` already won.
+        """Manual fire: runs ``on_fire`` unless `finish` already won.
         Firing CLAIMS completion (sets ``done`` inside the lock), so a
         `finish` racing in right after returns False — exactly one side
         ever wins, even when ``on_fire`` does not exit the process. The
@@ -174,24 +248,37 @@ class Watchdog:
 
     def finish(self) -> bool:
         """Claim completion; True iff the watchdog had not fired (the
-        caller may emit its result). Cancels a pending timer."""
+        caller may emit its result). Cancels a pending timer; idempotent
+        — repeat calls return False without side effects."""
         with self._lock:
             won = not self.done.is_set()
             self.done.set()
-        if self._timer is not None:
-            self._timer.cancel()
+            timer, self._timer = self._timer, None
+        if timer is not None:
+            timer.cancel()
         return won
+
+
+def subprocess_output(code: str, timeout_s: float,
+                      cwd: Optional[str] = None) -> Optional[str]:
+    """Stdout of ``python -c code`` iff it exits 0 within the budget,
+    else None. Sacrificial by design: a probe of a wedged resource must
+    hang a throwaway process, never the caller. The single home for the
+    throwaway-subprocess mechanics — `subprocess_probe` (boolean form)
+    and `serve.client.probe_backend` (backend-name form) both layer on
+    this."""
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s, cwd=cwd)
+        return r.stdout if r.returncode == 0 else None
+    except (subprocess.TimeoutExpired, OSError):
+        return None
 
 
 def subprocess_probe(code: str, timeout_s: float,
                      marker: str = "ok", cwd: Optional[str] = None) -> bool:
     """True iff ``python -c code`` exits 0 printing ``marker`` within the
-    budget. Sacrificial by design: a probe of a wedged resource must hang
-    a throwaway process, never the caller (bench.py device probe)."""
-    try:
-        r = subprocess.run([sys.executable, "-c", code],
-                           capture_output=True, text=True,
-                           timeout=timeout_s, cwd=cwd)
-        return r.returncode == 0 and marker in r.stdout
-    except (subprocess.TimeoutExpired, OSError):
-        return False
+    budget (the bench.py device-probe shape)."""
+    out = subprocess_output(code, timeout_s, cwd=cwd)
+    return out is not None and marker in out

@@ -1,4 +1,4 @@
-"""Benchmark driver contract: prints ONE JSON line.
+"""Benchmark driver contract: prints ONE JSON line, exits 0.
 
 Headline metric (the north star, BASELINE.md): n=1000 swarm assignment on
 one TPU chip, reported as sustained assignment throughput. The reference's
@@ -25,92 +25,140 @@ Methodology (pinned after round-1 variance, see VERDICT r1 weak #9):
 - Quality is guarded, not assumed: the same kernel config is checked
   against the exact host LAP (`assignment.lapjv`) and the line includes the
   measured suboptimality ratio (target <= 2%).
+
+Execution path (round-6, docs/SERVICE.md): the measurement runs as a
+swarmserve CLIENT — subprocess device probe under the unified
+RetryPolicy, then one deadline-bounded request through `SwarmService`
+with the retry/degrade executor underneath. EVERY outcome is a
+structured row with rc=0: a wedged tunnel (the BENCH_r05 failure mode),
+a non-TPU fallback backend, and a deadline miss all produce a
+``degraded: true`` row carrying the structured reason — the committed
+device measurement in benchmarks/results/scale_tpu.json remains the
+reference. rc != 0 now means the DRIVER is broken, never the device.
 """
 import json
 import os
 import sys
 from pathlib import Path
 
-from aclswarm_tpu.utils.retry import Watchdog, subprocess_probe
+from aclswarm_tpu.utils.retry import Watchdog
 
 BASELINE_HZ = 100.0  # north-star target at n=1000 (BASELINE.md)
 N = 1000
 K = 400
+REPS = 5
+# non-TPU fallback sizing: the full K=400 x 5-rep chain is a multi-
+# minute CPU burn that measures nothing the committed artifact doesn't;
+# the degraded row keeps the same methodology at evidence-smoke scale
+K_DEGRADED = 24
+REPS_DEGRADED = 3
 
 # hard ceiling on the whole run: the remote-TPU tunnel can wedge in a
-# way where even jax.devices() blocks forever (observed once this
-# round); a hung bench burns the driver's whole budget, so a watchdog
-# emits a diagnostic line — keeping the one-JSON-line contract — and
-# hard-exits. Normal runs finish in ~3-4 min incl. first compile.
+# way where even jax.devices() blocks forever (observed round 5); a hung
+# bench burns the driver's whole budget, so a watchdog emits a
+# structured DEGRADED row — keeping the one-JSON-line, rc=0 contract —
+# and hard-exits. Normal runs finish in ~3-4 min incl. first compile.
 WATCHDOG_S = 900.0
 # a wedged tunnel blocks jax.devices() itself, so before arming the main
 # measurement the backend is probed in a THROWAWAY subprocess with a
-# short budget: a wedge costs PROBE_TIMEOUT_S, not the full 900 s
+# short budget: a wedge costs ~2 probe attempts, not the full 900 s.
+# (_PROBE_CODE stays a module attribute — tests monkeypatch it.)
 PROBE_TIMEOUT_S = 120.0
-_PROBE_CODE = "import jax; jax.devices(); print('ok')"
+from aclswarm_tpu.serve.client import PROBE_CODE as _PROBE_CODE  # noqa: E402
 
 
-def _error_line(msg: str) -> None:
-    print(json.dumps({
+def _degraded_line(msg: str, serve_fields: dict | None = None) -> None:
+    row = {
         "metric": f"sinkhorn_assign_n{N}_hz",
         "value": 0.0,
         "unit": "Hz",
         "vs_baseline": 0.0,
+        "degraded": True,
         "error": msg,
-    }), flush=True)
+    }
+    if serve_fields:
+        row.update(serve_fields)
+    print(json.dumps(row), flush=True)
 
 
 def _on_watchdog_fire() -> None:
-    _error_line(f"bench did not complete within {WATCHDOG_S:.0f} s — "
-                "device backend unreachable (tunnel wedge?); see "
-                "benchmarks/results/scale_tpu.json for the committed "
-                "measurement")
-    os._exit(2)
+    _degraded_line(
+        f"bench did not complete within {WATCHDOG_S:.0f} s — device "
+        "backend unreachable or wedged mid-measurement; see "
+        "benchmarks/results/scale_tpu.json for the committed "
+        "measurement")
+    os._exit(0)          # structured degraded row delivered: rc=0
 
 
 # the finish-vs-fire boundary race (a measurement completing exactly at
 # the timeout must never allow a second output line) lives in the
-# unified retry layer now: `utils.retry.Watchdog` makes the claim atomic
+# unified retry layer: `utils.retry.Watchdog` makes the claim atomic
 _wd = Watchdog(on_fire=_on_watchdog_fire)
 _done = _wd.done          # tests poke these exact names
 _watchdog = _wd.fire
 
 
-def _probe_device(timeout_s: float | None = None) -> bool:
-    """True iff a subprocess can enumerate jax devices within the budget.
-    Run as a separate process because a wedged device tunnel hangs the
+def _probe_device(timeout_s: float | None = None) -> str | None:
+    """Backend name iff a subprocess can initialize jax within the
+    budget (2 attempts under the unified RetryPolicy), else None. Run
+    as a separate process because a wedged device tunnel hangs the
     *calling* process inside jax.devices() uncancellably
-    (`utils.retry.subprocess_probe` — the probe is sacrificial)."""
-    return subprocess_probe(
-        _PROBE_CODE,
+    (`serve.client.probe_backend` — the probe is sacrificial)."""
+    from aclswarm_tpu.serve.client import probe_backend
+    return probe_backend(
         PROBE_TIMEOUT_S if timeout_s is None else timeout_s,
-        cwd=str(Path(__file__).resolve().parent))
+        code=_PROBE_CODE, cwd=str(Path(__file__).resolve().parent))
 
 
 def main():
-    if not _probe_device():
-        _error_line(f"device backend probe did not answer within "
-                    f"{PROBE_TIMEOUT_S:.0f} s (tunnel wedge?) — skipping "
-                    "the measurement instead of burning the "
-                    f"{WATCHDOG_S:.0f} s budget; see "
-                    "benchmarks/results/scale_tpu.json for the committed "
-                    "measurement")
-        return 2
+    backend = _probe_device()
+    if backend is None:
+        _degraded_line(
+            f"device backend probe did not answer within 2 x "
+            f"{PROBE_TIMEOUT_S:.0f} s (tunnel wedge?) — skipping the "
+            f"measurement instead of burning the {WATCHDOG_S:.0f} s "
+            "budget; see benchmarks/results/scale_tpu.json for the "
+            "committed measurement")
+        return 0
     _wd.arm(WATCHDOG_S)
-    # single source of truth for the measurement lives in benchmarks/scale.py
+    # single source of truth for the measurement lives in
+    # benchmarks/scale.py; the serving layer owns retry/degrade/deadline
     sys.path.insert(0, str(Path(__file__).resolve().parent / "benchmarks"))
     from scale import sinkhorn_throughput
 
-    sk = sinkhorn_throughput(N, K, reps=5)
-    _wd.finish()            # measurement done: from here the watchdog
-    #                         can no longer claim the output line
-    print(json.dumps({
+    from aclswarm_tpu.serve import ServiceConfig, SwarmService
+
+    on_device = backend == "tpu"
+    k, reps = (K, REPS) if on_device else (K_DEGRADED, REPS_DEGRADED)
+
+    svc = SwarmService(ServiceConfig())
+    svc.register(
+        "bench_sinkhorn",
+        lambda p: sinkhorn_throughput(p["n"], p["K"], reps=p["reps"]))
+    ticket = svc.submit("bench_sinkhorn", {"n": N, "K": k, "reps": reps},
+                        tenant="bench", deadline_s=WATCHDOG_S - 120.0)
+    res = ticket.result(timeout=WATCHDOG_S)
+    # claim the output line the instant the measurement lands (ADVICE
+    # r5: a timer firing between completion and post-processing must
+    # not discard a finished measurement) — post-processing follows
+    if not _wd.finish():     # watchdog already claimed the output line
+        return 0             # pragma: no cover — fire() hard-exits
+    svc.close()
+    serve_fields = svc.row_fields()
+    if not res.ok:
+        _degraded_line(
+            f"measurement request terminated {res.status}: "
+            f"{res.error.code}: {res.error.message}",
+            serve_fields)
+        return 0
+    sk = res.value
+    row = {
         "metric": f"sinkhorn_assign_n{N}_hz",
         "value": round(sk["hz"], 1),
         "unit": "Hz",
         "vs_baseline": round(sk["hz"] / BASELINE_HZ, 2),
         "subopt_vs_lap": round(sk["subopt"], 4),
-        # min/max Hz over the 5 timing reps (round-2 next-step #9: spread
+        # min/max Hz over the timing reps (round-2 next-step #9: spread
         # makes regressions visible beyond the single median)
         "hz_spread": sk["hz_spread"],
         # roofline position (round-3 weak #6): achieved FLOP/s + HBM GB/s
@@ -124,7 +172,23 @@ def main():
         # per-dispatch floor vs on-device time (round-4 review Weak #4)
         "latency_ms": round(sk["latency_ms"], 2),
         "latency_decomposition": sk["latency_decomposition"],
-    }))
+        # serving-layer provenance: the request's measured latency plus
+        # any retry/degrade markers the executor recorded
+        "serve": dict(serve_fields.get("serve", {}),
+                      request_latency_s=round(res.latency_s, 2)),
+    }
+    if not on_device:
+        # a fallback backend is a DEGRADED capture by definition: same
+        # methodology, wrong silicon — never comparable to the baseline
+        row["degraded"] = True
+        row["degraded_reason"] = (
+            f"backend={backend!r} (not the bench TPU); K={k}, "
+            f"reps={reps} evidence-smoke sizing — the committed device "
+            "measurement is benchmarks/results/scale_tpu.json")
+    for key in ("retries", "degraded", "execution_failures"):
+        if key in serve_fields:
+            row.setdefault(key, serve_fields[key])
+    print(json.dumps(row), flush=True)
     return 0
 
 

@@ -38,6 +38,96 @@ RESULTS = Path(__file__).resolve().parent / "results"
 # everything the faults subsystem and later suites commit goes here)
 STRICT_ROWS = ("fault_recovery.json", "resilience_overhead.json")
 
+# the serve-soak artifact (benchmarks/serve_soak.py; docs/SERVICE.md) is
+# summary-shaped but schema-FIXED: the exact key set below, counted
+# promises that must reconcile, and finite latency percentiles. A soak
+# row that drifts (a renamed counter, a NaN percentile, counts that no
+# longer add up to "every accepted request terminated") is rejected —
+# it is the zero-silent-loss evidence, so drift here is evidence rot.
+SERVE_SOAK = "serve_soak.json"
+_SOAK_COUNTS = ("accepted", "completed", "rejected", "preempted",
+                "timed_out", "failed", "silent_losses", "resumed",
+                "sigkills", "tenants")
+_SOAK_KEYS = set(_SOAK_COUNTS) | {"name", "n", "backend",
+                                  "resume_bit_identical", "latency_s",
+                                  "wall_s", "quick"}
+_SOAK_PCTS = ("p50", "p95", "p99")
+
+
+def _is_count(v) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool) and v >= 0
+
+
+def check_serve_soak(obj, where: str) -> list[str]:
+    """Validate the serve_soak summary object (exact key set, counts,
+    percentile keys, NaN/Inf rejection, promise reconciliation)."""
+    if not isinstance(obj, dict):
+        return [f"{where}: not a JSON object"]
+    probs = []
+    missing, unknown = _SOAK_KEYS - set(obj), set(obj) - _SOAK_KEYS
+    if missing:
+        probs.append(f"{where}: missing keys {sorted(missing)}")
+    if unknown:
+        probs.append(f"{where}: unknown keys {sorted(unknown)} "
+                     "(exact-key-set schema)")
+    if obj.get("name") != "serve_soak":
+        probs.append(f"{where}: 'name' must be 'serve_soak'")
+    for k in _SOAK_COUNTS:
+        if k in obj and not _is_count(obj[k]):
+            probs.append(f"{where}: '{k}' must be a non-negative int, "
+                         f"got {obj[k]!r}")
+    if all(_is_count(obj.get(k)) for k in
+           ("accepted", "completed", "timed_out", "failed",
+            "silent_losses")):
+        total = (obj["completed"] + obj["timed_out"] + obj["failed"]
+                 + obj["silent_losses"])
+        if total != obj["accepted"]:
+            probs.append(
+                f"{where}: accepted ({obj['accepted']}) != completed + "
+                f"timed_out + failed + silent_losses ({total}) — the "
+                "terminal ledger must reconcile")
+    for k in ("resume_bit_identical", "quick"):
+        if k in obj and not isinstance(obj[k], bool):
+            probs.append(f"{where}: '{k}' must be a bool")
+    lat = obj.get("latency_s")
+    if lat is not None:
+        if not isinstance(lat, dict):
+            probs.append(f"{where}: 'latency_s' must be an object")
+        else:
+            miss = set(_SOAK_PCTS) - set(lat)
+            unk = set(lat) - set(_SOAK_PCTS)
+            if miss:
+                probs.append(f"{where}: latency_s missing {sorted(miss)}")
+            if unk:
+                probs.append(f"{where}: latency_s unknown keys "
+                             f"{sorted(unk)}")
+            vals = [lat[k] for k in _SOAK_PCTS if k in lat]
+            for k in _SOAK_PCTS:
+                v = lat.get(k)
+                if v is None:
+                    continue
+                if isinstance(v, bool) \
+                        or not isinstance(v, (int, float)) \
+                        or not math.isfinite(v) or v < 0:
+                    probs.append(f"{where}: latency_s.{k} must be a "
+                                 f"finite non-negative number, got {v!r}")
+            if len(vals) == 3 and all(
+                    isinstance(v, (int, float)) and not isinstance(v, bool)
+                    and math.isfinite(v) for v in vals) \
+                    and not (vals[0] <= vals[1] <= vals[2]):
+                probs.append(f"{where}: percentiles must be "
+                             f"non-decreasing (p50 <= p95 <= p99), got "
+                             f"{vals}")
+    if "wall_s" in obj:
+        w = obj["wall_s"]
+        if isinstance(w, bool) or not isinstance(w, (int, float)) \
+                or not math.isfinite(w) or w < 0:
+            probs.append(f"{where}: 'wall_s' must be a finite "
+                         f"non-negative number, got {w!r}")
+    if "n" in obj and not (_is_count(obj["n"]) and obj["n"] > 0):
+        probs.append(f"{where}: 'n' must be a positive int")
+    return probs
+
 # resilience metadata (docs/RESILIENCE.md): optional on any row, but
 # when present the values must be well-formed — a malformed degraded
 # marker is worse than none (it reads as "not degraded")
@@ -140,6 +230,10 @@ def check_file(path: Path) -> list[str]:
         whole = json.loads(text)
     except json.JSONDecodeError:
         whole = None
+    if path.name == SERVE_SOAK:
+        if whole is None:
+            return [f"{path.name}: unparseable serve-soak artifact"]
+        return check_serve_soak(whole, path.name)
     if isinstance(whole, dict) and (
             len(lines) > 1
             or ("name" not in whole and "metric" not in whole)):

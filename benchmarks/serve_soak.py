@@ -1,0 +1,304 @@
+"""Multi-client chaos soak for swarmserve — the serving-axis flagship
+benchmark (docs/SERVICE.md; docs/SCALING.md names independent problem
+instances as "the axis that maps to serving traffic").
+
+Three concurrent tenants submit a mixed stream of shape-heterogeneous
+rollout / assignment / gain-design requests — several carrying their own
+`FaultSchedule` scripts, one with an already-expired deadline, one
+tenant deliberately flooding past its admission cap — while a scripted
+`CrashPlan` SIGKILLs the service worker process MID-BATCH. A second
+service process recovers the journal and drains. The parent then audits
+the promise ledger:
+
+- **zero silent losses**: every accepted request has a terminal
+  done-frame (result or structured error);
+- **bit-identical resume**: every completed rollout's digest matches an
+  uninterrupted reference service run;
+- **latency SLO evidence**: p50/p95/p99 over accepted->terminal wall
+  latency, committed to `benchmarks/results/serve_soak.json`
+  (schema-guarded by `benchmarks/check_results.py`).
+
+Run:
+
+    JAX_PLATFORMS=cpu python benchmarks/serve_soak.py [--quick] \
+        [--out benchmarks/results/serve_soak.json]
+
+Exit 1 on any broken promise (a loss, a non-terminal request, a resume
+digest mismatch) — the artifact is only committed from a green run.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+RESULTS = Path(__file__).resolve().parent / "results"
+KILL_ROUND = 6          # mid-batch: several chunks in, none finished all
+
+TENANTS = ("alpha", "beta", "gamma")
+
+
+def request_mix(quick: bool) -> list[dict]:
+    """The soak's request stream: shape-heterogeneous (n=5 and n=8
+    buckets), fault-scripted, deadline-edged. Deterministic — phase B
+    recovery and the parent's reference runs must agree on it."""
+    ticks = 60 if quick else 120
+    mix = [
+        # tenant alpha: plain + faulted n=5 rollouts
+        {"kind": "rollout", "tenant": "alpha", "request_id": "a-roll0",
+         "params": {"n": 5, "ticks": ticks, "chunk_ticks": 20,
+                    "seed": 10}},
+        {"kind": "rollout", "tenant": "alpha", "request_id": "a-roll1",
+         "params": {"n": 5, "ticks": ticks, "chunk_ticks": 20, "seed": 11,
+                    "faults": {"dropout_frac": 0.4, "drop_tick": 15,
+                               "rejoin_tick": 55}}},
+        # tenant beta: the second shape bucket (n=8) + lossy links
+        {"kind": "rollout", "tenant": "beta", "request_id": "b-roll0",
+         "params": {"n": 8, "ticks": ticks, "chunk_ticks": 20, "seed": 20,
+                    "faults": {"link_loss": 0.2}}},
+        {"kind": "rollout", "tenant": "beta", "request_id": "b-roll1",
+         "params": {"n": 8, "ticks": ticks, "chunk_ticks": 20,
+                    "seed": 21}},
+        # tenant gamma: single-shot kinds + the dead-on-arrival deadline
+        {"kind": "assign", "tenant": "gamma", "request_id": "g-assign",
+         "params": {"n": 16, "seed": 30}},
+        {"kind": "gains", "tenant": "gamma", "request_id": "g-gains",
+         "params": {"n": 5, "seed": 31}},
+        {"kind": "rollout", "tenant": "gamma", "request_id": "g-late",
+         "deadline_s": 0.0,
+         "params": {"n": 5, "ticks": ticks, "chunk_ticks": 20,
+                    "seed": 32}},
+    ]
+    if not quick:
+        mix += [
+            {"kind": "rollout", "tenant": "alpha",
+             "request_id": "a-roll2",
+             "params": {"n": 8, "ticks": ticks, "chunk_ticks": 20,
+                        "seed": 12, "faults": {"dropout_frac": 0.25,
+                                               "drop_tick": 40}}},
+            {"kind": "assign", "tenant": "beta", "request_id": "b-assign",
+             "params": {"n": 16, "seed": 22, "solver": "lap"}},
+        ]
+    return mix
+
+
+def flood_burst(quick: bool) -> list[dict]:
+    """Tenant alpha's oversubscription burst: more queued work than its
+    admission cap allows — the rejected remainder is the backpressure
+    evidence (client-side, never journaled)."""
+    n_flood = 4 if quick else 8
+    return [
+        {"kind": "rollout", "tenant": "alpha",
+         "request_id": f"a-flood{i}",
+         "params": {"n": 5, "ticks": 40, "chunk_ticks": 20,
+                    "seed": 100 + i}}
+        for i in range(n_flood)
+    ]
+
+
+def _service(journal: str):
+    from aclswarm_tpu.serve import ServiceConfig, SwarmService
+
+    # tight caps + 1-chunk quantum + 2 batch slots: preemption and
+    # rejection both OCCUR (a soak that never exercises its guarantees
+    # proves nothing)
+    return SwarmService(ServiceConfig(
+        max_batch=2, quantum_chunks=1, max_queue_per_tenant=4,
+        max_queue_total=16, journal_dir=journal))
+
+
+def child(journal: str, quick: bool) -> int:
+    """One service lifetime: submit the mix (+ flood), report the
+    client-side view, wait for every ticket. Run 1 is SIGKILLed by the
+    env-armed CrashPlan mid-wait; run 2 recovers the same journal,
+    resubmits idempotently (duplicate ids attach, terminal ids resolve
+    from the journal) and drains to idle."""
+    from aclswarm_tpu.serve import RejectedError
+
+    svc = _service(journal)
+    tickets, rejected = [], []
+    for spec in request_mix(quick) + flood_burst(quick):
+        try:
+            tickets.append(svc.submit(
+                spec["kind"], spec["params"], tenant=spec["tenant"],
+                request_id=spec["request_id"],
+                deadline_s=spec.get("deadline_s")))
+        except RejectedError as e:
+            rejected.append({"request_id": spec["request_id"],
+                             "retry_after_s": round(e.retry_after_s, 3)})
+    print("CLIENT " + json.dumps({
+        "submitted": len(tickets), "rejected": rejected}), flush=True)
+    for t in tickets:
+        t.result(timeout=600)
+    svc.close()
+    print("CHILD_DONE", flush=True)
+    return 0
+
+
+def _reference_digests(specs: list[dict]) -> dict[str, int]:
+    """Uninterrupted solo-service run of every rollout spec — the
+    bit-parity oracle for the crashed+preempted+resumed soak results."""
+    from aclswarm_tpu.serve import ServiceConfig, SwarmService
+
+    ref = SwarmService(ServiceConfig(max_batch=4))
+    # submit everything first: same-bucket specs share device batches
+    # (digests are batch-invariant by the engine's row-independence
+    # guarantee), so the oracle costs ~one residency per bucket, not
+    # one per spec
+    tickets = [(s["request_id"],
+                ref.submit(s["kind"], s["params"], tenant=s["tenant"]))
+               for s in specs]
+    out = {}
+    for rid, t in tickets:
+        res = t.result(600)
+        assert res.ok, f"reference run failed for {rid}"
+        out[rid] = int(res.value["digest"])
+    ref.close()
+    return out
+
+
+def run_soak(out: str | None, quick: bool) -> int:
+    from aclswarm_tpu.resilience.crash import ENV_VAR
+    from aclswarm_tpu.serve.service import _read_frame
+
+    t_start = time.time()
+    problems: list[str] = []
+    with tempfile.TemporaryDirectory(prefix="aclswarm_soak_") as d:
+        # phase A: clients + worker, SIGKILL mid-batch
+        env = dict(os.environ, **{ENV_VAR: f"serve:{KILL_ROUND}:kill"})
+        argv = [sys.executable, __file__, "--child", "--dir", d]
+        if quick:
+            argv.append("--quick")
+        rA = subprocess.run(argv, env=env, capture_output=True, text=True,
+                            timeout=900)
+        if rA.returncode != -signal.SIGKILL:
+            print(f"FAIL: phase-A child exited {rA.returncode}, expected "
+                  f"SIGKILL\n{rA.stdout}\n{rA.stderr}")
+            return 1
+        client = json.loads(next(
+            ln for ln in rA.stdout.splitlines()
+            if ln.startswith("CLIENT ")).split(" ", 1)[1])
+        print(f"phase A: SIGKILL at serve round {KILL_ROUND}; "
+              f"{client['submitted']} accepted, "
+              f"{len(client['rejected'])} rejected with retry-after")
+
+        # phase B: recovery on the same journal, drain to idle
+        envB = dict(os.environ)
+        envB.pop(ENV_VAR, None)
+        rB = subprocess.run(argv, env=envB, capture_output=True,
+                            text=True, timeout=900)
+        if rB.returncode != 0 or "CHILD_DONE" not in rB.stdout:
+            print(f"FAIL: phase-B child exited {rB.returncode}\n"
+                  f"{rB.stdout}\n{rB.stderr}")
+            return 1
+        print("phase B: journal recovered, drained to all-tenants-idle")
+
+        # audit the promise ledger
+        ledger: dict[str, dict] = {}
+        values: dict[str, dict] = {}
+        for reqf in Path(d).glob("req_*.req"):
+            rid = reqf.name[len("req_"):-len(".req")]
+            donef = reqf.with_suffix(".done")
+            if not donef.exists():
+                problems.append(f"SILENT LOSS: {rid} accepted, never "
+                                "terminal")
+                continue
+            payload, man = _read_frame(donef)
+            ledger[rid] = man
+            values[rid] = payload
+        accepted = len(list(Path(d).glob("req_*.req")))
+        statuses = {k: v["status"] for k, v in ledger.items()}
+        completed = sum(1 for s in statuses.values() if s == "completed")
+        timed_out = sum(1 for s in statuses.values() if s == "timed_out")
+        failed = sum(1 for s in statuses.values() if s == "failed")
+        silent = accepted - (completed + timed_out + failed)
+        preempted = sum(int(v.get("preemptions", 0))
+                        for v in ledger.values())
+        resumed = sum(1 for v in ledger.values() if v.get("resumed"))
+        lat = sorted(float(v["latency_s"]) for v in ledger.values())
+        if statuses.get("g-late") != "timed_out":
+            problems.append("deadline case g-late did not time out "
+                            f"(got {statuses.get('g-late')})")
+
+        # bit-parity oracle: every completed rollout vs a fresh solo run
+        roll_specs = [s for s in request_mix(quick)
+                      if s["kind"] == "rollout"
+                      and statuses.get(s["request_id"]) == "completed"]
+        ref = _reference_digests(roll_specs)
+        mismatches = [
+            rid for rid, dig in ref.items()
+            if int(values[rid]["value"]["digest"]) != dig]
+        for rid in mismatches:
+            problems.append(f"resume digest mismatch for {rid}")
+        bit_identical = not mismatches and bool(ref)
+
+    row = {
+        "name": "serve_soak",
+        "n": 8,                      # largest rollout shape in the mix
+        "backend": _backend(),
+        "tenants": len(TENANTS),
+        "accepted": accepted,
+        "completed": completed,
+        "rejected": len(client["rejected"]),
+        "preempted": preempted,
+        "timed_out": timed_out,
+        "failed": failed,
+        "silent_losses": silent,
+        "resumed": resumed,
+        "sigkills": 1,
+        "resume_bit_identical": bit_identical,
+        "latency_s": {
+            "p50": round(float(np.percentile(lat, 50)), 3),
+            "p95": round(float(np.percentile(lat, 95)), 3),
+            "p99": round(float(np.percentile(lat, 99)), 3),
+        },
+        "wall_s": round(time.time() - t_start, 1),
+        "quick": bool(quick),
+    }
+    print(json.dumps(row, indent=1))
+    if problems:
+        print(f"SOAK FAILED ({len(problems)} broken promise(s)):")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    if out:
+        p = Path(out)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(row, indent=1) + "\n")
+        print(f"wrote {p}")
+    return 0
+
+
+def _backend() -> str:
+    import jax
+    return jax.default_backend()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--child", action="store_true",
+                    help="(internal) one service lifetime")
+    ap.add_argument("--dir", default=None,
+                    help="(internal) journal directory")
+    ap.add_argument("--quick", action="store_true",
+                    help="smaller mix (CI smoke; artifact not committed)")
+    ap.add_argument("--out", default=str(RESULTS / "serve_soak.json"),
+                    help="artifact path ('' to skip writing)")
+    args = ap.parse_args(argv)
+    if args.child:
+        return child(args.dir, args.quick)
+    return run_soak(args.out or None, args.quick)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

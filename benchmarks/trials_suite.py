@@ -13,10 +13,18 @@ as artifacts:
 
 Run (on the bench TPU; CPU works but slower):
 
-    python benchmarks/trials_suite.py [--quick] [--only CONFIG]
+    python benchmarks/trials_suite.py [--quick] [--only CONFIG] [--serve]
 
 All configs run `dynamics=doubleint` (the honest second-order model,
 golden-pinned in tests/test_dynamics_golden.py).
+
+``--serve`` routes every grid cell through the swarmserve layer
+(docs/SERVICE.md) as a service CLIENT: each cell is one journaled-style
+request with the unified retry/degrade executor underneath, a failing
+cell terminates with a structured error instead of an exception, and
+the committed summary carries the service's execution provenance
+(retries / degraded markers / request counts) — serving as the flagship
+benchmark axis, per ROADMAP open item 2.
 """
 from __future__ import annotations
 
@@ -285,12 +293,54 @@ def main(argv=None):
                     "trials_summary.json and resume the interrupted one "
                     "from its checkpoints (needs --checkpoint-dir for "
                     "mid-rollout resume)")
+    ap.add_argument("--serve", action="store_true",
+                    help="run every grid cell as a swarmserve client "
+                    "request (docs/SERVICE.md): structured per-cell "
+                    "errors + execution provenance in the summary")
     args = ap.parse_args(argv)
 
     import jax
     from aclswarm_tpu.resilience import InjectedCrash
     from aclswarm_tpu.utils.retry import ExecutionFailure
     RESULTS.mkdir(exist_ok=True)
+
+    svc = None
+    if args.serve:
+        from aclswarm_tpu.serve import (ServiceConfig, SwarmService,
+                                        submit_and_wait)
+        svc = SwarmService(ServiceConfig(max_queue_per_tenant=64,
+                                         max_queue_total=64))
+        svc.register(
+            "trials_config",
+            lambda p: run_config(p["name"], p["overrides"], p["m"],
+                                 p["seed"], batch=p["batch"],
+                                 checkpoint_dir=p["checkpoint_dir"],
+                                 resume=p["resume"]))
+
+    def _cell_stats(name, overrides, n_trials):
+        """One grid cell: direct call, or a serve-client request whose
+        structured failure is re-raised into the existing recorded-
+        cell-failure path."""
+        if svc is None:
+            return run_config(name, overrides, n_trials, args.seed,
+                              batch=args.batch,
+                              checkpoint_dir=args.checkpoint_dir,
+                              resume=args.resume)
+        # submit_and_wait owns the liveness-aware wait: a DEAD worker
+        # (scripted crash drill, unexpected bug) comes back as a
+        # structured `worker_died` result instead of hanging the suite
+        res = submit_and_wait(
+            svc, "trials_config",
+            {"name": name, "overrides": overrides, "m": n_trials,
+             "seed": args.seed, "batch": args.batch,
+             "checkpoint_dir": args.checkpoint_dir,
+             "resume": args.resume},
+            tenant="suite", request_id=f"cell-{name}")
+        if not res.ok:
+            raise RuntimeError(f"serve cell {res.status}: "
+                               f"{res.error.code}: {res.error.message}")
+        return res.value
+
     summary = {
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),
@@ -329,10 +379,7 @@ def main(argv=None):
         print(f"=== {name} (m={n_trials}) ===", flush=True)
         t0 = time.time()
         try:
-            stats = run_config(name, overrides, n_trials, args.seed,
-                               batch=args.batch,
-                               checkpoint_dir=args.checkpoint_dir,
-                               resume=args.resume)
+            stats = _cell_stats(name, overrides, n_trials)
         except InjectedCrash:
             raise          # scripted preemption: die as scripted
         except Exception as e:      # noqa: BLE001 — recorded, not hidden
@@ -358,6 +405,11 @@ def main(argv=None):
                           if k != "config"}), flush=True)
 
     summary["configs"] = {**prior, **summary["configs"]}
+    if svc is not None:
+        svc.close()
+        # serving provenance: request counts + any retry/degraded
+        # markers the executor recorded while running the grid
+        summary["serve"] = svc.row_fields()
     path.write_text(json.dumps(summary, indent=1))
     print(f"wrote {path}")
     bad = [k for k, v in summary["configs"].items()

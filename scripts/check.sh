@@ -14,9 +14,12 @@
 #   python benchmarks/check_results.py            committed artifacts
 #   JAX_PLATFORMS=cpu python -m aclswarm_tpu.resilience.smoke
 #                                         crash-resume smoke (SIGKILL)
+#   JAX_PLATFORMS=cpu python -m aclswarm_tpu.serve.smoke
+#                               serve smoke: SIGKILL the serving worker
+#                               mid-batch, recover, zero losses
 #   pytest tests/test_analysis.py tests/test_invariants.py \
-#          tests/test_results_schema.py tests/test_resilience.py
-#                                                   guard self-tests
+#          tests/test_results_schema.py tests/test_resilience.py \
+#          tests/test_serve.py                      guard self-tests
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -32,6 +35,11 @@ python benchmarks/check_results.py
 echo "== crash-resume smoke: SIGKILL at chunk 1 of an n=5 rollout, =="
 echo "== resume from checkpoint, assert bit-parity (docs/RESILIENCE.md) =="
 JAX_PLATFORMS=cpu python -m aclswarm_tpu.resilience.smoke
+
+echo "== serve smoke: start the service, submit 3 mixed requests, =="
+echo "== SIGKILL the worker mid-batch, recover the journal — zero =="
+echo "== losses + bit-identical resume (docs/SERVICE.md) =="
+JAX_PLATFORMS=cpu python -m aclswarm_tpu.serve.smoke
 
 # tier-1 duration guard: the verify command (ROADMAP.md) runs under a
 # hard 870 s timeout and tees its log to /tmp/_t1.log; fail loudly once
@@ -62,8 +70,9 @@ else
     echo "no tier-1 log at $T1_LOG — skipping (run tier-1 first)"
 fi
 
-echo "== guard self-tests (lint fixtures, audit grid, invariant contracts, resilience) =="
+echo "== guard self-tests (lint fixtures, audit grid, invariant contracts, resilience, serve) =="
 exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_analysis.py tests/test_invariants.py \
     tests/test_results_schema.py tests/test_resilience.py \
+    tests/test_serve.py \
     -q -m 'not slow' -p no:cacheprovider

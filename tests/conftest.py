@@ -59,6 +59,13 @@ def pytest_configure(config):
         "(aclswarm_tpu.resilience; docs/RESILIENCE.md)")
     config.addinivalue_line(
         "markers",
+        "serve: swarmserve always-on serving layer — admission control "
+        "and backpressure, per-tenant fair batching, deadline "
+        "enforcement, checkpoint-backed preemption, journal recovery "
+        "(aclswarm_tpu.serve; docs/SERVICE.md). Soak-sized runs "
+        "additionally carry `slow` to respect the tier-1 duration guard")
+    config.addinivalue_line(
+        "markers",
         "invariants: swarmcheck runtime sanitizer — compiled-in "
         "invariant contracts (aclswarm_tpu.analysis.invariants; "
         "docs/STATIC_ANALYSIS.md runtime tier): clean-system positives, "

@@ -1,7 +1,11 @@
-"""bench.py watchdog: a wedged device tunnel must yield ONE diagnostic
-JSON line and exit 2 (never a silent hang that burns the driver's
-budget), and a measurement finishing at the timer boundary must not
-race a second line in."""
+"""bench.py driver contract: ONE structured JSON line, rc=0.
+
+Round 5's wedge (BENCH_r05: 0.0 Hz, rc=2) is the regression under
+guard: a wedged device tunnel, a hung measurement, and a fallback
+backend must each yield a single STRUCTURED row — ``degraded: true``
+plus the reason — with exit 0, so the driver's budget is never burned
+and the capture is evidence instead of a dead run. A non-zero rc now
+means the driver itself is broken, never the device."""
 import json
 import subprocess
 import sys
@@ -10,7 +14,12 @@ from pathlib import Path
 REPO = str(Path(__file__).resolve().parents[1])
 
 
-def test_wedge_emits_single_diagnostic_line():
+def _json_lines(stdout: str) -> list[dict]:
+    return [json.loads(ln) for ln in stdout.splitlines()
+            if ln.startswith("{")]
+
+
+def test_wedge_emits_single_degraded_line_rc0():
     code = (
         "import bench, threading, time\n"
         "bench.WATCHDOG_S = 0.5\n"
@@ -20,18 +29,18 @@ def test_wedge_emits_single_diagnostic_line():
     )
     r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
                        capture_output=True, text=True, timeout=30)
-    assert r.returncode == 2
-    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert r.returncode == 0
+    lines = _json_lines(r.stdout)
     assert len(lines) == 1
-    d = json.loads(lines[0])
+    d = lines[0]
     assert d["metric"] == "sinkhorn_assign_n1000_hz"
-    assert "error" in d and d["value"] == 0.0
+    assert d["degraded"] is True and "error" in d and d["value"] == 0.0
 
 
-def test_probe_timeout_emits_error_line_fast():
+def test_probe_timeout_emits_degraded_line_fast_rc0():
     """A wedged tunnel (simulated: a probe that sleeps forever) must
-    yield the structured error line via the cheap PRE-measurement probe
-    — exit 2 within the probe budget, not after 900 s."""
+    yield the structured degraded line via the cheap PRE-measurement
+    probe — rc=0 within the probe budget, not after 900 s."""
     code = (
         "import bench, sys\n"
         "bench.PROBE_TIMEOUT_S = 0.5\n"
@@ -40,24 +49,27 @@ def test_probe_timeout_emits_error_line_fast():
     )
     r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
                        capture_output=True, text=True, timeout=60)
-    assert r.returncode == 2
-    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert r.returncode == 0
+    lines = _json_lines(r.stdout)
     assert len(lines) == 1
-    d = json.loads(lines[0])
+    d = lines[0]
     assert d["metric"] == "sinkhorn_assign_n1000_hz"
+    assert d["degraded"] is True
     assert "probe" in d["error"] and d["value"] == 0.0
 
 
-def test_probe_accepts_healthy_backend():
-    """The probe itself passes on a working (CPU) backend."""
+def test_probe_reports_backend_name():
+    """The probe returns the backend NAME (the degraded-marking input)
+    on a working backend."""
     code = (
         "import bench\n"
-        "bench._PROBE_CODE = \"print('ok')\"\n"
-        "print('PROBE', bench._probe_device(timeout_s=30))\n"
+        "print('PROBE', bench._probe_device(timeout_s=60))\n"
     )
     r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
-                       capture_output=True, text=True, timeout=60)
-    assert "PROBE True" in r.stdout
+                       capture_output=True, text=True, timeout=120)
+    probe = [ln for ln in r.stdout.splitlines()
+             if ln.startswith("PROBE ")]
+    assert probe and probe[0].split()[1] in ("cpu", "tpu", "gpu")
 
 
 def test_boundary_finish_suppresses_watchdog():
@@ -73,5 +85,5 @@ def test_boundary_finish_suppresses_watchdog():
     r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
                        capture_output=True, text=True, timeout=30)
     assert r.returncode == 0
-    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
-    assert len(lines) == 1 and json.loads(lines[0])["ok"]
+    lines = _json_lines(r.stdout)
+    assert len(lines) == 1 and lines[0]["ok"]

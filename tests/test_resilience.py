@@ -253,6 +253,88 @@ class TestRetry:
         wd3 = retrylib.Watchdog(on_fire=lambda: wd3.finish())
         wd3.fire()
 
+    def test_retry_call_cancel_before_first_attempt(self):
+        import threading
+        ev = threading.Event()
+        ev.set()
+        calls = []
+        with pytest.raises(retrylib.RetryCancelled):
+            retrylib.retry_call(lambda: calls.append(1), cancel=ev)
+        assert not calls                # never even tried
+
+    def test_retry_call_cancel_interrupts_backoff_budget(self):
+        """Cancellation lands DURING the backoff sleep: the in-flight
+        budget ends immediately (event wait, not time.sleep) and the
+        real failure surfaces — no further attempts (the serve
+        deadline/shutdown teardown path)."""
+        import threading
+        import time as _time
+        ev = threading.Event()
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            raise RuntimeError("UNAVAILABLE")
+
+        t0 = _time.monotonic()
+        with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+            retrylib.retry_call(
+                flaky,
+                policy=retrylib.RetryPolicy(attempts=5, base_s=30.0,
+                                            jitter=0.0),
+                on_retry=lambda a, e: ev.set(),   # cancel mid-backoff
+                cancel=ev)
+        assert len(calls) == 1          # the 30 s backoff never ran out
+        assert _time.monotonic() - t0 < 5.0
+
+    def test_poll_until_cancel(self):
+        import threading
+        ev = threading.Event()
+        ev.set()
+        assert not retrylib.poll_until(lambda: True, grace_s=10.0,
+                                       cancel=ev)   # pre-cancelled
+        ev2 = threading.Event()
+        threading.Timer(0.05, ev2.set).start()
+        t0 = retrylib.time.monotonic()
+        assert not retrylib.poll_until(lambda: False, grace_s=30.0,
+                                       poll_s=0.01, cancel=ev2)
+        assert retrylib.time.monotonic() - t0 < 5.0
+
+    def test_watchdog_rearm_replaces_timer(self):
+        """Re-arming cancels the prior timer (no stale fire) and a
+        resolved watchdog refuses to re-arm — the serve layer arms per
+        request from client threads."""
+        import time as _time
+        fired = []
+        wd = retrylib.Watchdog(on_fire=lambda: fired.append(1))
+        wd.arm(0.05)
+        wd.arm(30.0)                    # replaces: the 0.05 s timer dies
+        _time.sleep(0.2)
+        assert fired == []
+        assert wd.finish() is True
+        wd.arm(0.01)                    # after resolution: a no-op
+        _time.sleep(0.1)
+        assert fired == []
+
+    def test_watchdog_concurrent_finish_vs_fire_single_winner(self):
+        """Hammer fire/finish from many threads: exactly ONE side ever
+        wins (the one-output contract under real races)."""
+        import threading
+        for _ in range(20):
+            fired = []
+            wd = retrylib.Watchdog(on_fire=lambda: fired.append(1))
+            wins = []
+            threads = (
+                [threading.Thread(target=wd.fire) for _ in range(4)]
+                + [threading.Thread(
+                    target=lambda: wins.append(wd.finish()))
+                   for _ in range(4)])
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(fired) + sum(wins) == 1
+
     def test_failure_record_matches_checker_schema(self):
         sys.path.insert(0, str(REPO / "benchmarks"))
         import check_results
@@ -305,6 +387,28 @@ class TestChunkExecutor:
         fields = ex.row_fields()
         assert fields["degraded"] is True
         assert fields["execution_failures"][0]["fallback"] == "cpu"
+
+    def test_cancelled_stage_gets_no_cpu_fallback(self):
+        """A torn-down request (deadline/shutdown) must surface its
+        failure immediately: no remaining retries, no CPU fallback."""
+        import threading
+        ev = threading.Event()
+        ex = ChunkExecutor(policy=retrylib.RetryPolicy(
+            attempts=4, base_s=10.0, jitter=0.0))
+        calls = []
+
+        def dies():
+            calls.append(1)
+            ev.set()                    # teardown lands mid-flight
+            raise RuntimeError("UNAVAILABLE: device wedged")
+
+        with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+            ex.run(dies, stage="t", cancel=ev)
+        assert len(calls) == 1 and not ex.degraded
+        ev2 = threading.Event()
+        ev2.set()
+        with pytest.raises(retrylib.RetryCancelled):
+            ex.run(lambda: 1, cancel=ev2)
 
     def test_deleted_buffer_not_retried(self):
         ex = ChunkExecutor()

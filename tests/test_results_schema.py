@@ -13,7 +13,8 @@ import pytest
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "benchmarks"))
 
-from check_results import RESULTS, check_all, check_file  # noqa: E402
+from check_results import (RESULTS, check_all, check_file,  # noqa: E402
+                           check_serve_soak)
 
 
 def test_committed_artifacts_pass_schema():
@@ -132,6 +133,78 @@ def test_strict_rows_accept_recorded_cell_failures(tmp_path):
     assert check_file(strict) == []
     strict.write_text(json.dumps({"name": "x", "n": 10}) + "\n")
     assert len(check_file(strict)) == 1
+
+
+def _soak_row(**over):
+    row = {
+        "name": "serve_soak", "n": 8, "backend": "cpu", "tenants": 3,
+        "accepted": 12, "completed": 11, "rejected": 6, "preempted": 26,
+        "timed_out": 1, "failed": 0, "silent_losses": 0, "resumed": 6,
+        "sigkills": 1, "resume_bit_identical": True,
+        "latency_s": {"p50": 12.8, "p95": 15.3, "p99": 15.7},
+        "wall_s": 22.5, "quick": False,
+    }
+    row.update(over)
+    return row
+
+
+def test_serve_soak_schema_accepts_valid_row(tmp_path):
+    """The soak artifact (docs/SERVICE.md) is held to an EXACT key set
+    with reconciling counters and finite latency percentiles."""
+    p = tmp_path / "serve_soak.json"
+    p.write_text(json.dumps(_soak_row(), indent=1) + "\n")
+    assert check_file(p) == []
+
+
+def test_serve_soak_schema_flags_drift(tmp_path):
+    p = tmp_path / "serve_soak.json"
+    cases = [
+        # missing counter key
+        ({k: v for k, v in _soak_row().items() if k != "preempted"},
+         "missing keys"),
+        # unknown key (exact key set)
+        (_soak_row(extra=1), "unknown keys"),
+        # negative count
+        (_soak_row(rejected=-1), "non-negative"),
+        # ledger does not reconcile: a silent loss hidden in the counts
+        (_soak_row(completed=9), "must reconcile"),
+        # NaN percentile (json parses it; the checker must not)
+        (_soak_row(latency_s={"p50": float("nan"), "p95": 1.0,
+                              "p99": 2.0}), "finite"),
+        # percentile keys are exactly p50/p95/p99
+        (_soak_row(latency_s={"p50": 1.0, "p95": 2.0, "p99": 3.0,
+                              "p90": 2.5}), "unknown keys"),
+        (_soak_row(latency_s={"p50": 1.0, "p95": 2.0}), "missing"),
+        # out-of-order percentiles
+        (_soak_row(latency_s={"p50": 5.0, "p95": 2.0, "p99": 3.0}),
+         "non-decreasing"),
+        # bool-typed count smuggling
+        (_soak_row(sigkills=True), "non-negative"),
+        (_soak_row(resume_bit_identical="yes"), "bool"),
+    ]
+    for row, needle in cases:
+        p.write_text(json.dumps(row, indent=1) + "\n")
+        probs = check_file(p)
+        assert probs and any(needle in x for x in probs), (row, probs)
+
+
+def test_serve_soak_direct_checker_on_non_dict():
+    assert check_serve_soak([1, 2], "x") == ["x: not a JSON object"]
+
+
+def test_serve_soak_artifact_committed():
+    """The chaos-soak evidence is committed, on schema, and shows the
+    promises held: zero silent losses and bit-identical resume under
+    worker SIGKILL (benchmarks/serve_soak.py)."""
+    path = RESULTS / "serve_soak.json"
+    assert path.exists(), "benchmarks/results/serve_soak.json missing " \
+                          "(python benchmarks/serve_soak.py)"
+    row = json.loads(path.read_text())
+    assert check_serve_soak(row, path.name) == []
+    assert row["silent_losses"] == 0
+    assert row["resume_bit_identical"] is True
+    assert row["sigkills"] >= 1 and row["accepted"] > 0
+    assert row["preempted"] > 0 and row["rejected"] > 0
 
 
 def test_resilience_overhead_artifact_committed():

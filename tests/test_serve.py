@@ -1,0 +1,409 @@
+"""swarmserve request-lifecycle guarantees (aclswarm_tpu.serve;
+docs/SERVICE.md).
+
+The contract under test, edge by edge: duplicate submissions are
+idempotent, queue-full rejection is loud and carries a retry-after hint,
+deadlines expiring DURING a multi-chunk rollout terminate with a
+structured error at the next boundary, checkpoint-backed preemption
+resumes bit-identically under an active `FaultSchedule`, a worker that
+dies mid-batch loses nothing a journal recovery cannot honor, tenants
+cannot starve each other, and an all-tenants-idle `close` is a clean
+shutdown. Soak-sized runs are marked `slow` (tier-1 duration guard).
+"""
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from aclswarm_tpu.resilience import crash as crashlib
+from aclswarm_tpu.resilience.crash import CrashPlan
+from aclswarm_tpu.serve import (COMPLETED, FAILED, TIMED_OUT,
+                                RejectedError, ServiceConfig,
+                                SwarmService, Ticket, submit_and_wait)
+
+REPO = Path(__file__).resolve().parents[1]
+
+pytestmark = pytest.mark.serve
+
+ROLL = {"n": 5, "ticks": 60, "chunk_ticks": 20, "seed": 5}
+ROLL_FAULTED = {"n": 5, "ticks": 80, "chunk_ticks": 20, "seed": 6,
+                "faults": {"dropout_frac": 0.4, "drop_tick": 15,
+                           "rejoin_tick": 45}}
+
+
+@pytest.fixture(autouse=True)
+def _disarm_crash():
+    yield
+    crashlib.arm(None)
+
+
+@pytest.fixture
+def svc():
+    s = SwarmService(ServiceConfig(max_batch=2, quantum_chunks=2))
+    yield s
+    s.close()
+
+
+# ------------------------------------------------------------- lifecycle
+
+class TestLifecycle:
+    def test_rollout_completes_and_streams_chunks(self, svc):
+        t = svc.submit("rollout", ROLL, tenant="a")
+        res = t.result(timeout=240)
+        assert res.status == COMPLETED and res.ok
+        assert res.value["q"].shape == (5, 3)
+        assert res.value["ticks"] == 60 and res.chunks == 3
+        events = list(t.stream(timeout=1))
+        assert [e.payload["chunk"] for e in events] == [0, 1, 2]
+        # the stream's running digest ends at the result digest
+        assert events[-1].payload["digest"] == res.value["digest"]
+
+    def test_mixed_kinds_complete(self, svc):
+        ta = svc.submit("assign", {"n": 10, "seed": 1}, tenant="a")
+        tg = svc.submit("gains", {"n": 5, "seed": 2}, tenant="b")
+        ra, rg = ta.result(240), tg.result(240)
+        assert ra.ok and sorted(np.asarray(ra.value["perm"])) \
+            == list(range(10))
+        assert rg.ok and rg.value["gains"].shape == (15, 15)
+
+    def test_unknown_kind_and_bad_params_refused_at_submit(self, svc):
+        with pytest.raises(ValueError, match="unknown request kind"):
+            svc.submit("nope", {})
+        with pytest.raises(ValueError, match="multiple of"):
+            svc.submit("rollout", {"n": 5, "ticks": 40, "chunk_ticks": 30,
+                                   "assign_every": 20})
+        # non-chunk-aligned ticks would silently over-run (chunks run
+        # whole): refused at the door, not rounded up
+        with pytest.raises(ValueError, match="chunks run whole"):
+            svc.submit("rollout", {"n": 5, "ticks": 50,
+                                   "chunk_ticks": 20})
+        with pytest.raises(ValueError, match="faults"):
+            svc.submit("rollout", dict(ROLL, faults={"bogus_key": 1}))
+
+    def test_duplicate_submission_idempotent(self, svc):
+        t1 = svc.submit("rollout", ROLL, tenant="a", request_id="dup")
+        t2 = svc.submit("rollout", ROLL, tenant="a", request_id="dup")
+        assert t1 is t2                      # one ticket, one execution
+        res = t1.result(timeout=240)
+        assert res.ok
+        # resubmitting AFTER completion still resolves to the same work
+        t3 = svc.submit("rollout", ROLL, tenant="a", request_id="dup")
+        assert t3.result(timeout=5).value["digest"] \
+            == res.value["digest"]
+        assert svc.stats["accepted"] == 1 and svc.stats["completed"] == 1
+
+    def test_racing_duplicate_submits_one_execution(self, svc):
+        """The id reservation is atomic with the duplicate check: N
+        threads slamming one request_id simultaneously get ONE ticket
+        and ONE execution (regression: check and insert used to live in
+        separate lock acquisitions)."""
+        import threading
+        tickets, barrier = [], threading.Barrier(8)
+
+        def go():
+            barrier.wait()
+            tickets.append(svc.submit("rollout", ROLL, tenant="a",
+                                      request_id="race"))
+
+        threads = [threading.Thread(target=go) for _ in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(30)
+        assert len(tickets) == 8
+        assert all(t is tickets[0] for t in tickets)
+        assert tickets[0].result(timeout=240).ok
+        assert svc.stats["accepted"] == 1
+
+    def test_stream_timeout_raises_and_end_marker_sticky(self, svc):
+        t = svc.submit("rollout", ROLL, tenant="a")
+        assert t.result(timeout=240).ok
+        assert [e.payload["chunk"] for e in t.stream(timeout=1)] \
+            == [0, 1, 2]
+        # events are consumed once, but the end marker is sticky: a
+        # second stream terminates instead of blocking forever
+        assert list(t.stream(timeout=1)) == []
+        # a lapsed per-event timeout is a TimeoutError, not the queue
+        # module's internal exception
+        with pytest.raises(TimeoutError, match="no chunk event"):
+            next(Ticket("never-resolved").stream(timeout=0.05))
+
+    def test_terminal_requests_retire_to_bounded_cache(self):
+        """An always-on service keeps NO per-request state after a
+        request terminates: the job map empties and the idempotency
+        cache is bounded by done_retention (oldest evicted)."""
+        svc = SwarmService(ServiceConfig(done_retention=2))
+        results = [
+            svc.submit("assign", {"n": 6, "seed": i},
+                       request_id=f"r{i}").result(timeout=240)
+            for i in range(4)]
+        assert all(r.ok for r in results)
+        assert svc._jobs == {}
+        assert set(svc._done_prior) == {"r2", "r3"}
+        # idempotent replay still served from the bounded cache
+        replay = svc.submit("assign", {"n": 6, "seed": 3},
+                            request_id="r3").result(timeout=5)
+        assert replay.ok and svc.stats["accepted"] == 4
+        svc.close()
+
+
+# ------------------------------------------- admission and backpressure
+
+class TestAdmission:
+    def test_queue_full_rejection_with_retry_after(self):
+        # worker not started: the queue cannot drain, so the caps bind
+        svc = SwarmService(ServiceConfig(max_queue_per_tenant=2,
+                                         max_queue_total=3), start=False)
+        svc.submit("rollout", ROLL, tenant="a")
+        svc.submit("rollout", ROLL, tenant="a")
+        with pytest.raises(RejectedError) as ei:
+            svc.submit("rollout", ROLL, tenant="a")   # per-tenant cap
+        assert ei.value.retry_after_s > 0
+        assert "cap" in str(ei.value)
+        svc.submit("rollout", ROLL, tenant="b")       # other tenant fits
+        with pytest.raises(RejectedError) as ei:
+            svc.submit("rollout", ROLL, tenant="c")   # global cap
+        assert "global cap" in str(ei.value)
+        assert svc.stats["rejected"] == 2 and svc.stats["accepted"] == 3
+
+    def test_rejected_work_is_not_owed(self, tmp_path):
+        """A rejected submit journals NOTHING: recovery must not
+        resurrect work the client was told to retry elsewhere."""
+        svc = SwarmService(ServiceConfig(max_queue_per_tenant=1,
+                                         journal_dir=str(tmp_path)),
+                          start=False)
+        svc.submit("rollout", ROLL, tenant="a", request_id="kept")
+        with pytest.raises(RejectedError):
+            svc.submit("rollout", ROLL, tenant="a", request_id="bounced")
+        reqs = {p.name for p in tmp_path.glob("req_*.req")}
+        assert reqs == {"req_kept.req"}
+
+
+# ----------------------------------------------------------- deadlines
+
+class TestDeadlines:
+    def test_deadline_expiring_during_chunks(self, svc):
+        """A deadline that lapses MID-ROLLOUT terminates the request at
+        the next chunk boundary with a structured error — partial work
+        is cancelled, the service moves on, other requests are
+        unaffected."""
+        # long job with a deadline it cannot meet, short job without
+        tshort = svc.submit("rollout", dict(ROLL, seed=9), tenant="b")
+        tlong = svc.submit(
+            "rollout", {"n": 5, "ticks": 10_000, "chunk_ticks": 20,
+                        "seed": 8},
+            tenant="a", deadline_s=2.0)
+        rlong = tlong.result(timeout=240)
+        assert rlong.status == TIMED_OUT and not rlong.ok
+        assert rlong.error.code == "deadline_exceeded"
+        assert "chunk boundary" in rlong.error.message
+        assert 0 < rlong.chunks < 500      # it ran, then was cancelled
+        assert tshort.result(timeout=240).ok
+
+    def test_expired_on_arrival(self, svc):
+        r = svc.submit("rollout", ROLL, deadline_s=0.0).result(timeout=60)
+        assert r.status == TIMED_OUT and r.chunks == 0
+        assert r.error.code == "deadline_exceeded"
+
+
+# --------------------------------------------- preemption + bit-parity
+
+class TestPreemption:
+    def test_preempt_then_resume_bit_parity_under_faults(self):
+        """Two tenants contend for ONE batch slot with a 1-chunk
+        quantum: both rollouts (one carrying an active FaultSchedule)
+        are preempted through the checkpoint codec repeatedly, and both
+        finish bit-identical to uncontended solo runs."""
+        ref = SwarmService(ServiceConfig(max_batch=4))
+        r_ref = [ref.submit("rollout", p).result(240)
+                 for p in (ROLL_FAULTED, dict(ROLL, seed=7))]
+        ref.close()
+
+        svc = SwarmService(ServiceConfig(max_batch=1, quantum_chunks=1))
+        ta = svc.submit("rollout", ROLL_FAULTED, tenant="a")
+        tb = svc.submit("rollout", dict(ROLL, seed=7), tenant="b")
+        ra, rb = ta.result(timeout=240), tb.result(timeout=240)
+        svc.close()
+        assert ra.preemptions > 0 and rb.preemptions > 0
+        assert svc.stats["preempted"] >= 2
+        for got, want in ((ra, r_ref[0]), (rb, r_ref[1])):
+            assert got.ok
+            assert got.value["digest"] == want.value["digest"]
+            assert got.value["chunk_digests"] == want.value["chunk_digests"]
+            assert np.array_equal(got.value["q"], want.value["q"])
+
+
+# ------------------------------------------------- crash + journal recovery
+
+class TestRecovery:
+    def test_worker_death_mid_batch_loses_nothing(self, tmp_path):
+        """In-process crash drill (the subprocess SIGKILL proof lives in
+        `serve.smoke`/`serve_soak`): the worker dies mid-batch via an
+        injected crash; a new service on the same journal re-admits
+        every accepted request, resumes the rollout from its checkpoint,
+        and terminates all of them."""
+        svc = SwarmService(ServiceConfig(max_batch=1, quantum_chunks=1,
+                                         journal_dir=str(tmp_path)))
+        crashlib.arm(CrashPlan("serve", 2, "raise"))
+        svc.submit("rollout", ROLL_FAULTED, tenant="a",
+                   request_id="roll")
+        svc.submit("assign", {"n": 10, "seed": 4}, tenant="b",
+                   request_id="asg")
+        deadline = time.monotonic() + 60
+        while svc._worker.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not svc._worker.is_alive()      # died mid-batch
+        done = {p.name for p in tmp_path.glob("req_*.done")}
+        reqs = {p.name for p in tmp_path.glob("req_*.req")}
+        assert reqs == {"req_roll.req", "req_asg.req"}
+        assert len(done) < 2                   # work genuinely in flight
+
+        svc2 = SwarmService(ServiceConfig(max_batch=1,
+                                          journal_dir=str(tmp_path)))
+        # recovered requests are serviced without any resubmission;
+        # duplicate submits attach to the recovered jobs
+        t_roll = svc2.submit("rollout", ROLL_FAULTED, request_id="roll")
+        t_asg = svc2.submit("assign", {"n": 10, "seed": 4},
+                            request_id="asg")
+        r_roll, r_asg = t_roll.result(timeout=240), \
+            t_asg.result(timeout=240)
+        svc2.close()
+        assert r_roll.ok and r_asg.ok
+        assert r_roll.resumed                  # checkpoint, not restart
+        assert svc2.stats["resumed"] == 1
+
+        ref = SwarmService(ServiceConfig())
+        want = ref.submit("rollout", ROLL_FAULTED).result(240)
+        ref.close()
+        assert r_roll.value["digest"] == want.value["digest"]
+        assert np.array_equal(r_roll.value["q"], want.value["q"])
+
+    def test_resubmit_after_restart_replays_journaled_result(
+            self, tmp_path):
+        svc = SwarmService(ServiceConfig(journal_dir=str(tmp_path)))
+        want = svc.submit("rollout", ROLL,
+                          request_id="r1").result(timeout=240)
+        svc.close()
+        svc2 = SwarmService(ServiceConfig(journal_dir=str(tmp_path)),
+                            start=False)
+        got = svc2.submit("rollout", ROLL, request_id="r1").result(1)
+        assert got.ok and got.value["digest"] == want.value["digest"]
+        assert svc2.stats["accepted"] == 0     # replayed, not re-run
+
+
+# ------------------------------------------------- fairness + shutdown
+
+class TestFairnessAndShutdown:
+    def test_flooding_tenant_cannot_starve_another(self):
+        """Tenant a queues 6 rollouts; tenant b's single request lands
+        LAST — round-robin slots must still finish b well before a's
+        backlog drains."""
+        svc = SwarmService(ServiceConfig(max_batch=1, quantum_chunks=1,
+                                         max_queue_per_tenant=8),
+                           start=False)
+        flood = [svc.submit("rollout", dict(ROLL, seed=50 + i),
+                            tenant="a") for i in range(6)]
+        tb = svc.submit("rollout", dict(ROLL, seed=99), tenant="b")
+        svc._worker.start()
+        rb = tb.result(timeout=240)
+        assert rb.ok
+        done_of_a = sum(1 for t in flood if t.done)
+        assert done_of_a < 6, "tenant b waited behind tenant a's flood"
+        for t in flood:
+            assert t.result(timeout=240).ok
+        svc.close()
+
+    def test_all_tenants_idle_clean_shutdown(self):
+        svc = SwarmService(ServiceConfig())
+        assert svc.submit("assign", {"n": 8}).result(timeout=240).ok
+        svc.close()                      # drain: idle -> worker exits
+        assert not svc._worker.is_alive()
+        svc.close()                      # idempotent
+        with pytest.raises(RejectedError, match="shutdown"):
+            svc.submit("assign", {"n": 8})
+
+    def test_nondrain_close_resolves_queued_with_structured_error(self):
+        svc = SwarmService(ServiceConfig(), start=False)
+        t = svc.submit("rollout", ROLL)
+        svc.close(drain=False)
+        r = t.result(timeout=5)
+        assert r.status == FAILED
+        assert r.error.code == "service_shutdown"
+
+    def test_drain_timeout_is_loud_not_silent(self):
+        """A drain that cannot finish within close()'s timeout resolves
+        the abandoned tickets with an error NAMING the drain timeout
+        (regression: the broken run-to-terminal promise used to look
+        identical to a never-scheduled shutdown)."""
+        svc = SwarmService(ServiceConfig(max_batch=1))
+        t = svc.submit("rollout", {"n": 5, "ticks": 10_000,
+                                   "chunk_ticks": 20, "seed": 3})
+        time.sleep(0.3)                 # let the worker go resident
+        svc.close(drain=True, timeout=0.2)
+        r = t.result(timeout=60)
+        assert r.status == FAILED
+        assert r.error.code == "service_shutdown"
+        assert "abandoned the drain" in r.error.message
+
+
+# ------------------------------------------------------- client helpers
+
+class TestSubmitAndWait:
+    def test_structured_nonanswers(self):
+        """Rejection, a dead worker, and client impatience all come back
+        as structured failed Results — never an exception, never a
+        hang."""
+        # dead worker: a never-started service cannot resolve tickets
+        svc = SwarmService(ServiceConfig(), start=False)
+        r = submit_and_wait(svc, "assign", {"n": 6}, poll_s=0.05,
+                            client_timeout_s=10.0)
+        assert r.status == FAILED and r.error.code == "worker_died"
+        svc.close(drain=False)
+        # queue full: the retry-after hint survives the translation
+        svc2 = SwarmService(ServiceConfig(max_queue_per_tenant=1),
+                            start=False)
+        svc2.submit("assign", {"n": 6})
+        r2 = submit_and_wait(svc2, "assign", {"n": 6})
+        assert r2.status == FAILED and r2.error.code == "queue_full"
+        assert r2.error.detail["retry_after_s"] > 0
+        svc2.close(drain=False)
+
+    def test_client_timeout_while_service_still_owes(self):
+        svc = SwarmService(ServiceConfig())
+        r = submit_and_wait(
+            svc, "rollout", {"n": 5, "ticks": 10_000, "chunk_ticks": 20,
+                             "seed": 1},
+            poll_s=0.1, client_timeout_s=0.3)
+        assert r.status == FAILED and r.error.code == "client_timeout"
+        svc.close(drain=False)
+
+
+# ----------------------------------------------------------- soak sizes
+
+@pytest.mark.slow
+def test_serve_soak_quick_subprocess():
+    """The full chaos soak (SIGKILL + recovery + ledger audit +
+    bit-parity) in quick sizing — the tier-2 end-to-end proof."""
+    r = subprocess.run(
+        [sys.executable, str(REPO / "benchmarks" / "serve_soak.py"),
+         "--quick", "--out", ""],
+        capture_output=True, text=True, timeout=570, cwd=str(REPO))
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert '"silent_losses": 0' in r.stdout
+    assert '"resume_bit_identical": true' in r.stdout
+
+
+@pytest.mark.slow
+def test_serve_smoke_subprocess():
+    """The scripts/check.sh serve smoke (SIGKILL the worker process,
+    recover, zero losses, bit-identical resume) stays green."""
+    r = subprocess.run(
+        [sys.executable, "-m", "aclswarm_tpu.serve.smoke"],
+        capture_output=True, text=True, timeout=570, cwd=str(REPO))
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert "PASS" in r.stdout
