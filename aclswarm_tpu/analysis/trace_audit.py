@@ -189,32 +189,39 @@ def _faults(gp: GridPoint, seed: int = 0):
         link_loss=0.1)
 
 
-def _sim_state(gp: GridPoint, seed: int = 0, checks: bool = False):
+def _sim_state(gp: GridPoint, seed: int = 0, checks: bool = False,
+               telemetry: bool = False):
     from aclswarm_tpu import sim
     return sim.init_state(_scatter(gp.n, seed),
                           localization=(gp.localization == "flooded"),
-                          faults=_faults(gp, seed), checks=checks)
+                          faults=_faults(gp, seed), checks=checks,
+                          telemetry=telemetry)
 
 
 _TICKS = 4
 
 
-def _build_rollout(gp: GridPoint, check: bool = False):
+def _build_rollout(gp: GridPoint, check: bool = False,
+                   tel: bool = False):
     from aclswarm_tpu.core.types import ControlGains
-    args = (_sim_state(gp, checks=check), _formation(gp.n), ControlGains(),
-            _sparams())
+    args = (_sim_state(gp, checks=check, telemetry=tel), _formation(gp.n),
+            ControlGains(), _sparams())
     cfg = _sim_cfg(gp)
     if check:
         cfg = cfg.replace(check_mode="on")
+    if tel:
+        cfg = cfg.replace(telemetry="on")
     return args, {"cfg": cfg, "n_ticks": _TICKS}
 
 
-def _build_batched_rollout(gp: GridPoint, check: bool = False):
+def _build_batched_rollout(gp: GridPoint, check: bool = False,
+                           tel: bool = False):
     import jax
     import jax.numpy as jnp
 
     from aclswarm_tpu.core.types import ControlGains
-    states = [_sim_state(gp, seed=b, checks=check) for b in range(gp.B)]
+    states = [_sim_state(gp, seed=b, checks=check, telemetry=tel)
+              for b in range(gp.B)]
     forms = [_formation(gp.n) for _ in range(gp.B)]
     stack = lambda *xs: jnp.stack(xs)                      # noqa: E731
     state = jax.tree.map(stack, *states)
@@ -223,14 +230,17 @@ def _build_batched_rollout(gp: GridPoint, check: bool = False):
     cfg = _sim_cfg(gp)
     if check:
         cfg = cfg.replace(check_mode="on")
+    if tel:
+        cfg = cfg.replace(telemetry="on")
     return args, {"cfg": cfg, "n_ticks": _TICKS}
 
 
-def _build_rollout_summary(gp: GridPoint, check: bool = False):
+def _build_rollout_summary(gp: GridPoint, check: bool = False,
+                           tel: bool = False):
     import jax.numpy as jnp
 
     from aclswarm_tpu.sim import summary
-    args, statics = _build_batched_rollout(gp, check=check)
+    args, statics = _build_batched_rollout(gp, check=check, tel=tel)
     carry = summary.init_carry(gp.n, window=3, dtype=jnp.float32,
                                batch=gp.B)
     statics.update(window=3, pose_every=0)
@@ -357,6 +367,23 @@ def _install_default_registry() -> None:
                    static_argnames=("cfg", "n_ticks", "window",
                                     "pose_every"),
                    build=partial(_build_rollout_summary, check=True),
+                   axes=("n", "B", "solver", "faults", "localization"),
+                   baseline=False)
+    # swarmscope-ON variants (docs/OBSERVABILITY.md): the instrumented
+    # programs must also be transfer-free, cache-stable, and f64-clean —
+    # device counters that secretly synced would defeat the whole
+    # riding-the-existing-sync design. Excluded from the zero-cost
+    # baseline like [checked] (they differ from it by construction).
+    register_entry("sim.engine.rollout[telemetry]", engine.rollout,
+                   static_argnames=("n_ticks", "cfg"),
+                   build=partial(_build_rollout, tel=True),
+                   axes=("n", "solver", "faults", "localization"),
+                   baseline=False)
+    register_entry("sim.summary.batched_rollout_summary[telemetry]",
+                   summary.batched_rollout_summary,
+                   static_argnames=("cfg", "n_ticks", "window",
+                                    "pose_every"),
+                   build=partial(_build_rollout_summary, tel=True),
                    axes=("n", "B", "solver", "faults", "localization"),
                    baseline=False)
 

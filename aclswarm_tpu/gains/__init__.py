@@ -4,9 +4,9 @@
 - ``reference`` — sequential NumPy mirror of the C++ solver, the test oracle
                   (matches `test_admm.cpp` goldens to machine precision).
 """
-from aclswarm_tpu.gains.admm import (solve_gains, solve_gains_blocks,
-                                     validate_gains)
+from aclswarm_tpu.gains.admm import (AdmmSolveStats, solve_gains,
+                                     solve_gains_blocks, validate_gains)
 from aclswarm_tpu.gains.reference import AdmmParams
 
-__all__ = ["solve_gains", "solve_gains_blocks", "validate_gains",
-           "AdmmParams"]
+__all__ = ["AdmmSolveStats", "solve_gains", "solve_gains_blocks",
+           "validate_gains", "AdmmParams"]

@@ -45,6 +45,7 @@ end-to-end on a v5e; agrees with 'eigh' to ~1e-6 at f64 — see
 """
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
 
 import jax
@@ -54,6 +55,18 @@ from jax import lax
 
 from aclswarm_tpu.analysis import invariants as invlib
 from aclswarm_tpu.gains.reference import AdmmParams
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmmSolveStats:
+    """Host-side swarmscope record of one gain solve (docs/
+    OBSERVABILITY.md): total ADMM iterations across the 2D+1D
+    subproblems and the worse of the two final residuals (last diffX) —
+    the per-solve trace ROADMAP open item 1's warm-start attack needs
+    before any claim that warm starts help."""
+
+    iters: int
+    residual: float
 
 
 def _proj_struct(B: jnp.ndarray, d: int) -> jnp.ndarray:
@@ -159,15 +172,20 @@ def _constraint_system(Q: jnp.ndarray, i_idx: jnp.ndarray,
 
 def _subproblem(Q: jnp.ndarray, i_idx: jnp.ndarray, j_idx: jnp.ndarray,
                 valid: jnp.ndarray, d: int,
-                params: AdmmParams, check: bool = False) -> jnp.ndarray:
+                params: AdmmParams, check: bool = False,
+                tel: bool = False) -> jnp.ndarray:
     """Solve one (2D or 1D) gain subproblem; returns the full-space gains
     -Q Abar Q^T (`solver.cpp:143,207`).
 
     ``check=True`` additionally threads the swarmcheck `admm_residual`
-    contract through the iteration carry (first/last diffX) and returns
-    ``(gains, code)`` — code 0 unless the loop finished neither
-    converged nor with a net residual decrease. Python-gated: with
-    ``check=False`` the carry and the lowered HLO are unchanged."""
+    contract through the iteration carry (first/last diffX) and appends
+    a ``code`` return — 0 unless the loop finished neither converged
+    nor with a net residual decrease. ``tel=True`` (swarmscope,
+    `telemetry.device`) appends ``(iters, final_residual)`` — the
+    iteration count and last diffX the paper's warm-start evaluation
+    needs per solve. Flag-gated returns compose as
+    ``(gains[, code][, iters, residual])``; Python-gated, so with both
+    flags off the carry and the lowered HLO are unchanged."""
     dtype = Q.dtype
     dm = Q.shape[1]
     mu = params.mu
@@ -333,11 +351,15 @@ def _subproblem(Q: jnp.ndarray, i_idx: jnp.ndarray, j_idx: jnp.ndarray,
         out = (Xnew, Snew, it + 1, stop)
         if check:
             out = out + (jnp.where(it == 0, diffX, carry[4]), diffX)
+        elif tel:
+            out = out + (diffX,)
         return out
 
     carry0 = (X0, S0, jnp.asarray(0, jnp.int32), jnp.asarray(False))
     if check:
         carry0 = carry0 + (jnp.zeros((), dtype), jnp.zeros((), dtype))
+    elif tel:
+        carry0 = carry0 + (jnp.zeros((), dtype),)
     fin = lax.while_loop(cond, body, carry0)
     X, S = fin[0], fin[1]
 
@@ -345,12 +367,17 @@ def _subproblem(Q: jnp.ndarray, i_idx: jnp.ndarray, j_idx: jnp.ndarray,
     W = W_of(C - mu * X)
     X22 = (-W / mu)[dm:, dm:]
     gains = -(Q @ X22 @ Q.T)
+    extras = ()
     if check:
-        code = jnp.where(
+        extras = extras + (jnp.where(
             invlib.admm_residual_violated(fin[4], fin[5], fin[3]),
             jnp.asarray(invlib.CODES["admm_residual"], jnp.int32),
-            jnp.zeros((), jnp.int32))
-        return gains, code
+            jnp.zeros((), jnp.int32)),)
+    if tel:
+        # last diffX sits after the check slots when both flags are on
+        extras = extras + (fin[2], fin[5] if check else fin[4])
+    if extras:
+        return (gains,) + extras
     return gains
 
 
@@ -380,17 +407,21 @@ def _kernel_1d(pts_z: jnp.ndarray, planar: bool) -> jnp.ndarray:
     return U[:, N.shape[1]:]
 
 
-@partial(jax.jit, static_argnames=("planar", "params", "check_mode"))
+@partial(jax.jit, static_argnames=("planar", "params", "check_mode",
+                                   "telemetry"))
 def _solve_jit(points: jnp.ndarray, i_idx: jnp.ndarray, j_idx: jnp.ndarray,
                valid: jnp.ndarray, adjmask: jnp.ndarray, planar: bool,
                params: AdmmParams,
-               check_mode: str = "off") -> jnp.ndarray:
+               check_mode: str = "off",
+               telemetry: str = "off") -> jnp.ndarray:
     check = check_mode == "on"
-    if check:
-        A2d, code2 = _subproblem(_kernel_2d(points[:, :2]), i_idx, j_idx,
-                                 valid, 2, params, check=True)
-        A1d, code1 = _subproblem(_kernel_1d(points[:, 2], planar), i_idx,
-                                 j_idx, valid, 1, params, check=True)
+    tel = telemetry == "on"
+    if check or tel:
+        A2d, *ex2 = _subproblem(_kernel_2d(points[:, :2]), i_idx, j_idx,
+                                valid, 2, params, check=check, tel=tel)
+        A1d, *ex1 = _subproblem(_kernel_1d(points[:, 2], planar), i_idx,
+                                j_idx, valid, 1, params, check=check,
+                                tel=tel)
     else:
         A2d = _subproblem(_kernel_2d(points[:, :2]), i_idx, j_idx, valid, 2,
                           params)
@@ -408,14 +439,25 @@ def _solve_jit(points: jnp.ndarray, i_idx: jnp.ndarray, j_idx: jnp.ndarray,
     flat = out.reshape(3 * n, 3 * n)
     # kill numerically-zero entries (`solver.cpp:144,208`)
     flat = jnp.where(jnp.abs(flat) > params.thr_sparse_zero, flat, 0.0)
-    if check:
-        return flat, jnp.maximum(code2, code1)
+    if check or tel:
+        extras = ()
+        k = 0
+        if check:
+            extras = extras + (jnp.maximum(ex2[0], ex1[0]),)
+            k = 1
+        if tel:
+            # total iterations across the 2D+1D subproblems, and the
+            # worse of the two final residuals (one solve = one pair)
+            extras = extras + (ex2[k] + ex1[k],
+                               jnp.maximum(ex2[k + 1], ex1[k + 1]))
+        return (flat,) + extras
     return flat
 
 
 def solve_gains(points, adj, params: AdmmParams | None = None,
                 max_nonedges: int | None = None,
-                check_mode: str = "off") -> jnp.ndarray:
+                check_mode: str = "off",
+                telemetry: bool = False) -> jnp.ndarray:
     """Design (3n, 3n) formation gains on device.
 
     The graph enters as *traced* padded index arrays, so one compiled
@@ -431,6 +473,11 @@ def solve_gains(points, adj, params: AdmmParams | None = None,
     `InvariantViolation` if either finished neither converged nor with a
     net residual decrease (the host sync this costs sits on the
     dispatch-time gain-design path, not in a rollout).
+
+    ``telemetry=True`` (swarmscope, docs/OBSERVABILITY.md) returns
+    ``(gains, AdmmSolveStats)`` — iteration count + final residual per
+    solve, same dispatch-time host sync as check_mode. Both flags are
+    static and Python-gated: off is the committed-baseline HLO.
     """
     params = params or AdmmParams()
     if check_mode not in ("off", "on"):
@@ -460,15 +507,24 @@ def solve_gains(points, adj, params: AdmmParams | None = None,
     else:
         planar = bool(np.std(np.asarray(points)[:, 2], ddof=1)
                       < params.thr_planar)
-    if check_mode == "on":
-        gains, code = _solve_jit(jnp.asarray(points), jnp.asarray(i_idx),
-                                 jnp.asarray(j_idx), jnp.asarray(valid),
-                                 jnp.asarray(adjmask), planar, params,
-                                 check_mode="on")
-        code = int(code)   # deliberate host sync: dispatch-time path
-        if code:
-            raise invlib.InvariantViolation(invlib.contract_of(code),
-                                            tick=-1)
+    if check_mode == "on" or telemetry:
+        outs = _solve_jit(jnp.asarray(points), jnp.asarray(i_idx),
+                          jnp.asarray(j_idx), jnp.asarray(valid),
+                          jnp.asarray(adjmask), planar, params,
+                          check_mode=check_mode,
+                          telemetry="on" if telemetry else "off")
+        gains = outs[0]
+        k = 1
+        if check_mode == "on":
+            code = int(outs[k])   # deliberate host sync: dispatch path
+            k += 1
+            if code:
+                raise invlib.InvariantViolation(invlib.contract_of(code),
+                                                tick=-1)
+        if telemetry:
+            stats = AdmmSolveStats(iters=int(outs[k]),
+                                   residual=float(outs[k + 1]))
+            return gains, stats
         return gains
     return _solve_jit(jnp.asarray(points), jnp.asarray(i_idx),
                       jnp.asarray(j_idx), jnp.asarray(valid),

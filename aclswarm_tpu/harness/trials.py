@@ -30,6 +30,7 @@ overrides (--set key=value), per SURVEY.md §5.6.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import csv
 import dataclasses
 import re
@@ -87,6 +88,24 @@ class TrialConfig:
     # structured `InvariantViolation` (trial + tick + contract) the
     # moment a chunk's synced codes show one. 'off' is proven zero-cost.
     check_mode: str = "off"
+    # swarmscope device counters ('off' | 'on', `SimConfig.telemetry`,
+    # docs/OBSERVABILITY.md): 'on' compiles the per-trial chunk counters
+    # (auction/CBAA rounds to consensus, reassignment churn, flood
+    # staleness, CA activations, dispatch-time ADMM iterations/residual)
+    # into the rollout and publishes them into the process telemetry
+    # registry at every chunk boundary — riding the syncs the drivers
+    # already do. 'off' is proven zero-cost (same HLO baseline proof as
+    # check_mode).
+    telemetry: str = "off"
+    # JSONL metrics dump written after the run (None = don't); requires
+    # telemetry='on' to carry the device counters, but host metrics
+    # (timing histograms, log counters) land regardless
+    telemetry_dump: Optional[str] = None
+    # opt-in jax.profiler capture (docs/OBSERVABILITY.md): write one
+    # profiler trace into this directory for the chunk whose index is
+    # `profile_chunk` (TensorBoard/Perfetto-viewable; None = off)
+    profile_dir: Optional[str] = None
+    profile_chunk: int = 1
     colavoid_neighbors: Optional[int] = None
     chunk_ticks: int = 50           # FSM action latency bound (0.5 s)
     # initial-condition sampling (trial.sh:7-9: 20 x 20 area, r=0.75)
@@ -198,7 +217,8 @@ class TrialConfig:
 # checkpoint manifest's config hash so e.g. resuming into a different
 # output CSV stays legal while any engine-visible knob change is caught
 _CKPT_EXCLUDE = ("out", "verbose", "checkpoint_dir", "checkpoint_every",
-                 "resume")
+                 "resume", "telemetry_dump", "profile_dir",
+                 "profile_chunk")
 
 
 def _ckpt_cfg_hash(cfg: "TrialConfig") -> str:
@@ -223,16 +243,25 @@ def _formations_for_trial(cfg: TrialConfig, seed: int
 
 
 def _gains_for(spec: FormationSpec,
-               max_nonedges: Optional[int] = None) -> np.ndarray:
+               max_nonedges: Optional[int] = None,
+               stats: bool = False):
     """Library gains if shipped, else the on-dispatch device ADMM solve
     (`coordination_ros.cpp:112-119`). ``max_nonedges`` pins the padded
     constraint bucket so Monte-Carlo trials over random graphs (whose
     non-edge count varies per seed) reuse one compiled solver — for
     `simformN` groups the generator removes at most n-4 edges
-    (`generate_random_formation.py:61-73`), so n-4 is a static bound."""
+    (`generate_random_formation.py:61-73`), so n-4 is a static bound.
+    ``stats=True`` (swarmscope) returns ``(gains, AdmmSolveStats |
+    None)`` — None when the library shipped the gains (no solve ran)."""
     if spec.gains is not None:
-        return np.asarray(spec.gains)
+        return (np.asarray(spec.gains), None) if stats \
+            else np.asarray(spec.gains)
     from aclswarm_tpu import gains as gainslib
+    if stats:
+        g, st = gainslib.solve_gains(spec.points, spec.adjmat,
+                                     max_nonedges=max_nonedges,
+                                     telemetry=True)
+        return np.asarray(g), st
     return np.asarray(gainslib.solve_gains(spec.points, spec.adjmat,
                                            max_nonedges=max_nonedges))
 
@@ -274,26 +303,30 @@ def _engine_kw(cfg: TrialConfig) -> dict:
                 assign_eps=cfg.assign_eps,
                 cbaa_task_block=cfg.cbaa_task_block,
                 check_mode=cfg.check_mode,
+                telemetry=cfg.telemetry,
                 flight_fsm=True)
 
 
 def _dispatch_gains(cfg: TrialConfig, spec: FormationSpec,
-                    n: int) -> np.ndarray:
+                    n: int, stats: bool = False):
     """On-dispatch gain design with the padded-constraint bucket rule:
     fc graphs have exactly zero non-edges (a 1-slot bucket avoids padding
     n-4 dead constraint slots into the solve); random simformN graphs
     remove at most n-4 edges, a static bound that lets Monte-Carlo seeds
-    share one compiled solver."""
+    share one compiled solver. ``stats=True`` additionally returns the
+    solve's `AdmmSolveStats` (None for library gains) — the swarmscope
+    drivers fold it into the `ChunkTelemetry` carry at commit."""
     if not _SIMFORM.match(cfg.formation):
         bucket = None
     elif cfg.sim_fc:
         bucket = 1
     else:
         bucket = max(n - 4, 1)
-    g = _gains_for(spec, bucket)
+    out = _gains_for(spec, bucket, stats=stats)
+    g, st = out if stats else (out, None)
     if cfg.gain_scale is not None:
         g = g * cfg.gain_scale
-    return g
+    return (g, st) if stats else g
 
 
 def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
@@ -331,9 +364,11 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
                                      np.zeros((n, n)), None)
     gains_cache: dict[int, np.ndarray] = {}
 
+    tel_on = cfg.telemetry == "on"
     state = sim.init_state(q0, flying=False,
                            localization=cfg.localization == "flooded",
-                           checks=cfg.check_mode == "on")
+                           checks=cfg.check_mode == "on",
+                           telemetry=tel_on)
     fsm = TrialFSM(n, len(specs), takeoff_alt=sparams.takeoff_alt,
                    dt=cfg.control_dt, trial_timeout=trial_timeout)
     cgains = _trial_cgains(cfg)
@@ -359,6 +394,13 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
                                          ckptlib, maybe_crash)
     from aclswarm_tpu.utils import get_logger
     execu = ChunkExecutor(log=get_logger("trials"))
+    # --- swarmscope wiring (docs/OBSERVABILITY.md): chunk-boundary
+    # counter publication + the opt-in jax.profiler capture hook ---
+    if tel_on:
+        from aclswarm_tpu.telemetry import device as devtel, get_registry
+        publisher = devtel.ChunkPublisher(get_registry(), prefix="trial")
+    if cfg.profile_dir is not None:
+        from aclswarm_tpu.utils import timing as timinglib
     ckpt_dir = cfg.checkpoint_dir
     if ckpt_dir is not None and cfg.record_dir is not None:
         raise ValueError("checkpoint_dir with record_dir is unsupported: "
@@ -401,10 +443,15 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
             joy_vel=jnp.zeros((chunk, n, 3), state.swarm.q.dtype),
             joy_yawrate=jnp.zeros((chunk, n), state.swarm.q.dtype),
             joy_active=jnp.zeros((chunk, n), bool))
-        state, metrics = execu.run(
-            lambda: sim.rollout(state, cur_formation, cgains, sparams,
-                                cur_cfg, chunk, inputs),
-            stage=f"trial{trial_idx}:chunk{chunk_idx}")
+        prof = (timinglib.trace(cfg.profile_dir)
+                if cfg.profile_dir is not None
+                and chunk_idx == cfg.profile_chunk
+                else contextlib.nullcontext())
+        with prof:
+            state, metrics = execu.run(
+                lambda: sim.rollout(state, cur_formation, cgains, sparams,
+                                    cur_cfg, chunk, inputs),
+                stage=f"trial{trial_idx}:chunk{chunk_idx}")
         if cfg.record_dir is not None:
             recorded.append(metrics)
         if cfg.check_mode == "on":
@@ -414,6 +461,11 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
             from aclswarm_tpu.analysis import invariants as invlib
             invlib.raise_on_violation(np.asarray(metrics.inv_code),
                                       trial=trial_idx, tick0=ticks_done)
+        if tel_on:
+            # trial-cumulative chunk-final counters, riding the metric
+            # sync this driver already does — zero extra transfers
+            publisher.publish(trial_idx,
+                              devtel.to_host(metrics.tel, index=-1))
         ticks_done += chunk
         q = np.asarray(metrics.q)
         dn = np.asarray(metrics.distcmd_norm)
@@ -440,8 +492,14 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
 
         if pending_dispatch is not None and not fsm.done:
             spec = specs[pending_dispatch]
+            solve_st = None
             if pending_dispatch not in gains_cache:
-                gains_cache[pending_dispatch] = _dispatch_gains(cfg, spec, n)
+                if tel_on:
+                    g, solve_st = _dispatch_gains(cfg, spec, n, stats=True)
+                    gains_cache[pending_dispatch] = g
+                else:
+                    gains_cache[pending_dispatch] = _dispatch_gains(
+                        cfg, spec, n)
             cur_formation = make_formation(spec.points, spec.adjmat,
                                            gains_cache[pending_dispatch])
             cur_cfg = fly_cfg
@@ -457,6 +515,13 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
             state = state.replace(v2f=permutil.identity(n),
                                   tick=jnp.zeros_like(state.tick),
                                   first_auction=jnp.asarray(True))
+            if tel_on and solve_st is not None:
+                # fold the dispatch-time gain solve into the device
+                # carry: it checkpoints and syncs with everything else
+                state = state.replace(tel=state.tel.replace(
+                    admm_iters=jnp.asarray(solve_st.iters, jnp.int32),
+                    admm_residual=jnp.asarray(solve_st.residual,
+                                              state.swarm.q.dtype)))
             formation_just_received = True
             committed_idx = pending_dispatch
             pending_dispatch = None
@@ -581,8 +646,9 @@ def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
                          "phase)")
 
     checks = cfg.check_mode == "on"
+    tel_on = cfg.telemetry == "on"
     states = [sim.init_state(q0, flying=False, localization=flooded,
-                             checks=checks)
+                             checks=checks, telemetry=tel_on)
               for q0 in q0s]
     bstate = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
     # pre-dispatch: auctions off per trial (the batch shares ONE compiled
@@ -625,6 +691,11 @@ def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
                                          ckptlib, maybe_crash)
     from aclswarm_tpu.utils import get_logger
     execu = ChunkExecutor(log=get_logger("trials"))
+    if tel_on:
+        from aclswarm_tpu.telemetry import device as devtel, get_registry
+        publisher = devtel.ChunkPublisher(get_registry(), prefix="trial")
+    if cfg.profile_dir is not None:
+        from aclswarm_tpu.utils import timing as timinglib
     ckpt_dir = cfg.checkpoint_dir
     stem = f"wave{trial_indices[0]:05d}_b{B}"
     cfg_hash = _ckpt_cfg_hash(cfg) if ckpt_dir is not None else None
@@ -699,11 +770,16 @@ def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
                                     joy_vel=joy_vel,
                                     joy_yawrate=joy_yawrate,
                                     joy_active=joy_active)
-        bstate, scarry, summ = execu.run(
-            lambda: sumlib.batched_rollout_summary(
-                bstate, scarry, bform, cgains, sparams, fly_cfg, chunk,
-                inputs, 0, window=window, takeoff_alt=takeoff_alt),
-            stage=f"wave{trial_indices[0]}:chunk{chunk_idx}")
+        prof = (timinglib.trace(cfg.profile_dir)
+                if cfg.profile_dir is not None
+                and chunk_idx == cfg.profile_chunk
+                else contextlib.nullcontext())
+        with prof:
+            bstate, scarry, summ = execu.run(
+                lambda: sumlib.batched_rollout_summary(
+                    bstate, scarry, bform, cgains, sparams, fly_cfg, chunk,
+                    inputs, 0, window=window, takeoff_alt=takeoff_alt),
+                stage=f"wave{trial_indices[0]}:chunk{chunk_idx}")
 
         # the chunk's ONLY host sync: O(B*chunk) bools + (B, n) distances
         if checks:
@@ -717,6 +793,13 @@ def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
                     invlib.raise_on_violation(inv_codes[b],
                                               trial=torig[b],
                                               tick0=ticks_done)
+        if tel_on:
+            # per-trial chunk-final counters ((B,) leaves on this same
+            # sync); finished rows stop publishing (their counters froze)
+            for b, fsm in enumerate(fsms):
+                if not fsm.done:
+                    publisher.publish(torig[b],
+                                      devtel.to_host(summ.tel, index=b))
         ticks_done += chunk
         conv = np.asarray(summ.conv_all)
         grid = np.asarray(summ.grid_any)
@@ -745,8 +828,13 @@ def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
             if idx is None or fsm.done:
                 continue
             spec = specs_per[b][idx]
+            solve_st = None
             if idx not in gains_cache[b]:
-                gains_cache[b][idx] = _dispatch_gains(cfg, spec, n)
+                if tel_on:
+                    g, solve_st = _dispatch_gains(cfg, spec, n, stats=True)
+                    gains_cache[b][idx] = g
+                else:
+                    gains_cache[b][idx] = _dispatch_gains(cfg, spec, n)
             f_new = make_formation(
                 jnp.asarray(spec.points, dtype),
                 jnp.asarray(spec.adjmat, dtype),
@@ -758,6 +846,12 @@ def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
                 tick=bstate.tick.at[b].set(0),
                 first_auction=bstate.first_auction.at[b].set(True),
                 assign_enabled=bstate.assign_enabled.at[b].set(True))
+            if tel_on and solve_st is not None:
+                bstate = bstate.replace(tel=bstate.tel.replace(
+                    admm_iters=bstate.tel.admm_iters.at[b].set(
+                        solve_st.iters),
+                    admm_residual=bstate.tel.admm_residual.at[b].set(
+                        solve_st.residual)))
             fsm.formation_dispatched()
 
         # --- chunk boundary: checkpoint (compaction-safe), then the
@@ -979,6 +1073,11 @@ def run_trials(cfg: TrialConfig) -> dict:
         stats = analyze(np.empty((0, 0)), n or 0, cfg.trials)
     if exec_meta:
         stats["resilience"] = exec_meta
+    if cfg.telemetry_dump:
+        from aclswarm_tpu.telemetry import get_registry
+        get_registry().dump(cfg.telemetry_dump)
+        if cfg.verbose:
+            print(f"telemetry: wrote {cfg.telemetry_dump}")
     if cfg.verbose:
         print_analysis(stats)
     return stats

@@ -57,7 +57,8 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def sim_state_sharding(mesh: Mesh, localization: bool = False,
                        faults: bool = False,
-                       checks: bool = False) -> sim.SimState:
+                       checks: bool = False,
+                       telemetry: bool = False) -> sim.SimState:
     """Sharding pytree for `sim.SimState`: per-agent leaves row-sharded.
 
     ``localization=True`` matches states built with
@@ -75,9 +76,16 @@ def sim_state_sharding(mesh: Mesh, localization: bool = False,
     ``checks=True`` matches states built with
     ``init_state(..., checks=True)``: the swarmcheck error carry is a
     pair of scalars, replicated (every shard records the identical
-    first-violation code)."""
+    first-violation code).
+
+    ``telemetry=True`` matches states built with
+    ``init_state(..., telemetry=True)``: the swarmscope counter carry
+    (`telemetry.device.ChunkTelemetry`) is a handful of scalars,
+    replicated exactly like the swarmcheck carry (every shard
+    accumulates the identical counters)."""
     from aclswarm_tpu.analysis.invariants import InvariantState
     from aclswarm_tpu.faults import FaultSchedule
+    from aclswarm_tpu.telemetry.device import ChunkTelemetry
 
     row = row_sharding(mesh)
     rep = replicated(mesh)
@@ -91,7 +99,11 @@ def sim_state_sharding(mesh: Mesh, localization: bool = False,
         flight=sim.FlightState(mode=row, ticks_in_mode=row,
                                initial_alt=row, takeoff_alt=row),
         loc=loc, first_auction=rep, assign_enabled=rep, faults=fsched,
-        inv=InvariantState(code=rep, tick=rep) if checks else None)
+        inv=InvariantState(code=rep, tick=rep) if checks else None,
+        tel=ChunkTelemetry(auctions=rep, assign_rounds=rep, reassigns=rep,
+                           ca_ticks=rep, flood_stale_max=rep,
+                           admm_iters=rep, admm_residual=rep)
+        if telemetry else None)
 
 
 def formation_sharding(mesh: Mesh) -> Formation:
@@ -108,7 +120,8 @@ def shard_problem(state: sim.SimState, formation, mesh: Mesh):
     """Place a sim state + formation onto the mesh with the standard layout."""
     st_sh = sim_state_sharding(mesh, localization=state.loc is not None,
                                faults=state.faults is not None,
-                               checks=state.inv is not None)
+                               checks=state.inv is not None,
+                               telemetry=state.tel is not None)
     f_sh = formation_sharding(mesh)
     return (jax.device_put(state, st_sh), jax.device_put(formation, f_sh),
             st_sh, f_sh)

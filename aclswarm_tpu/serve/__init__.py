@@ -35,10 +35,11 @@ from aclswarm_tpu.serve.api import (COMPLETED, FAILED, PREEMPTED, QUEUED,
 from aclswarm_tpu.serve.client import probe_backend, submit_and_wait
 from aclswarm_tpu.serve.service import (BUILTIN_KINDS, ServiceConfig,
                                         SwarmService)
+from aclswarm_tpu.serve.stats import ServeStats
 
 __all__ = [
     "COMPLETED", "FAILED", "PREEMPTED", "QUEUED", "RUNNING", "TERMINAL",
     "TIMED_OUT", "ChunkEvent", "RejectedError", "Request", "Result",
     "ServeError", "Ticket", "probe_backend", "submit_and_wait",
-    "BUILTIN_KINDS", "ServiceConfig", "SwarmService",
+    "BUILTIN_KINDS", "ServiceConfig", "SwarmService", "ServeStats",
 ]

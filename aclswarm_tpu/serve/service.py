@@ -61,6 +61,8 @@ from aclswarm_tpu.serve.api import (COMPLETED, E_DEADLINE, E_EXECUTION,
                                     PREEMPTED, QUEUED, RUNNING, TIMED_OUT,
                                     ChunkEvent, RejectedError, Request,
                                     Result, ServeError, Ticket)
+from aclswarm_tpu.serve.stats import ServeStats
+from aclswarm_tpu.telemetry import MetricsRegistry
 from aclswarm_tpu.utils import get_logger
 from aclswarm_tpu.utils.retry import RetryPolicy
 
@@ -274,6 +276,12 @@ class SwarmService:
         self.stats = {"accepted": 0, "completed": 0, "rejected": 0,
                       "preempted": 0, "timed_out": 0, "failed": 0,
                       "resumed": 0, "chunks": 0, "rounds": 0}
+        # swarmscope (docs/OBSERVABILITY.md): a PRIVATE registry per
+        # service — the soak runs a crashed service and its reference
+        # oracle in one process, and their ledgers must not mix.
+        # Created before _recover(): recovery re-admissions and replayed
+        # terminal results count like live traffic.
+        self.telemetry = MetricsRegistry()
         self._journal = Path(cfg.journal_dir) if cfg.journal_dir else None
         self._ckpt_dir = (self._journal / "ckpt"
                           if self._journal is not None else None)
@@ -352,6 +360,11 @@ class SwarmService:
                 self._jobs.pop(rid, None)
                 if rejected:
                     self.stats["rejected"] += 1
+            if rejected:
+                # admission ledger + the backpressure hints handed out
+                self.telemetry.counter("serve_rejected_total").inc()
+                self.telemetry.histogram("serve_retry_after_s").observe(
+                    e.retry_after_s)
             self._adm.cancel(job)
             if self._journal is not None:
                 self._req_path(rid).unlink(missing_ok=True)
@@ -367,6 +380,7 @@ class SwarmService:
         with self._lock:
             self.stats["accepted"] += 1
             orphaned = self._closed
+        self.telemetry.counter("serve_accepted_total").inc()
         if orphaned:
             # close() raced this submit and its cleanup sweep already
             # ran: nobody is left to schedule the job, so honor the
@@ -484,11 +498,15 @@ class SwarmService:
                 # with the batch picked and its rollouts mid-flight —
                 # the journal + checkpoints are all that survives
                 maybe_crash(CRASH_SITE, self._round)
-                if jobs[0].bucket[0] == "rollout":
-                    self._rollout_round(jobs)
-                else:
-                    for job in jobs:
-                        self._single(job)
+                with self.telemetry.span("serve.round",
+                                         round=self._round,
+                                         bucket=str(jobs[0].bucket[0]),
+                                         batch=len(jobs)):
+                    if jobs[0].bucket[0] == "rollout":
+                        self._rollout_round(jobs)
+                    else:
+                        for job in jobs:
+                            self._single(job)
             except InjectedCrash as e:
                 # scripted preemption: the worker dies HERE, mid-batch,
                 # leaving only the journal + checkpoints (quietly — a
@@ -615,6 +633,7 @@ class SwarmService:
         with self._lock:
             self.stats["chunks"] += len(live)
         self._adm.note_service((time.monotonic() - t0) / max(1, B))
+        self._sample_boundary(len(live))
 
         for job in live:
             if job.chunks_done >= job.chunks_total:
@@ -642,6 +661,7 @@ class SwarmService:
                 job.preemptions += 1
                 with self._lock:
                     self.stats["preempted"] += 1
+                self.telemetry.counter("serve_preempted_total").inc()
             # durability checkpoint every chunk when journaled: a
             # SIGKILL between rounds costs at most one chunk of work
             if self._ckpt_dir is not None:
@@ -676,6 +696,7 @@ class SwarmService:
         value = self._execu.run(lambda: fn(job.req.params),
                                 stage=f"{kind}:{job.req.request_id}")
         self._adm.note_service(time.monotonic() - t0)
+        self._sample_boundary(1)
         if self._expired(job):
             self._timeout(job, late=True)
             return
@@ -778,6 +799,12 @@ class SwarmService:
                     tenant=job.req.tenant, req_kind=job.req.kind,
                     t_done=t_done))
         job.status = status
+        self.telemetry.counter("serve_" + {
+            COMPLETED: "completed", TIMED_OUT: "deadline_miss",
+            FAILED: "failed"}[status] + "_total").inc()
+        self.telemetry.histogram(
+            "serve_latency_s",
+            labels={"tenant": job.req.tenant}).observe(res.latency_s)
         with self._lock:
             key = {COMPLETED: "completed", TIMED_OUT: "timed_out",
                    FAILED: "failed"}[status]
@@ -842,10 +869,12 @@ class SwarmService:
                 job.resumed = True
                 with self._lock:
                     self.stats["resumed"] += 1
+                self.telemetry.counter("serve_resumed_total").inc()
             self._jobs[rid] = job
             self._adm.admit(job, force=True)
             with self._lock:
                 self.stats["accepted"] += 1
+            self.telemetry.counter("serve_accepted_total").inc()
         if self._jobs:
             self.log.warning(
                 "serve recovery: re-admitted %d unfinished request(s) "
@@ -853,6 +882,29 @@ class SwarmService:
                 self._journal, len(self._done_prior))
 
     # --------------------------------------------------------- telemetry
+
+    def _sample_boundary(self, live: int) -> None:
+        """Chunk-boundary scheduler gauges (docs/OBSERVABILITY.md): the
+        batch-bucket occupancy (live device-batch slots / max_batch —
+        the continuous-batching fill factor `serve_throughput` plots)
+        and the admission queue depth, recorded both as last-value
+        gauges and as distributions over the run."""
+        t = self.telemetry
+        occ = live / max(1, self.cfg.max_batch)
+        depth = self._adm.pending()
+        # gauges and their distributions carry DISTINCT names (_hist):
+        # snapshot() keys by name+labels and Prometheus forbids two
+        # families sharing one name, so a collision would corrupt both
+        # export surfaces
+        t.gauge("serve_bucket_occupancy").set(occ)
+        t.histogram("serve_bucket_occupancy_hist").observe(occ)
+        t.gauge("serve_queue_depth").set(depth)
+        t.histogram("serve_queue_depth_hist").observe(depth)
+
+    def serve_stats(self) -> ServeStats:
+        """Plain-data swarmscope snapshot of this service's registry
+        (`serve.stats.ServeStats`; docs/OBSERVABILITY.md)."""
+        return ServeStats.of(self)
 
     def row_fields(self) -> dict:
         """Executor + service counters for results-JSON rows (the same

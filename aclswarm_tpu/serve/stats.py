@@ -1,0 +1,108 @@
+"""ServeStats — the swarmserve telemetry surface (docs/SERVICE.md,
+docs/OBSERVABILITY.md).
+
+Every `SwarmService` owns a private `MetricsRegistry` (services must
+not cross-pollute — the soak runs a crashed service and a reference
+service in one process) and records into it:
+
+- **admission counters**: ``serve_accepted_total``,
+  ``serve_rejected_total`` + the ``serve_retry_after_s`` histogram of
+  backpressure hints handed out;
+- **lifecycle counters**: completed / failed / preempted / resumed /
+  ``serve_deadline_miss_total`` (the timed-out ledger);
+- **scheduler gauges, sampled at every chunk boundary** (the service's
+  only scheduling points): ``serve_queue_depth`` and
+  ``serve_bucket_occupancy`` (live jobs / max_batch slots — the
+  continuous-batching fill factor the `serve_throughput` artifact
+  plots), plus the ``*_hist``-suffixed distributions so a run reports
+  percentiles, not last values (distinct names: two export families
+  must never share one);
+- **per-tenant end-to-end latency** histograms
+  (``serve_latency_s{tenant=...}``): accept -> terminal wall seconds,
+  observed in `_finish` for every terminal status;
+- **round spans** in the registry's flight recorder (name
+  ``serve.round``, attrs: round index, bucket, batch size).
+
+`ServeStats.of(service)` reduces that registry to one plain-data
+record; `.compact()` is the three-number summary `bench.py` embeds in
+its structured row.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+__all__ = ["ServeStats"]
+
+
+@dataclasses.dataclass
+class ServeStats:
+    """Plain-data snapshot of one service's telemetry registry."""
+
+    counts: dict                 # accepted/rejected/completed/... ints
+    queue_depth: int             # last sampled depth (chunk boundary)
+    occupancy: float             # last sampled live/max_batch fill
+    occupancy_mean: float        # mean over all sampled rounds
+    occupancy_p95: float
+    queue_depth_mean: float
+    queue_depth_p95: float
+    latency_s: dict              # tenant -> {count, p50, p95, p99}
+    rounds: int                  # scheduler rounds executed
+    chunks: int                  # device chunks executed
+    spans_recorded: int
+
+    @classmethod
+    def of(cls, service) -> "ServeStats":
+        reg = service.telemetry
+        counts = {}
+        for key in ("accepted", "rejected", "completed", "failed",
+                    "preempted", "resumed", "deadline_miss"):
+            counts[key] = int(reg.counter(f"serve_{key}_total").value)
+        occ = reg.histogram("serve_bucket_occupancy_hist")
+        dep = reg.histogram("serve_queue_depth_hist")
+        occ_row, dep_row = occ.to_row(), dep.to_row()
+        lat = {}
+        for m in reg.metrics():
+            if m.name == "serve_latency_s" and m.labels.get("tenant"):
+                row = m.to_row()
+                lat[m.labels["tenant"]] = {
+                    "count": row["count"],
+                    "p50": row.get("p50"), "p95": row.get("p95"),
+                    "p99": row.get("p99")}
+        with service._lock:
+            rounds = int(service.stats.get("rounds", 0))
+            chunks = int(service.stats.get("chunks", 0))
+        return cls(
+            counts=counts,
+            queue_depth=int(reg.gauge("serve_queue_depth").value),
+            occupancy=float(reg.gauge("serve_bucket_occupancy").value),
+            occupancy_mean=float(occ_row.get("mean", 0.0)),
+            occupancy_p95=float(occ_row.get("p95", 0.0)),
+            queue_depth_mean=float(dep_row.get("mean", 0.0)),
+            queue_depth_p95=float(dep_row.get("p95", 0.0)),
+            latency_s=lat, rounds=rounds, chunks=chunks,
+            spans_recorded=int(reg.recorder.recorded))
+
+    def compact(self) -> dict:
+        """The bench-row summary: bucket occupancy, queue depth,
+        preemption count (plus the admission ledger) — small enough to
+        ride every structured one-line row, degraded ones included."""
+        return {
+            "occupancy_mean": round(self.occupancy_mean, 3),
+            "queue_depth": self.queue_depth,
+            "preempted": self.counts.get("preempted", 0),
+            "accepted": self.counts.get("accepted", 0),
+            "rejected": self.counts.get("rejected", 0),
+            "deadline_miss": self.counts.get("deadline_miss", 0),
+        }
+
+    @staticmethod
+    def empty_compact() -> dict:
+        """The same key set, zeroed — degraded rows where no service
+        ever started (probe failure, watchdog) still carry the
+        telemetry block so row consumers need no key-presence logic."""
+        return {"occupancy_mean": 0.0, "queue_depth": 0, "preempted": 0,
+                "accepted": 0, "rejected": 0, "deadline_miss": 0}
+
+    def to_row(self) -> dict:
+        return dataclasses.asdict(self)

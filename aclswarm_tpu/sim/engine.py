@@ -52,6 +52,8 @@ from aclswarm_tpu.sim import localization as loclib
 from aclswarm_tpu.sim import vehicle
 from aclswarm_tpu.sim.localization import EstimateTable
 from aclswarm_tpu.sim.vehicle import ExternalInputs, FlightState
+from aclswarm_tpu.telemetry import device as devtel
+from aclswarm_tpu.telemetry.device import ChunkTelemetry
 
 
 @struct.dataclass
@@ -129,6 +131,16 @@ class SimConfig:
     # trial into the `SimState.inv` carry (requires
     # `init_state(..., checks=True)`)
     check_mode: str = struct.field(pytree_node=False, default="off")
+    # swarmscope device counters (`aclswarm_tpu.telemetry.device`):
+    # 'off' = no counters, PROVEN zero-cost exactly like check_mode
+    # (every accumulation site is Python-gated on this static flag, so
+    # the lowered HLO is bit-identical to the uninstrumented program —
+    # the same committed-baseline proof, `trace_audit
+    # .verify_zero_cost_off`); 'on' = accumulate auction/CBAA rounds to
+    # consensus, accepted-reassignment churn, flood staleness, and
+    # collision-avoidance activations into the `SimState.tel` carry
+    # (requires `init_state(..., telemetry=True)`)
+    telemetry: str = struct.field(pytree_node=False, default="off")
 
 
 @struct.dataclass
@@ -168,6 +180,12 @@ class SimState:
     # records the first contract violation (code + per-trial tick) as
     # plain data, so batched trials attribute violations per trial.
     inv: InvariantState | None = None
+    # swarmscope counter carry (`telemetry.device`): None = telemetry
+    # structurally absent (the zero-cost-off mode). A `ChunkTelemetry`
+    # accumulates the paper's evaluation signals (auction rounds,
+    # churn, staleness, CA activity) per trial; it checkpoints with the
+    # state and its per-tick snapshot rides the existing chunk syncs.
+    tel: ChunkTelemetry | None = None
 
 
 @struct.dataclass
@@ -190,12 +208,18 @@ class StepMetrics:
     # (`analysis.invariants.CONTRACTS`) — rides the metric stack so
     # drivers surface (trial, tick, contract) without extra host syncs
     inv_code: jnp.ndarray | None = None     # () int32
+    # swarmscope carry snapshot after the tick (None unless
+    # cfg.telemetry='on'): trial-cumulative counters riding the metric
+    # stack — chunked drivers read the chunk-final element, O(1) per
+    # chunk per counter, zero extra syncs
+    tel: ChunkTelemetry | None = None
 
 
 def init_state(q0, v2f0=None, flying: bool = True,
                localization: bool = False,
                faults: FaultSchedule | None = None,
-               checks: bool = False) -> SimState:
+               checks: bool = False,
+               telemetry: bool = False) -> SimState:
     """``flying=True`` starts airborne in FLYING (historical rollouts);
     ``flying=False`` starts NOT_FLYING on the ground — send CMD_GO via
     `ExternalInputs` to take off (requires ``cfg.flight_fsm``).
@@ -204,7 +228,9 @@ def init_state(q0, v2f0=None, flying: bool = True,
     ``faults`` attaches a fault script (`aclswarm_tpu.faults`); None keeps
     the fault-free engine.
     ``checks=True`` allocates the swarmcheck error carry (required iff
-    the rollout runs with ``cfg.check_mode='on'``)."""
+    the rollout runs with ``cfg.check_mode='on'``).
+    ``telemetry=True`` allocates the swarmscope counter carry (required
+    iff the rollout runs with ``cfg.telemetry='on'``)."""
     # explicit strong dtype: a dtype-less asarray would inherit whatever
     # the caller passed (list vs np array vs f32 array), and every distinct
     # aval retraces the whole rollout (jaxcheck JC003)
@@ -221,7 +247,8 @@ def init_state(q0, v2f0=None, flying: bool = True,
         loc=loclib.init_table(q0) if localization else None,
         first_auction=jnp.asarray(True),
         faults=faults,
-        inv=invlib.init_invariants() if checks else None)
+        inv=invlib.init_invariants() if checks else None,
+        tel=devtel.init_telemetry(dtype=q0.dtype) if telemetry else None)
 
 
 def assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
@@ -229,11 +256,16 @@ def assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
            first: jnp.ndarray | None = None,
            alive: jnp.ndarray | None = None,
            link_mask: jnp.ndarray | None = None,
-           check: bool = False):
+           check: bool = False, tel: bool = False):
     """One re-assignment: returns (new v2f, valid flag) — plus a ()
     int32 swarmcheck code (0 = clean) when ``check`` is set, carrying
     solver-level contract violations (currently the Sinkhorn marginal
-    tolerance) out of the assignment `lax.cond` branch.
+    tolerance) out of the assignment `lax.cond` branch; plus a ()
+    int32 rounds-to-consensus count when ``tel`` is set (swarmscope:
+    auction bid rounds / CBAA consensus rounds; 0 for the
+    fixed-iteration Sinkhorn solve and the 'none' mode), appended
+    LAST — the flag-gated returns compose as (v2f, valid[, code]
+    [, rounds]).
 
     'auction' follows the centralized path (`assignment.py:94-137`): order the
     swarm by the *last* assignment, globally align the formation (d=2), then
@@ -280,6 +312,19 @@ def assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
         return jnp.where(take, cand, v2f)
 
     clean = jnp.zeros((), jnp.int32)
+    zero_rounds = jnp.zeros((), jnp.int32)
+
+    def _ret(new_v2f, valid, code, rounds):
+        """Compose the flag-gated return: (v2f, valid[, code][, rounds]).
+        Python-gated on the STATIC flags, so check=tel=False lowers to
+        the historical two-tuple program bit-identically."""
+        out = (new_v2f, valid)
+        if check:
+            out = out + (code,)
+        if tel:
+            out = out + (rounds.astype(jnp.int32),)
+        return out
+
     if cfg.assignment == "auction":
         q_form = permutil.veh_to_formation_order(swarm.q, v2f)
         paligned = geometry.align(formation.points, q_form, d=2)
@@ -288,9 +333,7 @@ def assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
             c = faultmask.mask_cost(c, alive, v2f)
         res = auction.auction_lap(-c)
         new_v2f = jnp.where(res.valid, _hysteresis(res.row_to_col, c), v2f)
-        if check:
-            return new_v2f, res.valid, clean
-        return new_v2f, res.valid
+        return _ret(new_v2f, res.valid, clean, res.iters)
     elif cfg.assignment == "sinkhorn":
         q_form = permutil.veh_to_formation_order(swarm.q, v2f)
         paligned = geometry.align(formation.points, q_form, d=2)
@@ -306,6 +349,7 @@ def assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
                 c = faultmask.mask_cost(c, alive, v2f)
         else:
             c = None  # cfg is static; skip the matrix when unused
+        code = clean
         if check:
             # marginal contract on the *transport plan* the rounding
             # consumed (the rounded permutation itself is covered by the
@@ -315,21 +359,17 @@ def assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
                 invlib.sinkhorn_marginals_violated(row_err, col_err),
                 jnp.asarray(invlib.CODES["sinkhorn_marginal"], jnp.int32),
                 clean)
-            return _hysteresis(res.row_to_col, c), jnp.asarray(True), code
-        return _hysteresis(res.row_to_col, c), jnp.asarray(True)
+        return _ret(_hysteresis(res.row_to_col, c), jnp.asarray(True),
+                    code, zero_rounds)
     elif cfg.assignment == "cbaa":
         res = cbaa.cbaa_from_state(swarm.q, formation.points,
                                    formation.adjmat, v2f, est=est,
                                    task_block=cfg.cbaa_task_block,
                                    alive=alive, comm_extra=link_mask)
         new_v2f = jnp.where(res.valid, res.v2f, v2f)
-        if check:
-            return new_v2f, res.valid, clean
-        return new_v2f, res.valid
+        return _ret(new_v2f, res.valid, clean, res.rounds)
     elif cfg.assignment == "none":
-        if check:
-            return v2f, jnp.asarray(True), clean
-        return v2f, jnp.asarray(True)
+        return _ret(v2f, jnp.asarray(True), clean, zero_rounds)
     raise ValueError(f"unknown assignment mode {cfg.assignment!r}")
 
 
@@ -375,6 +415,20 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
         inv = invlib.record(inv,
                             invlib.adjacency_asymmetric(formation.adjmat),
                             "adj_sym", state.tick)
+
+    # --- swarmscope counters (`telemetry.device`): same zero-cost rule —
+    # every accumulation below is Python-gated on the STATIC
+    # `cfg.telemetry`, so 'off' lowers to bit-identical HLO (proven by
+    # the same committed baseline, `trace_audit.verify_zero_cost_off`).
+    if cfg.telemetry not in ("off", "on"):
+        raise ValueError(f"unknown telemetry mode {cfg.telemetry!r}")
+    tel_on = cfg.telemetry == "on"
+    tel = state.tel
+    if tel_on and tel is None:
+        raise ValueError(
+            "cfg.telemetry='on' needs init_state(..., telemetry=True): "
+            "the swarmscope counters accumulate into the SimState.tel "
+            "carry, which must exist in the state pytree")
 
     # --- fault model (`aclswarm_tpu.faults`): masks, not control flow ---
     # keyed on the PER-TRIAL `state.tick` (plain data, so batched trials
@@ -443,40 +497,44 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
     gate = state.assign_enabled
     if cfg.flight_fsm:
         gate = gate & jnp.all(flying)
+    cand_rounds = None
     if cfg.assignment == "none":
         new_v2f, valid = v2f, jnp.asarray(True)
         take = jnp.asarray(False)
-    elif checks:
-        # checked variant of the cond below: the solver-level contract
-        # code rides out of the branch alongside the candidate (the
-        # no-assign branch reports clean)
-        cand_v2f, cand_valid, cand_code = lax.cond(
-            do_assign,
-            lambda s, f, p, e: assign(s, f, p, cfg, e,
-                                      first=state.first_auction,
-                                      alive=alive, link_mask=link_mask,
-                                      check=True),
-            lambda s, f, p, e: (p, jnp.asarray(True),
-                                jnp.zeros((), jnp.int32)),
-            swarm, formation, v2f, est)
-        take = do_assign & gate
-        new_v2f = jnp.where(take, cand_v2f, v2f)
-        valid = jnp.where(take, cand_valid, True)
-        # a gated-off candidate is discarded, so its violations are too
-        inv = invlib.record_code(
-            inv, jnp.where(take, cand_code, jnp.zeros((), jnp.int32)),
-            state.tick)
     else:
-        cand_v2f, cand_valid = lax.cond(
-            do_assign,
-            lambda s, f, p, e: assign(s, f, p, cfg, e,
-                                      first=state.first_auction,
-                                      alive=alive, link_mask=link_mask),
-            lambda s, f, p, e: (p, jnp.asarray(True)),
-            swarm, formation, v2f, est)
+        # the solver-level swarmcheck code (when checks) and the
+        # swarmscope rounds-to-consensus count (when tel_on) ride out of
+        # the branch alongside the candidate, in `assign`'s flag-gated
+        # return order (v2f, valid[, code][, rounds]); the no-assign
+        # branch reports clean / zero rounds
+        def _run(s, f, p, e):
+            return assign(s, f, p, cfg, e, first=state.first_auction,
+                          alive=alive, link_mask=link_mask,
+                          check=checks, tel=tel_on)
+
+        def _hold(s, f, p, e):
+            out = (p, jnp.asarray(True))
+            if checks:
+                out = out + (jnp.zeros((), jnp.int32),)
+            if tel_on:
+                out = out + (jnp.zeros((), jnp.int32),)
+            return out
+
+        outs = lax.cond(do_assign, _run, _hold, swarm, formation, v2f, est)
+        cand_v2f, cand_valid = outs[0], outs[1]
         take = do_assign & gate
         new_v2f = jnp.where(take, cand_v2f, v2f)
         valid = jnp.where(take, cand_valid, True)
+        i = 2
+        if checks:
+            # a gated-off candidate is discarded, so its violations are
+            # too
+            inv = invlib.record_code(
+                inv, jnp.where(take, outs[i], jnp.zeros((), jnp.int32)),
+                state.tick)
+            i += 1
+        if tel_on:
+            cand_rounds = outs[i]
     reassigned = take & jnp.any(new_v2f != v2f)
     auctioned = take
     first_auction = state.first_auction & ~(auctioned & valid)
@@ -580,17 +638,34 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
         inv = invlib.record(inv, invlib.out_of_bounds(swarm.q, sparams),
                             "state_bounds", state.tick)
 
+    # --- swarmscope accumulation (after every mask is final: `ca` here
+    # is what actually flew — flight- and fault-masked) ---
+    if tel_on:
+        rounds_add = jnp.zeros((), jnp.int32) if cand_rounds is None \
+            else jnp.where(take, cand_rounds,
+                           jnp.zeros((), jnp.int32))
+        stale = tel.flood_stale_max
+        if cfg.localization == "flooded":
+            stale = jnp.maximum(stale, jnp.max(loc.age).astype(jnp.int32))
+        tel = tel.replace(
+            auctions=tel.auctions + take.astype(jnp.int32),
+            assign_rounds=tel.assign_rounds + rounds_add,
+            reassigns=tel.reassigns + reassigned.astype(jnp.int32),
+            ca_ticks=tel.ca_ticks + jnp.sum(ca, dtype=jnp.int32),
+            flood_stale_max=stale)
+
     new_state = SimState(swarm=swarm, goal=goal, v2f=v2f,
                          tick=state.tick + 1, flight=fs, loc=loc,
                          first_auction=first_auction,
                          assign_enabled=state.assign_enabled,
-                         faults=faults, inv=inv)
+                         faults=faults, inv=inv, tel=tel)
     return new_state, StepMetrics(distcmd_norm=distcmd_norm, ca_active=ca,
                                   assign_valid=valid, reassigned=reassigned,
                                   auctioned=auctioned, q=swarm.q,
                                   mode=fs.mode, v2f=v2f,
                                   alive=alive, fault_event=fault_event,
-                                  inv_code=inv.code if checks else None)
+                                  inv_code=inv.code if checks else None,
+                                  tel=tel if tel_on else None)
 
 
 @partial(jax.jit, static_argnames=("n_ticks", "cfg"))

@@ -45,6 +45,7 @@ from jax import lax
 
 from aclswarm_tpu.sim import engine, vehicle
 from aclswarm_tpu.sim.engine import StepMetrics
+from aclswarm_tpu.telemetry.device import ChunkTelemetry
 
 # supervisor thresholds (single source: `harness.supervisor` mirrors the
 # reference `supervisor.py:60-62,83`; duplicated here as module constants
@@ -96,6 +97,11 @@ class ChunkSummary:
     # decode them with `analysis.invariants.first_violation`, riding the
     # sync they already do per chunk
     inv_code: jnp.ndarray | None = None        # (T,) int32
+    # swarmscope chunk-final counter snapshot (None unless the rollout
+    # ran with cfg.telemetry='on'): the carry's value after the chunk's
+    # LAST tick — trial-cumulative, O(1) per chunk per counter, riding
+    # this same sync (`telemetry.device.ChunkTelemetry`)
+    tel: ChunkTelemetry | None = None
 
 
 def init_carry(n: int, window: int, dtype=jnp.float32,
@@ -226,6 +232,10 @@ def summarize_chunk(metrics: StepMetrics, carry: SummaryCarry,
         fault_kw = {}
     if metrics.inv_code is not None:
         fault_kw["inv_code"] = metrics.inv_code
+    if metrics.tel is not None:
+        # counters are trial-cumulative: the chunk-final element is the
+        # whole chunk's story (drivers difference across chunks)
+        fault_kw["tel"] = jax.tree.map(lambda x: x[-1], metrics.tel)
 
     summary = ChunkSummary(
         conv_all=conv_all,

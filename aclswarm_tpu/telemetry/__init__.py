@@ -1,0 +1,29 @@
+"""swarmscope — the unified telemetry layer (docs/OBSERVABILITY.md).
+
+Three tiers, one substrate:
+
+- **host metrics** (`telemetry.registry`): thread-safe counters, gauges,
+  bounded histograms (p50/p95/p99) and span tracing with a ring-buffer
+  flight recorder, exported as a snapshot dict, JSONL, and Prometheus
+  text. `utils.log` counts records into it, `utils.timing.timing_stats`
+  feeds named histograms, swarmserve owns one per service (`ServeStats`).
+- **device chunk counters** (`telemetry.device`, imported explicitly —
+  it pulls in jax): the `ChunkTelemetry` carry threaded through the
+  rollout scan exactly like the swarmcheck `InvariantState` — auction/
+  CBAA rounds to consensus, reassignment churn, flood staleness,
+  collision-avoidance activations, ADMM iterations + final residual —
+  aggregated on device, riding the existing chunk syncs, and PROVEN
+  zero-cost when off (the committed HLO baseline is unchanged).
+- **profiler hooks**: opt-in `jax.profiler` captures per chosen chunk
+  (`harness.trials --set profile_dir=...`, `bench.py --profile-dir`).
+
+This package __init__ stays stdlib-only on purpose: `utils.log` and
+`utils.timing` import it at configure time and must not drag jax in.
+"""
+from aclswarm_tpu.telemetry.registry import (Counter, Gauge, Histogram,
+                                             MetricsRegistry, get_registry,
+                                             reset_registry)
+from aclswarm_tpu.telemetry.spans import FlightRecorder, Span
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "get_registry", "reset_registry", "FlightRecorder", "Span"]

@@ -1,0 +1,93 @@
+"""Span flight recorder: the last N timed spans, always on, O(N) forever.
+
+The reference's tracing story was wall-clock log lines
+(`coordination_ros.cpp:113-118`); `utils.timing.trace` added opt-in
+`jax.profiler` captures. Between "nothing" and "a full profiler trace"
+sits the flight recorder: a bounded ring of the most recent spans
+(name, wall start, duration, attrs) that costs two list writes per span
+and can be dumped after the fact — when a soak goes sideways, the last
+1024 spans ARE the incident timeline, no foresight required.
+
+Wraparound drops the OLDEST spans (and counts the drops loudly in
+`dropped`): a flight recorder that refuses new evidence once full would
+record the boring startup and miss the crash.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+__all__ = ["Span", "FlightRecorder"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Span:
+    """One timed block. ``seq`` is assigned by the recorder (global
+    order survives the ring wraparound)."""
+
+    name: str
+    t_wall: float            # wall-clock start (epoch seconds)
+    dur_s: float
+    attrs: dict = dataclasses.field(default_factory=dict)
+    seq: int = -1
+
+    def to_row(self) -> dict:
+        row = {"span": self.name, "seq": self.seq,
+               "t_wall": self.t_wall, "dur_s": self.dur_s}
+        if self.attrs:
+            row["attrs"] = dict(self.attrs)
+        return row
+
+
+class FlightRecorder:
+    """Thread-safe bounded span ring (newest ``capacity`` retained)."""
+
+    def __init__(self, capacity: int = 1024):
+        if capacity < 1:
+            raise ValueError("flight recorder capacity must be >= 1")
+        self._cap = int(capacity)
+        self._ring: list[Optional[Span]] = []
+        self._next = 0
+        self._seq = 0
+        self._dropped = 0
+        self._lock = threading.Lock()
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    @property
+    def recorded(self) -> int:
+        """Total spans ever recorded (retained + dropped)."""
+        with self._lock:
+            return self._seq
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def record(self, span: Span) -> Span:
+        with self._lock:
+            stamped = dataclasses.replace(span, seq=self._seq)
+            self._seq += 1
+            if len(self._ring) < self._cap:
+                self._ring.append(stamped)
+            else:
+                self._ring[self._next] = stamped
+                self._dropped += 1
+            self._next = (self._next + 1) % self._cap
+            return stamped
+
+    def spans(self) -> list[Span]:
+        """Retained spans, oldest first (seq-ordered across wraparound)."""
+        with self._lock:
+            items = [s for s in self._ring if s is not None]
+        return sorted(items, key=lambda s: s.seq)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring = []
+            self._next = 0
+            self._dropped = 0

@@ -5,7 +5,10 @@ highlights (`coordination_ros.cpp:122-123`) and a `verbose` flag for the
 auction trace (`auctioneer.cpp:111-116`). Equivalent here: stdlib logging
 with a framework root logger, per-module children, an env knob
 (``ACLSWARM_LOG=debug`` or ``ACLSWARM_LOG=aclswarm_tpu.interop=debug``),
-and the same visual conventions on a tty.
+and the same visual conventions on a tty. Every framework record is
+additionally counted into the swarmscope registry
+(``log_records_total{level=...}`` — docs/OBSERVABILITY.md), so log
+volume by severity is a metric, not just a stream.
 
 Usage::
 
@@ -39,6 +42,24 @@ class _TtyFormatter(logging.Formatter):
         return msg
 
 
+class _TelemetryHandler(logging.Handler):
+    """Counts every framework log record into the swarmscope registry
+    (``log_records_total{level=...}``, docs/OBSERVABILITY.md): warn/
+    error rates become scrapeable metrics next to the counters they
+    explain — a soak whose error counter climbs is visible without
+    grepping its stderr. Always resolves the CURRENT default registry,
+    so `telemetry.reset_registry` (test isolation) is honored."""
+
+    def emit(self, record):
+        try:
+            from aclswarm_tpu.telemetry import get_registry
+            get_registry().counter(
+                "log_records_total",
+                labels={"level": record.levelname.lower()}).inc()
+        except Exception:       # noqa: BLE001 — logging must never raise
+            pass
+
+
 def _configure() -> None:
     global _configured
     if _configured:
@@ -51,6 +72,8 @@ def _configure() -> None:
             "[%(levelname).1s %(asctime)s %(name)s] %(message)s",
             datefmt="%H:%M:%S"))
         root.addHandler(handler)
+    if not any(isinstance(h, _TelemetryHandler) for h in root.handlers):
+        root.addHandler(_TelemetryHandler())
     root.setLevel(logging.INFO)
     # ACLSWARM_LOG=debug  or  ACLSWARM_LOG=<logger>=<level>,<logger>=...
     spec = os.environ.get("ACLSWARM_LOG", "")

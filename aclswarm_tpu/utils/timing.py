@@ -35,7 +35,8 @@ def readback_sync(x) -> float:
     return float(np.asarray(jax.tree.leaves(x)[0]).ravel()[0])
 
 
-def timing_stats(fn, arg, per: int = 1, reps: int = 5) -> dict:
+def timing_stats(fn, arg, per: int = 1, reps: int = 5,
+                 name: str | None = None, registry=None) -> dict:
     """Wall-second statistics of ``fn(arg)`` divided by ``per``, after one
     warmup call; ``fn`` should return a small digest (see
     `readback_sync`). For device work, chain ``per`` distinct instances
@@ -43,13 +44,27 @@ def timing_stats(fn, arg, per: int = 1, reps: int = 5) -> dict:
 
     Returns median plus the rep spread (min/max) so artifacts carry a
     jitter column — a single median hides tunnel hiccups and thermal
-    variance (the round-1 unexplained-variance lesson)."""
+    variance (the round-1 unexplained-variance lesson).
+
+    ``name`` additionally records every rep into the swarmscope
+    ``timing_<name>_s`` histogram (docs/OBSERVABILITY.md) — the default
+    process registry unless ``registry`` overrides it — so benchmark
+    timings and service latencies read out of ONE substrate. The
+    returned dict's key set is unchanged (the committed artifacts'
+    contract)."""
     readback_sync(fn(arg))
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
         readback_sync(fn(arg))
         times.append((time.perf_counter() - t0) / per)
+    if name is not None:
+        if registry is None:
+            from aclswarm_tpu.telemetry import get_registry
+            registry = get_registry()
+        hist = registry.histogram(f"timing_{name}_s")
+        for t in times:
+            hist.observe(t)
     return {"median_s": float(np.median(times)),
             "min_s": float(np.min(times)), "max_s": float(np.max(times)),
             "reps": reps}

@@ -67,7 +67,9 @@ PROBE_TIMEOUT_S = 120.0
 from aclswarm_tpu.serve.client import PROBE_CODE as _PROBE_CODE  # noqa: E402
 
 
-def _degraded_line(msg: str, serve_fields: dict | None = None) -> None:
+def _degraded_line(msg: str, serve_fields: dict | None = None,
+                   telemetry: dict | None = None) -> None:
+    from aclswarm_tpu.serve.stats import ServeStats
     row = {
         "metric": f"sinkhorn_assign_n{N}_hz",
         "value": 0.0,
@@ -75,6 +77,10 @@ def _degraded_line(msg: str, serve_fields: dict | None = None) -> None:
         "vs_baseline": 0.0,
         "degraded": True,
         "error": msg,
+        # compact swarmscope snapshot (docs/OBSERVABILITY.md): EVERY
+        # outcome carries the same telemetry block — a zeroed one when
+        # no service ever started (probe failure, watchdog fire)
+        "telemetry": telemetry or ServeStats.empty_compact(),
     }
     if serve_fields:
         row.update(serve_fields)
@@ -110,7 +116,16 @@ def _probe_device(timeout_s: float | None = None) -> str | None:
         code=_PROBE_CODE, cwd=str(Path(__file__).resolve().parent))
 
 
-def main():
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="n=1000 assignment throughput bench (one JSON row, "
+                    "rc=0)")
+    ap.add_argument("--profile-dir", default=None,
+                    help="opt-in swarmscope jax.profiler capture: write "
+                    "one trace of the measurement into this directory "
+                    "(TensorBoard/Perfetto; docs/OBSERVABILITY.md)")
+    args = ap.parse_args(argv)
     backend = _probe_device()
     if backend is None:
         _degraded_line(
@@ -135,9 +150,20 @@ def main():
     svc.register(
         "bench_sinkhorn",
         lambda p: sinkhorn_throughput(p["n"], p["K"], reps=p["reps"]))
-    ticket = svc.submit("bench_sinkhorn", {"n": N, "K": k, "reps": reps},
-                        tenant="bench", deadline_s=WATCHDOG_S - 120.0)
-    res = ticket.result(timeout=WATCHDOG_S)
+    import contextlib
+    if args.profile_dir:
+        # jax.profiler is process-global: a trace opened here captures
+        # the device work the service worker thread dispatches
+        from aclswarm_tpu.utils import timing as timinglib
+        prof = timinglib.trace(args.profile_dir)
+    else:
+        prof = contextlib.nullcontext()
+    with prof:
+        ticket = svc.submit("bench_sinkhorn",
+                            {"n": N, "K": k, "reps": reps},
+                            tenant="bench",
+                            deadline_s=WATCHDOG_S - 120.0)
+        res = ticket.result(timeout=WATCHDOG_S)
     # claim the output line the instant the measurement lands (ADVICE
     # r5: a timer firing between completion and post-processing must
     # not discard a finished measurement) — post-processing follows
@@ -145,11 +171,12 @@ def main():
         return 0             # pragma: no cover — fire() hard-exits
     svc.close()
     serve_fields = svc.row_fields()
+    telemetry = svc.serve_stats().compact()
     if not res.ok:
         _degraded_line(
             f"measurement request terminated {res.status}: "
             f"{res.error.code}: {res.error.message}",
-            serve_fields)
+            serve_fields, telemetry=telemetry)
         return 0
     sk = res.value
     row = {
@@ -176,6 +203,10 @@ def main():
         # any retry/degrade markers the executor recorded
         "serve": dict(serve_fields.get("serve", {}),
                       request_latency_s=round(res.latency_s, 2)),
+        # compact swarmscope snapshot (occupancy, queue depth,
+        # preemptions — docs/OBSERVABILITY.md); present on degraded
+        # rows too, so row consumers never branch on key presence
+        "telemetry": telemetry,
     }
     if not on_device:
         # a fallback backend is a DEGRADED capture by definition: same

@@ -53,6 +53,127 @@ _SOAK_KEYS = set(_SOAK_COUNTS) | {"name", "n", "backend",
                                   "wall_s", "quick"}
 _SOAK_PCTS = ("p50", "p95", "p99")
 
+# the serve_throughput artifact (benchmarks/serve_throughput.py; ROADMAP
+# open item 2(c)): JSON-lines, one row per offered-load level, exact key
+# set — request Hz vs batch-bucket occupancy is the continuous-batching
+# evidence, so a silently dropped occupancy column is evidence rot
+SERVE_THROUGHPUT = "serve_throughput.json"
+_THROUGHPUT_KEYS = {"name", "n", "backend", "offered_hz", "value",
+                    "unit", "occupancy_mean", "occupancy_p95",
+                    "queue_depth_mean", "queue_depth_p95", "accepted",
+                    "completed", "rejected", "preempted",
+                    "deadline_miss", "wall_s", "quick"}
+_THROUGHPUT_COUNTS = ("accepted", "completed", "rejected", "preempted",
+                      "deadline_miss")
+# minimum committed offered-load levels (the acceptance criterion)
+_THROUGHPUT_MIN_LEVELS = 3
+
+# the telemetry overhead artifact (aclswarm_tpu.telemetry.overhead):
+# exact key set per named row, and the <5% acceptance bar is part of
+# the schema — an artifact showing a regression must not pass silently
+TELEMETRY_OVERHEAD = "telemetry_overhead.json"
+_OVERHEAD_KEYS = {
+    "telemetry_overhead_frac_n10": {"name", "n", "value", "unit",
+                                    "wall_off_s", "wall_on_s", "chunks",
+                                    "reps", "note"},
+    "telemetry_publish_us": {"name", "n", "value", "unit", "note"},
+}
+_OVERHEAD_BAR = 0.05
+
+
+def _finite_num(v) -> bool:
+    return (isinstance(v, (int, float)) and not isinstance(v, bool)
+            and math.isfinite(v))
+
+
+def check_serve_throughput(rows: list, where: str) -> list[str]:
+    """Validate parsed serve_throughput rows (exact key set, count
+    sanity, occupancy in [0, 1], >= 3 non-quick offered-load levels)."""
+    probs = []
+    levels = set()
+    for i, row in enumerate(rows, 1):
+        at = f"{where}:{i}"
+        if not isinstance(row, dict):
+            probs.append(f"{at}: row is not a JSON object")
+            continue
+        missing = _THROUGHPUT_KEYS - set(row)
+        unknown = set(row) - _THROUGHPUT_KEYS
+        if missing:
+            probs.append(f"{at}: missing keys {sorted(missing)}")
+        if unknown:
+            probs.append(f"{at}: unknown keys {sorted(unknown)} "
+                         "(exact-key-set schema)")
+        if row.get("name") != "serve_throughput":
+            probs.append(f"{at}: 'name' must be 'serve_throughput'")
+        for k in ("offered_hz", "value", "wall_s", "queue_depth_mean",
+                  "queue_depth_p95"):
+            if k in row and not (_finite_num(row[k]) and row[k] >= 0):
+                probs.append(f"{at}: '{k}' must be a finite non-negative "
+                             f"number, got {row[k]!r}")
+        for k in ("occupancy_mean", "occupancy_p95"):
+            if k in row and not (_finite_num(row[k])
+                                 and 0.0 <= row[k] <= 1.0):
+                probs.append(f"{at}: '{k}' must be within [0, 1], got "
+                             f"{row[k]!r}")
+        for k in _THROUGHPUT_COUNTS:
+            if k in row and not _is_count(row[k]):
+                probs.append(f"{at}: '{k}' must be a non-negative int, "
+                             f"got {row[k]!r}")
+        if _is_count(row.get("accepted")) and _is_count(
+                row.get("completed")) \
+                and row["completed"] > row["accepted"]:
+            probs.append(f"{at}: completed ({row['completed']}) > "
+                         f"accepted ({row['accepted']})")
+        if "quick" in row and not isinstance(row["quick"], bool):
+            probs.append(f"{at}: 'quick' must be a bool")
+        if _finite_num(row.get("offered_hz")) and not row.get("quick"):
+            levels.add(row["offered_hz"])
+    if len(levels) < _THROUGHPUT_MIN_LEVELS:
+        probs.append(
+            f"{where}: only {len(levels)} non-quick offered-load "
+            f"level(s); the committed artifact owes >= "
+            f"{_THROUGHPUT_MIN_LEVELS} (request Hz vs occupancy vs "
+            "offered load)")
+    return probs
+
+
+def check_telemetry_overhead(rows: list, where: str) -> list[str]:
+    """Validate parsed telemetry_overhead rows (exact key set per named
+    row; the <5% acceptance bar on the n=10 fraction row)."""
+    probs = []
+    seen = set()
+    for i, row in enumerate(rows, 1):
+        at = f"{where}:{i}"
+        if not isinstance(row, dict):
+            probs.append(f"{at}: row is not a JSON object")
+            continue
+        name = row.get("name")
+        keys = _OVERHEAD_KEYS.get(name)
+        if keys is None:
+            probs.append(f"{at}: unknown row name {name!r} (expected "
+                         f"{sorted(_OVERHEAD_KEYS)})")
+            continue
+        seen.add(name)
+        missing, unknown = keys - set(row), set(row) - keys
+        if missing:
+            probs.append(f"{at}: missing keys {sorted(missing)}")
+        if unknown:
+            probs.append(f"{at}: unknown keys {sorted(unknown)} "
+                         "(exact-key-set schema)")
+        if not (_finite_num(row.get("value")) and row.get("value") >= 0):
+            probs.append(f"{at}: 'value' must be a finite non-negative "
+                         f"number, got {row.get('value')!r}")
+        elif name == "telemetry_overhead_frac_n10" \
+                and row["value"] >= _OVERHEAD_BAR:
+            probs.append(
+                f"{at}: telemetry-on overhead {row['value']} breaches "
+                f"the < {_OVERHEAD_BAR} acceptance bar "
+                "(docs/OBSERVABILITY.md)")
+    for name in _OVERHEAD_KEYS:
+        if name not in seen:
+            probs.append(f"{where}: missing required row {name!r}")
+    return probs
+
 
 def _is_count(v) -> bool:
     return isinstance(v, int) and not isinstance(v, bool) and v >= 0
@@ -234,6 +355,17 @@ def check_file(path: Path) -> list[str]:
         if whole is None:
             return [f"{path.name}: unparseable serve-soak artifact"]
         return check_serve_soak(whole, path.name)
+    if path.name in (SERVE_THROUGHPUT, TELEMETRY_OVERHEAD):
+        rows, probs = [], []
+        for i, line in enumerate(lines, 1):
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                probs.append(f"{path.name}:{i}: unparseable row ({e})")
+        checker = (check_serve_throughput
+                   if path.name == SERVE_THROUGHPUT
+                   else check_telemetry_overhead)
+        return probs + checker(rows, path.name)
     if isinstance(whole, dict) and (
             len(lines) > 1
             or ("name" not in whole and "metric" not in whole)):

@@ -141,6 +141,11 @@ def child(journal: str, quick: bool) -> int:
     for t in tickets:
         t.result(timeout=600)
     svc.close()
+    # swarmscope snapshot (docs/OBSERVABILITY.md): occupancy, queue
+    # depth, per-tenant latency — printed evidence next to the ledger
+    # (the committed soak artifact keeps its exact-key-set schema)
+    print("TELEMETRY " + json.dumps(svc.serve_stats().to_row(),
+                                    sort_keys=True), flush=True)
     print("CHILD_DONE", flush=True)
     return 0
 
@@ -202,6 +207,13 @@ def run_soak(out: str | None, quick: bool) -> int:
                   f"{rB.stdout}\n{rB.stderr}")
             return 1
         print("phase B: journal recovered, drained to all-tenants-idle")
+        tel_line = next((ln for ln in rB.stdout.splitlines()
+                         if ln.startswith("TELEMETRY ")), None)
+        if tel_line:
+            tel = json.loads(tel_line.split(" ", 1)[1])
+            print("phase B telemetry: occupancy_mean="
+                  f"{tel['occupancy_mean']:.3f} queue_depth_p95="
+                  f"{tel['queue_depth_p95']:.1f} rounds={tel['rounds']}")
 
         # audit the promise ledger
         ledger: dict[str, dict] = {}

@@ -1,0 +1,184 @@
+"""serve_throughput — request Hz vs batch-bucket occupancy vs offered
+load (ROADMAP open item 2(c): the owed continuous-batching artifact).
+
+The tunnel-TPU regime pays a fixed ~108 ms dispatch floor per device
+launch; the whole case for swarmserve's continuous batching is that the
+floor is paid ONCE per chunk round for every request packed into the
+bucket. This benchmark makes that win measurable: sweep offered load
+(requests/s) over a fixed-size service, and for each level report the
+achieved terminal-request rate next to the mean/p95 bucket occupancy
+and queue depth the swarmscope registry sampled at every chunk
+boundary. Low load = mostly-empty buckets (each request pays the floor
+alone); saturating load = full buckets (the floor amortizes B-ways) +
+admission rejections doing their bounded-queue job.
+
+Requests are single-chunk n=5 rollouts (the smallest real unit of
+device work the service schedules), submitted by paced client threads
+round-robin across three tenants. One service per level, fresh
+registry; a warmup service run first keeps compile time out of every
+measured level.
+
+Run:
+
+    JAX_PLATFORMS=cpu python benchmarks/serve_throughput.py [--quick] \
+        [--out benchmarks/results/serve_throughput.json]
+
+Exit 1 if any accepted request fails to terminate (the serve contract
+is part of what this measures). Rows are schema-guarded by
+`benchmarks/check_results.py::check_serve_throughput` (exact key set).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+RESULTS = Path(__file__).resolve().parent / "results"
+
+N = 5                     # rollout shape (one bucket; packing is the point)
+TICKS = 60                # 3-chunk requests: jobs stay resident across
+#                           rounds, so concurrent arrivals actually pack
+# The >= 3 committed offered-load levels (requests/s), chosen to
+# bracket the measured single-stream capacity of this host (~100
+# requests/s at ~8-10 ms per solo request): light (buckets stay at one
+# slot — latency-optimal), at-capacity (the rate a no-batching service
+# would cap at), and saturating (buckets fill to ~1.0 occupancy, the
+# achieved rate EXCEEDS single-stream capacity because the per-round
+# cost amortizes across max_batch slots, and admission sheds the rest).
+OFFERED_HZ = (16.0, 100.0, 400.0)
+OFFERED_HZ_QUICK = (8.0, 64.0)
+DURATION_S = 6.0
+DURATION_S_QUICK = 2.5
+TENANTS = ("alpha", "beta", "gamma")
+
+
+def _service():
+    from aclswarm_tpu.serve import ServiceConfig, SwarmService
+
+    # modest caps so the saturating level provably exercises admission
+    # backpressure; no journal — this is a throughput measurement, not
+    # a durability drill (serve_soak.py owns that)
+    return SwarmService(ServiceConfig(
+        max_batch=4, quantum_chunks=4, max_queue_per_tenant=8,
+        max_queue_total=24, idle_poll_s=0.01))
+
+
+def _warmup() -> str:
+    """Compile the rollout bucket once, outside every measured level."""
+    import jax
+
+    svc = _service()
+    t = svc.submit("rollout", {"n": N, "ticks": TICKS,
+                               "chunk_ticks": TICKS, "seed": 0})
+    res = t.result(timeout=600)
+    assert res.ok, f"warmup failed: {res}"
+    svc.close()
+    return jax.default_backend()
+
+
+def run_level(offered_hz: float, duration_s: float) -> dict:
+    """One offered-load level: paced submissions for ``duration_s``,
+    then drain every ticket to a terminal result and read the stats."""
+    from aclswarm_tpu.serve import RejectedError
+
+    svc = _service()
+    tickets = []
+    t0 = time.perf_counter()
+    i = 0
+    # paced open-loop submission: request i is due at t0 + i/offered_hz
+    # regardless of how the service is keeping up (closed-loop pacing
+    # would hide saturation — the point is to offer MORE than it drains)
+    while True:
+        due = t0 + i / offered_hz
+        now = time.perf_counter()
+        if due > t0 + duration_s:
+            break
+        if due > now:
+            time.sleep(due - now)
+        try:
+            tickets.append(svc.submit(
+                "rollout",
+                {"n": N, "ticks": TICKS, "chunk_ticks": TICKS,
+                 "seed": i},
+                tenant=TENANTS[i % len(TENANTS)],
+                request_id=f"lvl{offered_hz:g}-{i}"))
+        except RejectedError:
+            pass     # backpressure; counted by the service registry
+        i += 1
+    # drain every accepted ticket to a terminal result; a ticket still
+    # unresolved after its bounded wait is a broken serve promise and
+    # counts as failed (surfaced as the FAIL exit in main, not a hang)
+    results, non_terminal = [], 0
+    for t in tickets:
+        try:
+            results.append(t.result(timeout=600))
+        except TimeoutError:
+            non_terminal += 1
+    wall = time.perf_counter() - t0
+    svc.close()
+    st = svc.serve_stats()
+    completed = sum(1 for r in results if r.ok)
+    return {
+        "completed": completed, "wall_s": wall, "stats": st,
+        "failed": sum(1 for r in results if not r.ok) + non_terminal,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="2 short levels (CI smoke; artifact not "
+                    "committed)")
+    ap.add_argument("--out", default=str(RESULTS / "serve_throughput.json"),
+                    help="artifact path ('' to skip writing)")
+    args = ap.parse_args(argv)
+
+    levels = OFFERED_HZ_QUICK if args.quick else OFFERED_HZ
+    dur = DURATION_S_QUICK if args.quick else DURATION_S
+    backend = _warmup()
+
+    rows = []
+    broken = 0
+    for hz in levels:
+        r = run_level(hz, dur)
+        st = r["stats"]
+        broken += r["failed"]
+        row = {
+            "name": "serve_throughput",
+            "n": N,
+            "backend": backend,
+            "offered_hz": round(hz, 3),
+            "value": round(r["completed"] / r["wall_s"], 3),
+            "unit": "Hz",
+            "occupancy_mean": round(st.occupancy_mean, 4),
+            "occupancy_p95": round(st.occupancy_p95, 4),
+            "queue_depth_mean": round(st.queue_depth_mean, 3),
+            "queue_depth_p95": round(st.queue_depth_p95, 3),
+            "accepted": st.counts["accepted"],
+            "completed": r["completed"],
+            "rejected": st.counts["rejected"],
+            "preempted": st.counts["preempted"],
+            "deadline_miss": st.counts["deadline_miss"],
+            "wall_s": round(r["wall_s"], 2),
+            "quick": bool(args.quick),
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    if broken:
+        print(f"FAIL: {broken} accepted request(s) did not complete")
+        return 1
+    if args.out:
+        p = Path(args.out)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        print(f"wrote {p}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

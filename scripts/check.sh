@@ -32,6 +32,31 @@ JAX_PLATFORMS=cpu python -m aclswarm_tpu.analysis.trace_audit
 echo "== committed benchmark artifact schema =="
 python benchmarks/check_results.py
 
+# NOTE: the swarmscope telemetry zero-cost gate (telemetry-off lowered
+# HLO == committed baseline) is enforced by the trace_audit step above —
+# verify_zero_cost_off covers check_mode AND telemetry through the one
+# shared baseline, so no second lowering sweep is run here.
+echo "== swarmscope owed artifacts: serve_throughput + =="
+echo "== telemetry_overhead committed and on schema =="
+echo "== (docs/OBSERVABILITY.md) =="
+python - <<'EOF'
+import sys
+
+sys.path.insert(0, "benchmarks")
+from check_results import RESULTS, check_file  # noqa: E402
+
+for name in ("serve_throughput.json", "telemetry_overhead.json"):
+    path = RESULTS / name
+    if not path.exists():
+        print(f"FAIL: missing owed artifact benchmarks/results/{name}")
+        sys.exit(1)
+    probs = check_file(path)
+    if probs:
+        print(f"FAIL: {name} schema drift: {probs}")
+        sys.exit(1)
+    print(f"{name}: committed and on schema")
+EOF
+
 echo "== crash-resume smoke: SIGKILL at chunk 1 of an n=5 rollout, =="
 echo "== resume from checkpoint, assert bit-parity (docs/RESILIENCE.md) =="
 JAX_PLATFORMS=cpu python -m aclswarm_tpu.resilience.smoke
@@ -70,9 +95,9 @@ else
     echo "no tier-1 log at $T1_LOG — skipping (run tier-1 first)"
 fi
 
-echo "== guard self-tests (lint fixtures, audit grid, invariant contracts, resilience, serve) =="
+echo "== guard self-tests (lint fixtures, audit grid, invariant contracts, resilience, serve, telemetry) =="
 exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_analysis.py tests/test_invariants.py \
     tests/test_results_schema.py tests/test_resilience.py \
-    tests/test_serve.py \
+    tests/test_serve.py tests/test_telemetry.py \
     -q -m 'not slow' -p no:cacheprovider

@@ -66,6 +66,14 @@ def pytest_configure(config):
         "additionally carry `slow` to respect the tier-1 duration guard")
     config.addinivalue_line(
         "markers",
+        "telemetry: swarmscope unified telemetry layer — host metrics "
+        "registry (counters/gauges/histograms, span flight recorder, "
+        "JSONL + Prometheus exports), device-resident ChunkTelemetry "
+        "chunk counters (zero-cost off via the shared HLO baseline), "
+        "ServeStats, and the log/timing unification "
+        "(aclswarm_tpu.telemetry; docs/OBSERVABILITY.md)")
+    config.addinivalue_line(
+        "markers",
         "invariants: swarmcheck runtime sanitizer — compiled-in "
         "invariant contracts (aclswarm_tpu.analysis.invariants; "
         "docs/STATIC_ANALYSIS.md runtime tier): clean-system positives, "

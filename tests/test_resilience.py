@@ -438,7 +438,7 @@ def _assert_trees_equal(a, b, what=""):
         np.testing.assert_array_equal(x, y, err_msg=f"{what} leaf {i}")
 
 
-def _engine_problem(n=5, faults=False, checks=False):
+def _engine_problem(n=5, faults=False, checks=False, telemetry=False):
     import jax.numpy as jnp
 
     from aclswarm_tpu import sim
@@ -459,22 +459,34 @@ def _engine_problem(n=5, faults=False, checks=False):
                             dtype=jnp.asarray(pts).dtype) if faults \
         else None
     st = sim.init_state(rng.normal(size=(n, 3)) * 2.0 + [0, 0, 2.0],
-                        faults=sched, checks=checks)
+                        faults=sched, checks=checks, telemetry=telemetry)
+    if telemetry:
+        # seed the driver-set leaves too: the resume proof must cover a
+        # non-trivial float residual, not just zeroed counters
+        st = st.replace(tel=st.tel.replace(
+            admm_iters=jnp.asarray(7, jnp.int32),
+            admm_residual=jnp.asarray(0.1231, st.swarm.q.dtype)))
     cfg = sim.SimConfig(assignment="auction", assign_every=10,
-                        check_mode="on" if checks else "off")
+                        check_mode="on" if checks else "off",
+                        telemetry="on" if telemetry else "off")
     return st, form, ControlGains(), sp, cfg
 
 
-@pytest.mark.parametrize("faults,checks", [(False, False), (True, False),
-                                           (True, True)])
-def test_engine_chunked_resume_bit_identical(tmp_path, faults, checks):
+@pytest.mark.parametrize("faults,checks,telemetry",
+                         [(False, False, False), (True, False, False),
+                          (True, True, False), (True, False, True)])
+def test_engine_chunked_resume_bit_identical(tmp_path, faults, checks,
+                                             telemetry):
     """Serial rollout: save/load at a chunk boundary reproduces the
-    remaining chunks' trajectories (q in StepMetrics), summaries, and
-    invariant codes bit-exactly — with and without a FaultSchedule."""
+    remaining chunks' trajectories (q in StepMetrics), summaries,
+    invariant codes, and swarmscope chunk counters (auction rounds,
+    churn, ADMM iters/residual) bit-exactly — with and without a
+    FaultSchedule."""
     import jax
 
     from aclswarm_tpu import sim
-    st0, form, cg, sp, cfg = _engine_problem(faults=faults, checks=checks)
+    st0, form, cg, sp, cfg = _engine_problem(faults=faults, checks=checks,
+                                             telemetry=telemetry)
     chunk, cut, total = 10, 2, 4
 
     state = st0
@@ -498,11 +510,14 @@ def test_engine_chunked_resume_bit_identical(tmp_path, faults, checks):
     _assert_trees_equal(state, final_ref, "final state")
 
 
-@pytest.mark.parametrize("faults", [False, True])
-def test_batched_summary_resume_bit_identical(tmp_path, faults):
+@pytest.mark.parametrize("faults,telemetry", [(False, False),
+                                              (True, False),
+                                              (True, True)])
+def test_batched_summary_resume_bit_identical(tmp_path, faults, telemetry):
     """Batched (B=2, per-trial fault scripts) fused rollout+summary:
     (state, carry) checkpoint round trip reproduces the remaining
-    chunks' ChunkSummary bit-exactly."""
+    chunks' ChunkSummary — including the per-trial swarmscope counter
+    snapshots — bit-exactly."""
     import jax
     import jax.numpy as jnp
 
@@ -511,7 +526,7 @@ def test_batched_summary_resume_bit_identical(tmp_path, faults):
 
     sts, forms = [], []
     for b in range(2):
-        st, form, cg, sp, cfg = _engine_problem()
+        st, form, cg, sp, cfg = _engine_problem(telemetry=telemetry)
         if faults:
             dtype = st.swarm.q.dtype
             sched = sample_schedule(b + 1, 5, dropout_frac=0.4,

@@ -218,3 +218,92 @@ def test_resilience_overhead_artifact_committed():
     by_name = {r["name"]: r for r in rows}
     head = by_name["checkpoint_overhead_frac_n10"]
     assert head["n"] == 10 and head["value"] < 0.05
+
+
+# --------------------------------------------- swarmscope artifacts (PR 7)
+
+def test_serve_throughput_artifact_committed():
+    """The owed continuous-batching artifact (ROADMAP open item 2(c)):
+    >= 3 offered-load levels, request Hz vs bucket occupancy — the
+    saturating level must show fuller buckets than the light one."""
+    path = RESULTS / "serve_throughput.json"
+    assert path.exists(), "benchmarks/results/serve_throughput.json " \
+                          "missing (python benchmarks/serve_throughput.py)"
+    assert check_file(path) == []
+    rows = [json.loads(ln) for ln in path.read_text().strip().splitlines()]
+    rows.sort(key=lambda r: r["offered_hz"])
+    assert len(rows) >= 3
+    assert rows[-1]["occupancy_mean"] > rows[0]["occupancy_mean"]
+    assert rows[-1]["value"] > rows[0]["value"]      # Hz grew with load
+    assert rows[-1]["rejected"] > 0                  # backpressure engaged
+
+
+def test_serve_throughput_schema_flags_drift(tmp_path):
+    from check_results import check_serve_throughput
+
+    def row(**kw):
+        base = {"name": "serve_throughput", "n": 5, "backend": "cpu",
+                "offered_hz": 8.0, "value": 7.9, "unit": "Hz",
+                "occupancy_mean": 0.25, "occupancy_p95": 0.25,
+                "queue_depth_mean": 0.0, "queue_depth_p95": 0.0,
+                "accepted": 20, "completed": 20, "rejected": 0,
+                "preempted": 0, "deadline_miss": 0, "wall_s": 2.5,
+                "quick": False}
+        base.update(kw)
+        return base
+
+    good = [row(offered_hz=h) for h in (2.0, 8.0, 32.0)]
+    assert check_serve_throughput(good, "x") == []
+    # exact key set: unknown and missing keys both flagged
+    extra = [dict(row(), bogus=1)] + good
+    assert any("unknown keys" in p
+               for p in check_serve_throughput(extra, "x"))
+    gone = [{k: v for k, v in row().items() if k != "occupancy_mean"}] \
+        + good
+    assert any("missing keys" in p
+               for p in check_serve_throughput(gone, "x"))
+    # occupancy out of range, completed > accepted, too few levels
+    assert any("[0, 1]" in p for p in check_serve_throughput(
+        good + [row(occupancy_mean=1.5)], "x"))
+    assert any("completed" in p for p in check_serve_throughput(
+        good + [row(completed=21)], "x"))
+    assert any("offered-load" in p for p in check_serve_throughput(
+        [row(), row()], "x"))
+
+
+def test_telemetry_overhead_artifact_committed():
+    """The telemetry-tax evidence (acceptance: on < 5% of trial wall at
+    n=10, default cadence; off is separately PROVEN zero-cost via the
+    HLO baseline) is committed and on schema."""
+    path = RESULTS / "telemetry_overhead.json"
+    assert path.exists(), "benchmarks/results/telemetry_overhead.json " \
+                          "missing (python -m aclswarm_tpu.telemetry" \
+                          ".overhead)"
+    assert check_file(path) == []
+    rows = [json.loads(ln) for ln in path.read_text().strip().splitlines()]
+    by_name = {r["name"]: r for r in rows}
+    head = by_name["telemetry_overhead_frac_n10"]
+    assert head["n"] == 10 and head["value"] < 0.05
+
+
+def test_telemetry_overhead_schema_flags_drift(tmp_path):
+    from check_results import check_telemetry_overhead
+
+    frac = {"name": "telemetry_overhead_frac_n10", "n": 10,
+            "value": 0.02, "unit": "ratio", "wall_off_s": 0.3,
+            "wall_on_s": 0.31, "chunks": 79, "reps": 3, "note": "x"}
+    pub = {"name": "telemetry_publish_us", "n": 10, "value": 4.0,
+           "unit": "us", "note": "x"}
+    assert check_telemetry_overhead([frac, pub], "x") == []
+    # the acceptance bar IS schema: a regressed fraction fails loudly
+    bad = dict(frac, value=0.2)
+    assert any("acceptance bar" in p
+               for p in check_telemetry_overhead([bad, pub], "x"))
+    assert any("missing required row" in p
+               for p in check_telemetry_overhead([frac], "x"))
+    assert any("unknown keys" in p
+               for p in check_telemetry_overhead(
+                   [dict(frac, bogus=1), pub], "x"))
+    assert any("unknown row name" in p
+               for p in check_telemetry_overhead(
+                   [frac, pub, {"name": "mystery", "value": 1.0}], "x"))

@@ -1,0 +1,422 @@
+"""swarmscope unified telemetry layer (aclswarm_tpu.telemetry;
+docs/OBSERVABILITY.md).
+
+Four tiers under test:
+
+1. the host registry itself — concurrent counter/histogram updates from
+   worker + client threads (serve is multithreaded), snapshot
+   consistency under fire, flight-recorder ring wraparound, Prometheus
+   text escaping, JSONL export;
+2. the device `ChunkTelemetry` carry — counter semantics per solver,
+   serial vs batched bit-parity, telemetry-off structural absence
+   (the zero-cost HLO proof itself lives in
+   tests/test_analysis.py::TestZeroCostOff via the shared baseline);
+3. the serve surface — `ServeStats` counters/occupancy/latency;
+4. the unification satellites — `timing_stats` histogram feed with an
+   unchanged return contract, `get_logger` record counters.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.telemetry
+
+from aclswarm_tpu.telemetry import (FlightRecorder, MetricsRegistry,  # noqa: E402
+                                    Span, get_registry, reset_registry)
+from aclswarm_tpu.telemetry.registry import _escape_label  # noqa: E402
+
+
+# ---------------------------------------------------------------- registry
+
+class TestRegistry:
+    def test_counter_gauge_histogram_basics(self):
+        reg = MetricsRegistry()
+        c = reg.counter("events_total")
+        c.inc()
+        c.inc(4)
+        assert c.value == 5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        g = reg.gauge("depth")
+        g.set(3)
+        g.add(-1)
+        assert g.value == 2.0
+        h = reg.histogram("lat_s")
+        for v in (1.0, 2.0, 3.0, 4.0):
+            h.observe(v)
+        row = h.to_row()
+        assert row["count"] == 4 and row["sum"] == 10.0
+        assert row["min"] == 1.0 and row["max"] == 4.0
+        assert row["p50"] == 2.0 and row["p99"] == 4.0
+
+    def test_get_or_create_is_keyed_by_name_kind_labels(self):
+        reg = MetricsRegistry()
+        assert reg.counter("x") is reg.counter("x")
+        assert reg.counter("x") is not reg.counter("x", {"t": "a"})
+        # same name, different kind: distinct metric OBJECTS (keyed by
+        # kind internally) — but snapshot()/Prometheus key by name, so
+        # export-facing metrics must use distinct names (serve's
+        # `_hist` suffix convention)
+        g, h = reg.gauge("occ"), reg.histogram("occ")
+        g.set(1.0)
+        h.observe(0.5)
+        assert g.value == 1.0 and h.count == 1
+
+    def test_histogram_reservoir_bounded_and_newest_win(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", reservoir=8)
+        for v in range(100):
+            h.observe(float(v))
+        row = h.to_row()
+        assert row["count"] == 100          # exact count survives
+        assert row["max"] == 99.0           # exact extrema survive
+        # percentiles come from the NEWEST 8 samples (92..99)
+        assert row["p50"] >= 92.0
+
+    def test_concurrent_updates_and_snapshot_consistency(self):
+        """Worker + client threads hammer one registry while the main
+        thread snapshots: final counts are exact (no lost updates) and
+        every mid-flight snapshot is well-formed."""
+        reg = MetricsRegistry()
+        K, T = 2000, 4
+        stop = threading.Event()
+        snaps = []
+
+        def worker(tid):
+            c = reg.counter("hits_total")
+            h = reg.histogram("obs_s", labels={"tenant": f"t{tid}"})
+            for i in range(K):
+                c.inc()
+                h.observe(i * 1e-6)
+
+        def snapshotter():
+            while not stop.is_set():
+                s = reg.snapshot()
+                snaps.append(s["metrics"].get("hits_total",
+                                              {"value": 0})["value"])
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(T)]
+        sn = threading.Thread(target=snapshotter)
+        sn.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        sn.join()
+        assert reg.counter("hits_total").value == K * T
+        for t in range(T):
+            assert reg.histogram("obs_s",
+                                 labels={"tenant": f"t{t}"}).count == K
+        # snapshots taken under fire are monotone non-decreasing counts
+        assert snaps == sorted(snaps)
+
+    def test_snapshot_and_jsonl_roundtrip(self):
+        reg = MetricsRegistry()
+        reg.counter("a_total", {"k": "v"}).inc(2)
+        reg.histogram("b_s").observe(0.5)
+        with reg.span("phase", step=1):
+            pass
+        snap = reg.snapshot()
+        assert snap["metrics"]["a_total{k=v}"]["value"] == 2
+        assert snap["spans_recorded"] == 1
+        rows = [json.loads(ln) for ln in reg.to_jsonl().splitlines()]
+        kinds = {r.get("kind") for r in rows if "kind" in r}
+        assert kinds == {"counter", "histogram"}
+        assert any(r.get("span") == "phase" for r in rows)
+
+    def test_dump_writes_jsonl(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("c_total").inc()
+        out = tmp_path / "sub" / "tel.jsonl"
+        reg.dump(out)
+        assert json.loads(out.read_text().splitlines()[0])["value"] == 1
+
+
+class TestPrometheusText:
+    def test_escaping_of_label_values_and_names(self):
+        reg = MetricsRegistry()
+        reg.counter("weird total", {"path": 'a"b\\c\nd'}).inc()
+        text = reg.prometheus_text()
+        # metric name sanitized, label value escaped per the format spec
+        assert "weird_total" in text
+        assert '\\"b' in text and "\\\\c" in text and "\\nd" in text
+        assert "\nd" not in text.replace("\\nd", "")   # no raw newline
+
+    def test_escape_label_exact(self):
+        assert _escape_label('a"b') == 'a\\"b'
+        assert _escape_label("a\\b") == "a\\\\b"
+        assert _escape_label("a\nb") == "a\\nb"
+
+    def test_histogram_exports_summary_series(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_s", {"tenant": "a"})
+        for v in (1.0, 2.0, 3.0):
+            h.observe(v)
+        text = reg.prometheus_text()
+        assert 'lat_s{tenant="a",quantile="0.5"} 2' in text
+        assert 'lat_s_count{tenant="a"} 3' in text
+        assert 'lat_s_sum{tenant="a"} 6' in text
+        assert "# TYPE lat_s summary" in text
+
+
+class TestFlightRecorder:
+    def test_ring_wraparound_keeps_newest_and_counts_drops(self):
+        rec = FlightRecorder(capacity=8)
+        for i in range(20):
+            rec.record(Span(name=f"s{i}", t_wall=0.0, dur_s=0.001))
+        spans = rec.spans()
+        assert len(spans) == 8
+        assert [s.seq for s in spans] == list(range(12, 20))
+        assert [s.name for s in spans] == [f"s{i}" for i in range(12, 20)]
+        assert rec.recorded == 20 and rec.dropped == 12
+
+    def test_span_ctx_records_duration_and_histogram(self):
+        reg = MetricsRegistry()
+        with reg.span("work", idx=3):
+            time.sleep(0.01)
+        (s,) = reg.spans()
+        assert s.name == "work" and s.attrs == {"idx": 3}
+        assert s.dur_s >= 0.009
+        assert reg.histogram("span_work_s").count == 1
+
+    def test_span_ctx_marks_errors_and_reraises(self):
+        reg = MetricsRegistry()
+        with pytest.raises(RuntimeError):
+            with reg.span("boom"):
+                raise RuntimeError("x")
+        (s,) = reg.spans()
+        assert s.attrs.get("error") is True
+
+
+# ----------------------------------------------------- device chunk counters
+
+def _problem(n=5, dtype=None):
+    import jax.numpy as jnp
+
+    from aclswarm_tpu.core.types import (ControlGains, SafetyParams,
+                                         make_formation)
+    dt = dtype or jnp.result_type(float)
+    ang = np.linspace(0, 2 * np.pi, n, endpoint=False)
+    pts = np.stack([3 * np.cos(ang), 3 * np.sin(ang), np.full(n, 2.0)], 1)
+    form = make_formation(
+        jnp.asarray(pts, dt), jnp.asarray(np.ones((n, n)) - np.eye(n), dt),
+        jnp.asarray(np.eye(n)[:, :, None, None]
+                    * np.eye(3)[None, None] * 0.01, dt))
+    sp = SafetyParams(bounds_min=jnp.asarray([-50.0, -50.0, 0.0], dt),
+                      bounds_max=jnp.asarray([50.0, 50.0, 10.0], dt))
+    rng = np.random.default_rng(0)
+    q0 = jnp.asarray(rng.normal(size=(n, 3)) * 2.0 + [0, 0, 2.0], dt)
+    return pts, form, ControlGains(), sp, q0
+
+
+class TestChunkTelemetry:
+    def test_counters_per_solver_and_off_absence(self):
+        from aclswarm_tpu import sim
+        from aclswarm_tpu.telemetry import device as devtel
+
+        _, form, cg, sp, q0 = _problem()
+        for solver, rounds_expected in (("auction", True), ("cbaa", True),
+                                        ("sinkhorn", False)):
+            st = sim.init_state(q0, telemetry=True)
+            cfg = sim.SimConfig(assignment=solver, assign_every=5,
+                                telemetry="on")
+            st2, m = sim.rollout(st, form, cg, sp, cfg, 20)
+            th = devtel.to_host(st2.tel)
+            assert th["auctions"] == 4, (solver, th)
+            assert (th["assign_rounds"] > 0) == rounds_expected
+            assert th["reassigns"] <= th["auctions"]
+            # StepMetrics carries the per-tick cumulative snapshot
+            assert np.asarray(m.tel.auctions).shape == (20,)
+            last = devtel.to_host(m.tel, index=-1)
+            assert last == th
+        # off: structurally absent everywhere
+        st = sim.init_state(q0)
+        st2, m = sim.rollout(st, form, cg, sp,
+                             sim.SimConfig(assignment="auction",
+                                           assign_every=5), 10)
+        assert st2.tel is None and m.tel is None
+
+    def test_flood_staleness_counts_only_in_flooded_mode(self):
+        from aclswarm_tpu import sim
+        from aclswarm_tpu.telemetry import device as devtel
+
+        _, form, cg, sp, q0 = _problem()
+        st = sim.init_state(q0, telemetry=True, localization=True)
+        cfg = sim.SimConfig(assignment="cbaa", assign_every=4,
+                            localization="flooded", flood_every=2,
+                            telemetry="on")
+        st2, _ = sim.rollout(st, form, cg, sp, cfg, 12)
+        assert devtel.to_host(st2.tel)["flood_stale_max"] >= 1
+
+    def test_batched_matches_serial_bit_exact(self):
+        """The batched carry attributes counters per trial, bit-equal to
+        B serial rollouts (the engine's row-independence guarantee
+        extends to telemetry)."""
+        import jax
+        import jax.numpy as jnp
+
+        from aclswarm_tpu import sim
+        from aclswarm_tpu.telemetry import device as devtel
+
+        _, form, cg, sp, _ = _problem()
+        rng = np.random.default_rng(3)
+        dt = form.points.dtype
+        states, serial = [], []
+        cfg = sim.SimConfig(assignment="auction", assign_every=5,
+                            telemetry="on")
+        for b in range(2):
+            q0 = jnp.asarray(rng.normal(size=(5, 3)) * 2.0 + [0, 0, 2.0],
+                             dt)
+            states.append(sim.init_state(q0, telemetry=True))
+        for st in states:
+            fin, _ = sim.rollout(st, form, cg, sp, cfg, 20)
+            serial.append(devtel.to_host(fin.tel))
+        bstate = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+        bform = jax.tree.map(lambda *xs: jnp.stack(xs), form, form)
+        bfin, _ = sim.batched_rollout(bstate, bform, cg, sp, cfg, 20)
+        for b in range(2):
+            assert devtel.to_host(bfin.tel, index=b) == serial[b]
+
+    def test_telemetry_on_needs_carry(self):
+        from aclswarm_tpu import sim
+
+        _, form, cg, sp, q0 = _problem()
+        st = sim.init_state(q0)                  # no carry allocated
+        cfg = sim.SimConfig(assignment="auction", telemetry="on")
+        with pytest.raises(ValueError, match="telemetry=True"):
+            sim.rollout(st, form, cg, sp, cfg, 2)
+        with pytest.raises(ValueError, match="telemetry mode"):
+            sim.rollout(st, form, cg, sp,
+                        sim.SimConfig(telemetry="bogus"), 2)
+
+    def test_admm_solve_stats(self):
+        from aclswarm_tpu import gains as gainslib
+
+        pts, _, _, _, _ = _problem(6)
+        adj = np.ones((6, 6)) - np.eye(6)
+        g_plain = np.asarray(gainslib.solve_gains(pts[:6], adj))
+        g, st = gainslib.solve_gains(pts[:6], adj, telemetry=True)
+        assert isinstance(st, gainslib.AdmmSolveStats)
+        assert st.iters > 0 and np.isfinite(st.residual)
+        np.testing.assert_array_equal(np.asarray(g), g_plain)
+
+
+class TestChunkPublisher:
+    def test_deltas_monotone_across_chunks_and_trials(self):
+        from aclswarm_tpu.telemetry import device as devtel
+
+        reg = MetricsRegistry()
+        pub = devtel.ChunkPublisher(reg, prefix="trial")
+        base = {"auctions": 0, "assign_rounds": 0, "reassigns": 0,
+                "ca_ticks": 0, "flood_stale_max": 0, "admm_iters": 0,
+                "admm_residual": 0.0}
+        pub.publish(0, dict(base, auctions=2, assign_rounds=20))
+        pub.publish(0, dict(base, auctions=5, assign_rounds=55,
+                            admm_iters=9, admm_residual=0.01))
+        pub.publish(1, dict(base, auctions=3, assign_rounds=30))
+        assert reg.counter("trial_auctions_total").value == 8
+        assert reg.counter("trial_assign_rounds_total").value == 85
+        assert reg.histogram("trial_admm_iters").count == 1
+        # a resumed trial replays its cumulative value: no double count
+        pub2 = devtel.ChunkPublisher(reg, prefix="trial")
+        pub2.publish(0, dict(base, auctions=5, assign_rounds=55))
+        assert reg.counter("trial_auctions_total").value == 13
+
+
+# ------------------------------------------------------------- serve stats
+
+@pytest.mark.serve
+class TestServeStats:
+    def test_counters_occupancy_latency(self):
+        from aclswarm_tpu.serve import ServeStats, ServiceConfig, \
+            SwarmService
+
+        svc = SwarmService(ServiceConfig(max_batch=2))
+        ts = [svc.submit("rollout",
+                         {"n": 5, "ticks": 20, "chunk_ticks": 20,
+                          "seed": i}, tenant=f"t{i % 2}")
+              for i in range(3)]
+        for t in ts:
+            assert t.result(timeout=300).ok
+        svc.close()
+        st = svc.serve_stats()
+        assert isinstance(st, ServeStats)
+        assert st.counts["accepted"] == 3
+        assert st.counts["completed"] == 3
+        assert 0.0 < st.occupancy_mean <= 1.0
+        assert set(st.latency_s) == {"t0", "t1"}
+        assert st.latency_s["t0"]["count"] == 2
+        compact = st.compact()
+        assert set(compact) == set(ServeStats.empty_compact())
+        assert st.spans_recorded >= 1
+        # the private registry exports Prometheus text too
+        assert "serve_accepted_total 3" in svc.telemetry.prometheus_text()
+
+    def test_deadline_miss_and_reject_counters(self):
+        from aclswarm_tpu.serve import (RejectedError, ServiceConfig,
+                                        SwarmService)
+
+        svc = SwarmService(ServiceConfig(
+            max_batch=1, max_queue_per_tenant=1, max_queue_total=1),
+            start=False)
+        svc.submit("rollout", {"n": 5, "ticks": 20, "chunk_ticks": 20})
+        with pytest.raises(RejectedError):
+            svc.submit("rollout", {"n": 5, "ticks": 20, "chunk_ticks": 20})
+        st = svc.serve_stats()
+        assert st.counts["rejected"] == 1
+        assert svc.telemetry.histogram("serve_retry_after_s").count == 1
+        svc.close(drain=False, timeout=5)
+
+        svc2 = SwarmService(ServiceConfig(max_batch=1))
+        t = svc2.submit("rollout",
+                        {"n": 5, "ticks": 20, "chunk_ticks": 20},
+                        deadline_s=0.0)
+        res = t.result(timeout=60)
+        assert res.status == "timed_out"
+        svc2.close()
+        assert svc2.serve_stats().counts["deadline_miss"] == 1
+
+
+# ------------------------------------------------- unification satellites
+
+class TestUnifiedEntryPoints:
+    def test_timing_stats_feeds_histogram_contract_unchanged(self):
+        from aclswarm_tpu.utils import timing
+
+        reg = MetricsRegistry()
+        stats = timing.timing_stats(lambda x: x, np.zeros(1), reps=4,
+                                    name="unit", registry=reg)
+        # the artifact-facing contract is untouched (TestTimingStats)
+        assert set(stats) == {"median_s", "min_s", "max_s", "reps"}
+        h = reg.histogram("timing_unit_s")
+        assert h.count == 4                     # warmup NOT observed
+        row = h.to_row()
+        assert row["min"] <= stats["median_s"] <= row["max"] + 1e-12
+
+    def test_timing_stats_default_registry(self):
+        from aclswarm_tpu.utils import timing
+
+        reg = reset_registry()
+        timing.timing_stats(lambda x: x, np.zeros(1), reps=2, name="dflt")
+        assert reg.histogram("timing_dflt_s").count == 2
+        assert get_registry() is reg
+        reset_registry()
+
+    def test_log_records_counted_by_level(self):
+        from aclswarm_tpu.utils.log import get_logger
+
+        reg = reset_registry()
+        log = get_logger("telemetry_test")
+        log.warning("one")
+        log.warning("two")
+        log.error("boom")
+        log.debug("invisible at INFO level")
+        warn = reg.counter("log_records_total", {"level": "warning"})
+        err = reg.counter("log_records_total", {"level": "error"})
+        assert warn.value == 2 and err.value == 1
+        reset_registry()
