@@ -110,14 +110,39 @@ class Channel:
             pass
 
 
-def send_reliable(channel: "Channel", msg, grace_s: float = 1.0,
-                  poll_s: float = 0.001, log=None) -> bool:
-    """Send with bounded retry through backpressure; a drop after the
-    grace period is loud. The 'queue size 1 but don't want to lose any'
-    intent of the reference's subscriptions (`coordination_ros.cpp
-    :417-418`) — shared by the bridge daemon and the shm planner client
-    for frames that must not vanish (formation commits, KILL broadcasts,
-    one-shot assignments).
+def open_when_ready(name: str, grace_s: float = 5.0,
+                    poll_s: float = 0.005) -> "Channel":
+    """Open a peer-created ring, polling until the creator has
+    registered the shm object (the wire-handshake shape: a client
+    creates its connection rings THEN announces them on the control
+    ring, but shm visibility and the announcement are not ordered
+    across processes). Raises OSError after ``grace_s`` — a ring that
+    never appears is a vanished peer, reported loudly."""
+    from aclswarm_tpu.utils.retry import poll_until
+
+    out: list = []
+
+    def _try() -> bool:
+        try:
+            out.append(Channel(name, create=False))
+            return True
+        except OSError:
+            return False
+
+    if not poll_until(_try, grace_s=grace_s, poll_s=poll_s):
+        raise OSError(f"ring {name} did not appear within {grace_s:g} s "
+                      "(peer vanished before completing the handshake?)")
+    return out[0]
+
+
+def send_bytes_reliable(channel: "Channel", frame: bytes,
+                        grace_s: float = 1.0, poll_s: float = 0.001,
+                        log=None, what: str = "frame") -> bool:
+    """Raw-frame form of `send_reliable`: bounded retry through
+    backpressure, loud drop after the grace. THE single home for the
+    bounded-send loop — the codec path (`send_reliable`) and the serve
+    wire front end (`aclswarm_tpu.serve.wire`) both layer on this, so
+    backpressure semantics evolve in one place.
 
     The loop itself lives in the unified retry layer
     (`aclswarm_tpu.utils.retry.poll_until`, docs/RESILIENCE.md): fixed
@@ -125,10 +150,23 @@ def send_reliable(channel: "Channel", msg, grace_s: float = 1.0,
     add dispatch latency — against a hard grace deadline."""
     from aclswarm_tpu.utils.retry import poll_until
 
-    if poll_until(lambda: channel.send(msg), grace_s=grace_s,
+    if poll_until(lambda: channel.send_bytes(frame), grace_s=grace_s,
                   poll_s=poll_s):
         return True
     if log is not None:
         log.warning("DROPPED %s on %s after %ss backpressure",
-                    type(msg).__name__, channel.name, grace_s)
+                    what, channel.name, grace_s)
     return False
+
+
+def send_reliable(channel: "Channel", msg, grace_s: float = 1.0,
+                  poll_s: float = 0.001, log=None) -> bool:
+    """Send with bounded retry through backpressure; a drop after the
+    grace period is loud. The 'queue size 1 but don't want to lose any'
+    intent of the reference's subscriptions (`coordination_ros.cpp
+    :417-418`) — shared by the bridge daemon and the shm planner client
+    for frames that must not vanish (formation commits, KILL broadcasts,
+    one-shot assignments)."""
+    return send_bytes_reliable(channel, codec.encode(msg),
+                               grace_s=grace_s, poll_s=poll_s, log=log,
+                               what=type(msg).__name__)

@@ -46,6 +46,33 @@ def make_mesh(n_devices: int | None = None,
     return Mesh(np.asarray(devs), axis_names=(AGENT_AXIS,))
 
 
+def slice_devices(n_slices: int, devices: list | None = None
+                  ) -> list[list]:
+    """Partition the visible devices into ``n_slices`` worker slices
+    (the multi-worker serving layout, docs/SERVICE.md: one serve worker
+    per mesh slice). With at least one device per slice the split is
+    contiguous — slice boundaries respect device order, which on TPU
+    keeps each slice ICI-adjacent. With FEWER devices than slices (the
+    CPU fallback host: one device, N worker threads) slices share
+    devices round-robin: every slice still names a device, the workers
+    just contend for the same stream — scheduling still scales, compute
+    does not, and the caller can see that from the overlap."""
+    devs = list(devices if devices is not None else jax.devices())
+    n_slices = max(1, int(n_slices))
+    if not devs:
+        return [[] for _ in range(n_slices)]
+    if len(devs) >= n_slices:
+        # contiguous split, remainder spread over the leading slices
+        base, extra = divmod(len(devs), n_slices)
+        out, at = [], 0
+        for i in range(n_slices):
+            take = base + (1 if i < extra else 0)
+            out.append(devs[at:at + take])
+            at += take
+        return out
+    return [[devs[i % len(devs)]] for i in range(n_slices)]
+
+
 def row_sharding(mesh: Mesh) -> NamedSharding:
     """Leading axis = agents, sharded."""
     return NamedSharding(mesh, P(AGENT_AXIS))

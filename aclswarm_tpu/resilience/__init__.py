@@ -23,6 +23,7 @@ digests stay on the committed baseline (`trace_audit`)."""
 from aclswarm_tpu.resilience.checkpoint import (CheckpointCorrupt,
                                                 CheckpointError,
                                                 CheckpointMismatch,
+                                                append_frame,
                                                 clear_checkpoints,
                                                 config_hash,
                                                 dtype_fingerprint,
@@ -30,18 +31,20 @@ from aclswarm_tpu.resilience.checkpoint import (CheckpointCorrupt,
                                                 latest_checkpoint,
                                                 load_checkpoint,
                                                 make_manifest,
+                                                read_frame_log,
                                                 restore_tree, tree_arrays,
                                                 write_checkpoint)
 from aclswarm_tpu.resilience.crash import (CrashPlan, InjectedCrash, arm,
-                                           maybe_crash)
+                                           arm_many, maybe_crash)
 from aclswarm_tpu.resilience.executor import (ChunkExecutor,
                                               is_transient_device_error)
 
 __all__ = [
     "CheckpointCorrupt", "CheckpointError", "CheckpointMismatch",
-    "clear_checkpoints", "config_hash", "dtype_fingerprint",
-    "expected_manifest", "latest_checkpoint", "load_checkpoint",
-    "make_manifest", "restore_tree", "tree_arrays", "write_checkpoint",
-    "CrashPlan", "InjectedCrash", "arm", "maybe_crash",
+    "append_frame", "clear_checkpoints", "config_hash",
+    "dtype_fingerprint", "expected_manifest", "latest_checkpoint",
+    "load_checkpoint", "make_manifest", "read_frame_log",
+    "restore_tree", "tree_arrays", "write_checkpoint",
+    "CrashPlan", "InjectedCrash", "arm", "arm_many", "maybe_crash",
     "ChunkExecutor", "is_transient_device_error",
 ]

@@ -193,6 +193,68 @@ def loads(buf: bytes, path="<bytes>") -> tuple[Any, dict]:
 
 
 # ---------------------------------------------------------------------------
+# append-only frame log (torn-tail tolerant)
+
+_LOG_LEN = struct.Struct("<I")      # per-record length prefix
+
+
+def append_frame(path, payload, manifest: dict) -> None:
+    """Append one length-prefixed frame to an append-only log. UNLIKE
+    `write_checkpoint` this is NOT atomic — appends are how an
+    always-on service records a stream of events (the serve layer's
+    worker-lifecycle ledger), and a crash mid-append legitimately
+    leaves a torn trailing record. `read_frame_log` is the matching
+    reader that treats exactly that torn tail as clean EOF."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    frame = dumps(payload, manifest)
+    with open(path, "ab") as f:
+        f.write(_LOG_LEN.pack(len(frame)) + frame)
+
+
+def read_frame_log(path) -> tuple[list, bool]:
+    """Read every frame of an append-only log; returns
+    ``(frames, torn_tail)`` with ``frames`` a list of
+    ``(payload, manifest)`` pairs.
+
+    Recovery semantics (docs/RESILIENCE.md): a truncated or CRC-failing
+    *trailing* record is a crash mid-append — it is dropped and
+    reported as ``torn_tail=True`` (clean EOF; the writer died between
+    starting and finishing its last append, which loses at most that
+    one record). Any corrupt record with MORE data after it cannot be
+    explained by a torn append and raises `CheckpointCorrupt` loudly —
+    mid-log damage must never be silently skipped, because every record
+    after it would be misframed."""
+    path = Path(path)
+    try:
+        buf = path.read_bytes()
+    except OSError as e:
+        raise CheckpointCorrupt(path, f"unreadable ({e})") from e
+    frames: list = []
+    off, n = 0, len(buf)
+    while off < n:
+        if n - off < _LOG_LEN.size:
+            return frames, True          # torn length prefix at the tail
+        (flen,) = _LOG_LEN.unpack_from(buf, off)
+        start = off + _LOG_LEN.size
+        end = start + flen
+        if end > n:
+            return frames, True          # truncated trailing frame
+        try:
+            frames.append(loads(buf[start:end], f"{path}@{off}"))
+        except CheckpointError as e:
+            if end == n:
+                return frames, True      # CRC-failing trailing frame
+            raise CheckpointCorrupt(
+                path, f"corrupt non-trailing record at offset {off} "
+                      f"({getattr(e, 'detail', e)}) with "
+                      f"{n - end} byte(s) after it — not a torn append"
+            ) from e
+        off = end
+    return frames, False
+
+
+# ---------------------------------------------------------------------------
 # manifest helpers
 
 def code_version() -> str:

@@ -17,13 +17,23 @@ Arming: in-process via `arm(CrashPlan(...))` (tests), or across a
 process boundary via the ``ACLSWARM_CRASH`` environment variable
 (``site:boundary[:kind]``, e.g. ``trial:1:kill``) — the subprocess
 SIGKILL proofs use the env form.
+
+Multi-plan arming (the multi-worker serve drills): several plans may be
+armed at once — `arm_many([...])` in-process, or comma-separated specs
+in the env var (``serve.w0:2:raise,serve.w1:5:raise``). Each plan is
+still one-shot: `maybe_crash` consumes ONLY the matching plan, leaving
+the rest armed, so a soak can script repeated single-worker kills
+(worker sites are per-slot — ``serve.w{slot}`` with the slot's own
+round count — while the process-level ``serve`` site keeps its global
+round semantics).
 """
 from __future__ import annotations
 
 import dataclasses
 import os
 import signal
-from typing import Optional
+import threading
+from typing import List, Optional
 
 ENV_VAR = "ACLSWARM_CRASH"
 KINDS = ("raise", "kill")
@@ -61,33 +71,80 @@ class CrashPlan:
         return cls(site=parts[0], boundary=int(parts[1]),
                    kind=parts[2] if len(parts) == 3 else "raise")
 
+    @classmethod
+    def decode_many(cls, s: str) -> List["CrashPlan"]:
+        """Comma-separated multi-plan form of `decode` (env arming for
+        the repeated-kill drills)."""
+        return [cls.decode(part) for part in s.split(",") if part]
 
-_armed: Optional[CrashPlan] = None
+
+_armed: List[CrashPlan] = []
+# multiple serve workers consult plans concurrently; consumption must be
+# atomic so one matching plan dies exactly one worker, never two
+_plan_lock = threading.Lock()
 
 
 def arm(plan: Optional[CrashPlan]) -> None:
     """Install (or with None, clear) the in-process crash plan."""
+    arm_many([] if plan is None else [plan])
+
+
+def arm_many(plans: List[CrashPlan]) -> None:
+    """Install several in-process plans at once (each one-shot): the
+    multi-worker drills arm one kill per targeted worker round."""
     global _armed
-    _armed = plan
+    with _plan_lock:
+        _armed = list(plans)
 
 
 def active_plan() -> Optional[CrashPlan]:
-    """The in-process plan, else the ``ACLSWARM_CRASH`` env plan."""
-    if _armed is not None:
-        return _armed
+    """The first armed in-process plan, else the first ``ACLSWARM_CRASH``
+    env plan (inspection only — consumption happens in `maybe_crash`)."""
+    plans = active_plans()
+    return plans[0] if plans else None
+
+
+def active_plans() -> List[CrashPlan]:
+    """Every armed plan: the in-process set, else the env set."""
+    with _plan_lock:
+        if _armed:
+            return list(_armed)
     spec = os.environ.get(ENV_VAR)
-    return CrashPlan.decode(spec) if spec else None
+    return CrashPlan.decode_many(spec) if spec else []
+
+
+def _consume(site: str, boundary: int) -> Optional[CrashPlan]:
+    """Atomically claim the plan matching (site, boundary), if any:
+    only the matching plan is disarmed — the rest stay armed so one
+    drill can script several deaths."""
+    with _plan_lock:
+        for i, plan in enumerate(_armed):
+            if plan.site == site and plan.boundary == boundary:
+                return _armed.pop(i)
+        spec = os.environ.get(ENV_VAR)
+        if not spec:
+            return None
+        plans = CrashPlan.decode_many(spec)
+        for i, plan in enumerate(plans):
+            if plan.site == site and plan.boundary == boundary:
+                rest = plans[:i] + plans[i + 1:]
+                if rest:
+                    os.environ[ENV_VAR] = ",".join(p.encode()
+                                                   for p in rest)
+                else:
+                    os.environ.pop(ENV_VAR, None)
+                return plan
+    return None
 
 
 def maybe_crash(site: str, boundary: int) -> None:
-    """Consulted by drivers at each checkpoint boundary; dies iff the
-    active plan names this exact (site, boundary). One-shot: the plan is
-    disarmed before dying so a resumed in-process run sails past."""
-    plan = active_plan()
-    if plan is None or plan.site != site or plan.boundary != boundary:
+    """Consulted by drivers at each checkpoint boundary; dies iff an
+    active plan names this exact (site, boundary). One-shot per plan:
+    the matching plan is disarmed before dying so a resumed in-process
+    run sails past, while other armed plans stay live."""
+    plan = _consume(site, boundary)
+    if plan is None:
         return
-    arm(None)
-    os.environ.pop(ENV_VAR, None)
     if plan.kind == "kill":
         os.kill(os.getpid(), signal.SIGKILL)   # nothing survives this
     raise InjectedCrash(

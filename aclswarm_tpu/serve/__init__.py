@@ -34,12 +34,17 @@ from aclswarm_tpu.serve.api import (COMPLETED, FAILED, PREEMPTED, QUEUED,
                                     Result, ServeError, Ticket)
 from aclswarm_tpu.serve.client import probe_backend, submit_and_wait
 from aclswarm_tpu.serve.service import (BUILTIN_KINDS, ServiceConfig,
-                                        SwarmService)
+                                        SwarmService, bucket_of)
 from aclswarm_tpu.serve.stats import ServeStats
+from aclswarm_tpu.serve.workers import WorkerPool, place_slot
 
 __all__ = [
     "COMPLETED", "FAILED", "PREEMPTED", "QUEUED", "RUNNING", "TERMINAL",
     "TIMED_OUT", "ChunkEvent", "RejectedError", "Request", "Result",
     "ServeError", "Ticket", "probe_backend", "submit_and_wait",
     "BUILTIN_KINDS", "ServiceConfig", "SwarmService", "ServeStats",
+    "WorkerPool", "bucket_of", "place_slot",
 ]
+# WireServer / WireClient live in `aclswarm_tpu.serve.wire` and are
+# imported from there directly: they require the native shm transport
+# (make -C native), which must stay optional for the core service.

@@ -20,6 +20,17 @@ bucket of the first eligible job at the cursor, then fills remaining
 slots with same-bucket work from all tenants (fair cycle first, then
 greedy) — heterogeneous traffic still packs, it just packs per-round.
 
+Multi-worker serving (`serve.workers`) adds two hooks without changing
+the fairness policy: `pick` takes an ``eligible`` predicate (each
+worker only sees jobs whose bucket the placement function maps to it —
+admission SHARDS buckets across workers) and an ``on_take`` callback
+invoked under the queue lock before the picked batch is released (the
+worker registers its in-flight set atomically with the dequeue, so the
+supervisor can never observe jobs that are neither queued nor owned).
+`set_capacity` re-derives the retry-after hint from SURVIVING capacity:
+with half the workers dead the same backlog drains half as fast, and
+the backpressure hint says so.
+
 Re-queueing (preempted or still-running-next-chunk jobs) bypasses the
 caps: those requests were already accepted, and bouncing them would
 convert backpressure into a silent loss.
@@ -52,6 +63,10 @@ class AdmissionControl:
         # EWMA of per-request service time feeds the retry-after hint;
         # seeded pessimistically so an empty history still backs off
         self._ewma_s = 0.25
+        # surviving-capacity scale on the hint: total workers / alive
+        # workers (1.0 single-worker; grows as workers die, capped in
+        # retry_after; set by the worker-pool supervisor)
+        self._capacity_scale = 1.0
 
     # ------------------------------------------------------------- intake
 
@@ -92,45 +107,77 @@ class AdmissionControl:
             job.held = False
             self._cv.notify_all()
 
-    def cancel(self, job) -> None:
-        """Back out an enqueued-but-unpicked job (a failed submit):
-        frees its caps slot. No-op if the job is not queued."""
+    def cancel(self, job) -> bool:
+        """Back out an enqueued-but-unpicked job (a failed submit, or a
+        wire client dying with entries still queued): frees its caps
+        slot. Returns True iff the job was queued here — False means it
+        is resident in a worker batch (or already terminal) and must be
+        cancelled at a chunk boundary instead, never mid-batch."""
         with self._cv:
             q = self._queues.get(job.req.tenant, [])
             if job in q:
                 q.remove(job)
+                return True
+            return False
 
     def requeue(self, job) -> None:
         """Tail re-queue of an accepted job (next chunk / preempted)."""
         self.admit(job, force=True)
 
+    def contains(self, job) -> bool:
+        """Is this exact job object currently queued? The failover
+        supervisor's idempotence check: a job a fenced worker already
+        requeued at its boundary is SAFE — failing it over again would
+        double-enqueue it (two copies in one batch, chunks executed
+        twice, digest ruined)."""
+        with self._cv:
+            return any(job in q for q in self._queues.values())
+
     # ------------------------------------------------------------ picking
 
-    def pick(self, max_jobs: int, timeout: float) -> List:
+    def pick(self, max_jobs: int, timeout: float,
+             eligible: Optional[Callable] = None,
+             on_take: Optional[Callable] = None) -> List:
         """Dequeue up to ``max_jobs`` same-bucket jobs, tenant-fair.
-        Blocks up to ``timeout`` for work; [] = still idle."""
+        Blocks up to ``timeout`` for work; [] = still idle.
+
+        ``eligible(job)`` restricts the view (worker-sharded picking:
+        each worker sees only the buckets placed on it). ``on_take`` is
+        called with the picked batch WHILE the queue lock is held — the
+        atomic queued→in-flight handoff the failover supervisor relies
+        on (a job is always either queued or registered in-flight,
+        never invisible in between)."""
+        ok = eligible if eligible is not None else (lambda j: True)
         deadline = self._clock() + timeout
         with self._cv:
             while True:
-                lead = self._lead_job()
+                lead = self._lead_job(ok)
                 if lead is not None:
                     break
                 remaining = deadline - self._clock()
                 if remaining <= 0 or not self._cv.wait(remaining):
-                    if self._lead_job() is None:
+                    lead = self._lead_job(ok)
+                    if lead is None:
                         return []
-                    lead = self._lead_job()
                     break
             tenant, job0 = lead
             bucket = job0.bucket
             take = [job0]
             self._queues[tenant].remove(job0)
+            # suspect quarantine (docs/SERVICE.md §multi-worker): a job
+            # that was in-flight at a worker death runs ALONE until a
+            # surviving chunk exonerates it — if the next kill comes,
+            # the solo batch implicates exactly one request (and orphans
+            # no innocents); conversely an innocent batch-mate of a
+            # scripted/co-incidental kill completes its solo round and
+            # never rides to the poison bound
+            suspect0 = bool(getattr(job0, "suspect", False))
             # deal remaining slots one-per-tenant-per-cycle, starting
             # after the lead tenant; fall back to greedy same-bucket
             # fill once a full cycle adds nothing
             ring = self._order
             start = (ring.index(tenant) + 1) % len(ring)
-            progress = True
+            progress = not suspect0
             while len(take) < max_jobs and progress:
                 progress = False
                 for k in range(len(ring)):
@@ -138,7 +185,9 @@ class AdmissionControl:
                         break
                     t = ring[(start + k) % len(ring)]
                     j = next((x for x in self._queues.get(t, [])
-                              if x.bucket == bucket and not x.held), None)
+                              if x.bucket == bucket and not x.held
+                              and not getattr(x, "suspect", False)
+                              and ok(x)), None)
                     if j is not None:
                         self._queues[t].remove(j)
                         take.append(j)
@@ -146,16 +195,20 @@ class AdmissionControl:
             # advance the cursor PAST the lead tenant: the next pick
             # starts from its neighbor (the fairness rotation)
             self._cursor = start
+            if on_take is not None:
+                on_take(take)
             return take
 
-    def _lead_job(self):
+    def _lead_job(self, ok: Callable):
         """(tenant, job) at the round-robin cursor, else None. Held
-        jobs (mid-submit, journal frame not yet durable) are invisible."""
+        jobs (mid-submit, journal frame not yet durable) and jobs the
+        caller's ``ok`` predicate excludes (placed on another worker)
+        are invisible."""
         ring = self._order
         for k in range(len(ring)):
             t = ring[(self._cursor + k) % len(ring)]
             j = next((x for x in self._queues.get(t, [])
-                      if not x.held), None)
+                      if not x.held and ok(x)), None)
             if j is not None:
                 return t, j
         return None
@@ -181,11 +234,27 @@ class AdmissionControl:
         with self._cv:
             self._ewma_s = 0.8 * self._ewma_s + 0.2 * max(0.0, dt_s)
 
+    def set_capacity(self, alive: int, total: int) -> None:
+        """Re-derive the drain-rate hint from SURVIVING capacity
+        (graceful degradation to fewer workers): the EWMA measured
+        per-request service time against the then-alive worker set, so
+        with ``alive`` of ``total`` workers up the same backlog drains
+        ``total/alive`` times slower. ``alive=0`` pins the scale to the
+        hint's ceiling — the honest answer while the circuit-broken
+        fleet backs off toward rejoin."""
+        with self._cv:
+            if alive <= 0:
+                self._capacity_scale = float("inf")
+            else:
+                self._capacity_scale = max(1.0, total / alive)
+
     def retry_after(self) -> float:
         """Backpressure hint: estimated time for the current backlog to
-        drain (EWMA service time x pending), clamped to [0.05, 30] s."""
+        drain (EWMA service time x pending, scaled by the surviving-
+        capacity factor), clamped to [0.05, 30] s."""
         backlog = sum(len(q) for q in self._queues.values())
-        return float(min(30.0, max(0.05, self._ewma_s * max(1, backlog))))
+        est = self._ewma_s * max(1, backlog) * self._capacity_scale
+        return float(min(30.0, max(0.05, est)))
 
     def wake(self) -> None:
         """Nudge a parked `pick` (shutdown/drain transitions)."""

@@ -42,6 +42,12 @@ E_DEADLINE = "deadline_exceeded"   # deadline passed at a chunk boundary
 E_EXECUTION = "execution_failed"   # retries + fallback exhausted, or a bug
 E_SHUTDOWN = "service_shutdown"    # non-drain close with work still queued
 E_QUEUE_FULL = "queue_full"        # RejectedError.reason (never a Result)
+E_POISONED = "poisoned"            # request killed K distinct workers —
+#                                    excluded everywhere, terminated
+#                                    instead of ping-ponging the fleet
+E_CANCELLED = "cancelled"          # cancelled before/at a boundary (wire
+#                                    client death cancels its queue
+#                                    entries — never the running batch)
 # client-side codes (`serve.client` — never journaled; the service
 # still owes the result when these are reported):
 E_CLIENT_TIMEOUT = "client_timeout"   # the CLIENT stopped waiting
@@ -123,6 +129,8 @@ class Result:
     chunks: int = 0                  # device chunks executed
     preemptions: int = 0             # checkpoint-backed evictions survived
     resumed: bool = False            # continued from a journaled checkpoint
+    failovers: int = 0               # worker-death migrations survived
+    #                                  (checkpoint-backed, bit-identical)
 
     @property
     def ok(self) -> bool:

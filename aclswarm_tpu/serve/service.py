@@ -1,16 +1,19 @@
 """swarmserve: the always-on serving layer over the batched engine
 (docs/SERVICE.md; ROADMAP open item 2).
 
-A `SwarmService` is a threaded queue front end plus ONE device worker
-loop. Clients `submit` heterogeneous requests — chunked rollouts,
-assignment solves, gain designs, registered extension kinds — and hold
-a `Ticket` that streams per-chunk progress and resolves to a terminal
-`Result`. The worker packs compatible rollout requests into
-shape-bucketed, power-of-two-padded device batches (the
-`harness/trials.py` compaction idiom run in reverse: the batch is
-*refilled* from the queue every chunk instead of compacted as trials
-die) and runs them through `sim.batched_rollout` one chunk at a time,
-so every chunk boundary is simultaneously:
+A `SwarmService` is a threaded queue front end plus N SUPERVISED device
+workers (`serve.workers.WorkerPool` — one per mesh slice, or N host
+threads on the CPU fallback host; ``ServiceConfig.workers``). Clients
+`submit` heterogeneous requests — chunked rollouts, assignment solves,
+gain designs, registered extension kinds — and hold a `Ticket` that
+streams per-chunk progress and resolves to a terminal `Result`. Each
+worker packs compatible rollout requests into shape-bucketed,
+power-of-two-padded device batches (the `harness/trials.py` compaction
+idiom run in reverse: the batch is *refilled* from the queue every
+chunk instead of compacted as trials die) — admission SHARDS buckets
+across workers by rendezvous hash, so one compiled shape lives on
+exactly one worker — and runs them through `sim.batched_rollout` one
+chunk at a time, so every chunk boundary is simultaneously:
 
 - a **scheduling point** (new arrivals join the next round — continuous
   batching, the Orca-style iteration-level scheduler of PAPERS.md),
@@ -34,7 +37,12 @@ and `benchmarks/serve_soak.py`):
 3. bit-identical resume — preempted or crash-recovered rollouts match
    an uninterrupted run exactly;
 4. degraded, not dead — transient device failures retry and fall back
-   to CPU with loud markers via the shared `ChunkExecutor`.
+   to CPU with loud markers via the shared `ChunkExecutor`;
+5. worker death is routine — a killed worker's in-flight jobs fail
+   over through the checkpoint codec to surviving workers (heartbeat +
+   lease detection, poison ping-pong bound, backoff-gated rejoin:
+   `serve.workers`), proven by `serve.smoke --multiworker` and
+   `benchmarks/serve_multiworker_soak.py`.
 
 Host-side only: this module adds no compiled code (the HLO baseline is
 unchanged); it drives the same jitted entry points the trial drivers
@@ -53,14 +61,15 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
-from aclswarm_tpu.resilience import ChunkExecutor, InjectedCrash, maybe_crash
+from aclswarm_tpu.resilience import ChunkExecutor, maybe_crash
 from aclswarm_tpu.resilience import checkpoint as ckptlib
 from aclswarm_tpu.serve.admission import AdmissionControl
-from aclswarm_tpu.serve.api import (COMPLETED, E_DEADLINE, E_EXECUTION,
-                                    E_QUEUE_FULL, E_SHUTDOWN, FAILED,
-                                    PREEMPTED, QUEUED, RUNNING, TIMED_OUT,
-                                    ChunkEvent, RejectedError, Request,
-                                    Result, ServeError, Ticket)
+from aclswarm_tpu.serve.api import (COMPLETED, E_CANCELLED, E_DEADLINE,
+                                    E_EXECUTION, E_POISONED, E_QUEUE_FULL,
+                                    E_SHUTDOWN, FAILED, PREEMPTED, QUEUED,
+                                    RUNNING, TIMED_OUT, ChunkEvent,
+                                    RejectedError, Request, Result,
+                                    ServeError, Ticket)
 from aclswarm_tpu.serve.stats import ServeStats
 from aclswarm_tpu.telemetry import MetricsRegistry
 from aclswarm_tpu.utils import get_logger
@@ -78,6 +87,25 @@ class ServiceConfig:
     max_queue_total: int = 32         # admission cap across tenants
     max_batch: int = 4                # device batch slots per round
     quantum_chunks: int = 2           # chunks before a job is preemptible
+    # ---- multi-worker serving (serve.workers; docs/SERVICE.md) ----
+    workers: int = 1                  # supervised device workers (one per
+    #                                   mesh slice; N threads on CPU)
+    lease_s: float = 60.0             # heartbeat lease: a worker silent
+    #                                   this long is declared dead even
+    #                                   with its thread alive (wedge);
+    #                                   generous by default — a first
+    #                                   compile legitimately blocks the
+    #                                   loop for tens of seconds
+    supervise_poll_s: float = 0.1     # supervisor cadence
+    max_worker_exclusions: int = 2    # K SOLO-implicated kills (the job
+    #                                   was alone in the batch — nobody
+    #                                   else to blame) before a request
+    #                                   is declared poisoned; batched
+    #                                   kills quarantine but don't count
+    max_worker_restarts: int = 3      # circuit breaker: consecutive
+    #                                   deaths before a slot retires
+    rejoin_base_s: float = 0.05       # backoff-gated rejoin (RetryPolicy)
+    rejoin_max_s: float = 2.0
     # journal directory (None = in-memory only: preemption still goes
     # through the codec, but a killed worker process loses the promise
     # ledger — production serving always sets this)
@@ -114,6 +142,24 @@ class _Job:
     t_first_run: Optional[float] = None
     finished: bool = False        # _finish() ran (atomic once-guard)
     held: bool = False            # caps slot reserved, picker-invisible
+    worker: Optional[int] = None  # slot currently holding the job
+    epoch: int = 0                # bumped on failover: a fenced zombie
+    #                               worker's stale writes are no-ops
+    failovers: int = 0            # worker-death migrations survived
+    excluded_workers: set = dataclasses.field(default_factory=set)
+    #                               worker INCARNATIONS this job died on
+    #                               (the poison ping-pong bound)
+    suspect: bool = False         # was in-flight at a worker death:
+    #                               QUARANTINED to solo batches until a
+    #                               surviving chunk exonerates it — an
+    #                               innocent batch-mate of a kill must
+    #                               never ride to the poison bound
+    solo_kills: int = 0           # kills witnessed while SOLO in the
+    #                               batch (nobody else to blame) since
+    #                               the last exoneration — the poison
+    #                               bound counts only these
+    cancelled: Optional[str] = None    # boundary-cancel reason (wire
+    #                                    client death; never mid-batch)
     _ckpt_bytes: Optional[bytes] = None   # journal-less preemption frame
     _problem: Any = None          # (formation, cgains, sparams, cfg)
 
@@ -175,6 +221,25 @@ def _parse_rollout(params: dict) -> _RolloutSpec:
         assign_every=assign_every, seed=int(params.get("seed", 0)),
         faults_spec=fspec, points=arr["points"], adjmat=arr["adjmat"],
         gains=arr["gains"])
+
+
+def _bucket_from_spec(spec: _RolloutSpec) -> tuple:
+    return ("rollout", spec.n, spec.chunk_ticks, spec.assignment,
+            spec.assign_every)
+
+
+def bucket_of(kind: str, params: dict) -> tuple:
+    """The shape-compatibility key a request will be scheduled under —
+    the SAME encoding `_make_job` assigns (built on `_parse_rollout`,
+    defaults included). The failover drills aim worker-targeted kills
+    at a bucket's placed owner (`serve.smoke --multiworker`,
+    `benchmarks/serve_multiworker_soak.py`); this is the one helper
+    they and the service share, so the drills can never drift from the
+    scheduler's own bucketing. Raises ValueError for params the
+    service would refuse."""
+    if kind == "rollout":
+        return _bucket_from_spec(_parse_rollout(params))
+    return ("single", kind)
 
 
 def _rollout_problem(spec: _RolloutSpec):
@@ -275,7 +340,9 @@ class SwarmService:
         self._round = 0
         self.stats = {"accepted": 0, "completed": 0, "rejected": 0,
                       "preempted": 0, "timed_out": 0, "failed": 0,
-                      "resumed": 0, "chunks": 0, "rounds": 0}
+                      "resumed": 0, "chunks": 0, "rounds": 0,
+                      "workers": max(1, cfg.workers), "failovers": 0,
+                      "requeued": 0, "poisoned": 0, "cancelled": 0}
         # swarmscope (docs/OBSERVABILITY.md): a PRIVATE registry per
         # service — the soak runs a crashed service and its reference
         # oracle in one process, and their ledgers must not mix.
@@ -287,10 +354,13 @@ class SwarmService:
                           if self._journal is not None else None)
         if self._journal is not None:
             self._recover()
-        self._worker = threading.Thread(target=self._run, daemon=True,
-                                        name="swarmserve-worker")
+        # the worker fleet (serve.workers): N supervised device workers
+        # with heartbeat/lease failover — worker death is routine, not
+        # a service outage
+        from aclswarm_tpu.serve.workers import WorkerPool
+        self._pool = WorkerPool(self, cfg)
         if start:
-            self._worker.start()
+            self.start()
 
     # ------------------------------------------------------------ clients
 
@@ -398,14 +468,23 @@ class SwarmService:
                ) -> Result:
         return ticket.result(timeout)
 
+    def start(self) -> None:
+        """Launch the worker fleet (no-op if already started). Split
+        from __init__ for admission-control tests and staged recovery
+        drills (``start=False``)."""
+        self._pool.start()
+
     @property
     def alive(self) -> bool:
-        """True while the worker loop can still make progress. False
-        after a clean exit OR a scripted/unexpected worker death —
-        clients waiting without a timeout should poll this instead of
-        blocking forever on a ticket the dead worker will never
-        resolve (journal recovery is how such tickets get honored)."""
-        return self._worker.is_alive()
+        """True while the service can still make progress: at least one
+        worker thread is alive, OR the supervisor is (it can respawn a
+        dead worker after its rejoin backoff — a worker death is a
+        FAILOVER, not an outage). False after a clean exit, or once the
+        whole fleet is circuit-open/dead — clients waiting without a
+        timeout should poll this instead of blocking forever on a
+        ticket nobody will resolve (journal recovery is how such
+        tickets get honored)."""
+        return self._pool.any_alive()
 
     def close(self, drain: bool = True, timeout: float = 120.0) -> None:
         """Stop the service. ``drain=True`` (the clean shutdown): refuse
@@ -425,9 +504,9 @@ class SwarmService:
             self._stop.set()
         self._adm.wake()
         drain_timed_out = False
-        if self._worker.is_alive():
-            self._worker.join(timeout)
-            drain_timed_out = drain and self._worker.is_alive()
+        if self._pool.started and self._pool.any_alive():
+            self._pool.join(timeout)
+            drain_timed_out = drain and self._pool.any_alive()
         self._stop.set()
         if drain_timed_out:
             err = ServeError(
@@ -459,8 +538,7 @@ class SwarmService:
         if req.kind == "rollout":
             spec = _parse_rollout(req.params)
             job = _Job(req=req, ticket=Ticket(req.request_id),
-                       bucket=("rollout", spec.n, spec.chunk_ticks,
-                               spec.assignment, spec.assign_every),
+                       bucket=_bucket_from_spec(spec),
                        spec=spec, chunks_total=spec.n_chunks)
         elif req.kind in BUILTIN_KINDS or req.kind in self._kinds:
             job = _Job(req=req, ticket=Ticket(req.request_id),
@@ -480,48 +558,58 @@ class SwarmService:
         assert self._journal is not None
         return self._journal / f"req_{rid}.done"
 
-    # ------------------------------------------------------- worker loop
+    # ------------------------------------------------------- worker rounds
+    #
+    # The worker LOOP lives in `serve.workers.WorkerPool` (pick, exit
+    # conditions, heartbeat, InjectedCrash handling, in-flight
+    # bookkeeping); the round EXECUTION lives here with the rest of the
+    # request state machine. Every per-job mutation is guarded by the
+    # (job, epoch-at-pick) pairs the pool hands in: a fenced zombie
+    # worker whose jobs were failed over observes a bumped epoch and
+    # touches nothing.
 
-    def _run(self) -> None:
-        while not self._stop.is_set():
-            jobs = self._adm.pick(self.cfg.max_batch,
-                                  timeout=self.cfg.idle_poll_s)
-            if not jobs:
-                if self._draining.is_set() and self._adm.empty():
-                    return                 # all tenants idle: clean exit
-                continue
+    def _worker_round(self, pairs: list, worker) -> None:
+        """One scheduler round for one worker: crash hooks, span, then
+        the bucket-appropriate execution."""
+        jobs = [j for j, _ in pairs]
+        with self._lock:
             self._round += 1
-            with self._lock:
-                self.stats["rounds"] = self._round
-            try:
-                # the scripted-preemption hook: the soak SIGKILLs HERE,
-                # with the batch picked and its rollouts mid-flight —
-                # the journal + checkpoints are all that survives
-                maybe_crash(CRASH_SITE, self._round)
-                with self.telemetry.span("serve.round",
-                                         round=self._round,
-                                         bucket=str(jobs[0].bucket[0]),
-                                         batch=len(jobs)):
-                    if jobs[0].bucket[0] == "rollout":
-                        self._rollout_round(jobs)
-                    else:
-                        for job in jobs:
-                            self._single(job)
-            except InjectedCrash as e:
-                # scripted preemption: the worker dies HERE, mid-batch,
-                # leaving only the journal + checkpoints (quietly — a
-                # thread traceback would just be noise in the drill)
-                self.log.warning("serve worker dying as scripted: %s", e)
-                return
-            except Exception as e:         # noqa: BLE001 — recorded
-                # a round-level bug must not wedge the service: every
-                # job of the round terminates with structured evidence
-                err = ServeError(E_EXECUTION,
-                                 f"{type(e).__name__}: {e}",
-                                 detail=self._execu.row_fields() or None)
-                for job in jobs:
-                    if not job.ticket.done:
-                        self._finish(job, FAILED, error=err)
+            grnd = self._round
+            self.stats["rounds"] = self._round
+        # the scripted-crash hooks: the process-level site ("serve",
+        # global round — the PR-6 SIGKILL drills) and the worker-
+        # targeted site ("serve.w{slot}", the slot's cumulative round —
+        # the single-worker failover drills). Both fire HERE, with the
+        # batch picked and registered in-flight: exactly what a killed
+        # worker leaves behind.
+        maybe_crash(CRASH_SITE, grnd)
+        from aclswarm_tpu.serve.workers import WORKER_SITE
+        maybe_crash(WORKER_SITE.format(slot=worker.slot), worker.round)
+        with self.telemetry.span("serve.round", round=grnd,
+                                 worker=worker.slot,
+                                 bucket=str(jobs[0].bucket[0]),
+                                 batch=len(jobs)):
+            if jobs[0].bucket[0] == "rollout":
+                self._rollout_round(pairs, worker)
+            else:
+                for job, epoch in pairs:
+                    self._single(job, epoch, worker)
+
+    def _fail_round(self, pairs: list, exc: BaseException) -> None:
+        """A round-level bug must not wedge the service: every job of
+        the round terminates with structured evidence."""
+        err = ServeError(E_EXECUTION,
+                         f"{type(exc).__name__}: {exc}",
+                         detail=self._execu.row_fields() or None)
+        for job, _ in pairs:
+            if not job.ticket.done:
+                self._finish(job, FAILED, error=err)
+
+    def _stale(self, job: _Job, epoch: int) -> bool:
+        """True when this residency no longer owns the job (finished by
+        a racing path, or failed over to another worker)."""
+        with self._lock:
+            return job.finished or job.epoch != epoch
 
     # -------------------------------------------------- rollout batching
 
@@ -562,8 +650,9 @@ class SwarmService:
     def _stem(self, job: _Job) -> str:
         return f"req_{job.req.request_id}"
 
-    def _checkpoint(self, job: _Job, to_disk: bool) -> None:
-        payload = {"state": ckptlib.tree_arrays(job.state),
+    def _checkpoint(self, job: _Job, to_disk: bool, state=None) -> None:
+        payload = {"state": ckptlib.tree_arrays(
+                       job.state if state is None else state),
                    "crc": int(job.crc),
                    "chunk_digests": [int(d) for d in job.chunk_digests],
                    "preemptions": int(job.preemptions)}
@@ -577,21 +666,30 @@ class SwarmService:
         else:
             job._ckpt_bytes = ckptlib.dumps(payload, man)
 
-    def _rollout_round(self, jobs: list) -> None:
-        """One chunk for one shape bucket: deadline gate -> restore ->
-        pad to the power-of-two batch -> ONE `batched_rollout` launch ->
-        unstack, stream, checkpoint, then complete/preempt/requeue."""
+    def _rollout_round(self, pairs: list, worker) -> None:
+        """One chunk for one shape bucket: deadline/cancel gate ->
+        restore -> pad to the power-of-two batch -> ONE `batched_rollout`
+        launch -> unstack, stream, checkpoint, then
+        complete/preempt/requeue. Every mutation is epoch-guarded: a
+        job failed over mid-round (this worker fenced as a zombie) is
+        skipped entirely — the new owner's restored state is
+        authoritative."""
         import jax
         import jax.numpy as jnp
 
         from aclswarm_tpu import sim
 
-        live = []
-        for job in jobs:
+        live, epochs = [], {}
+        for job, epoch in pairs:
+            if self._stale(job, epoch):
+                continue
             if self._expired(job):
                 self._timeout(job)
+            elif job.cancelled is not None:
+                self._cancel_at_boundary(job)
             else:
                 live.append(job)
+                epochs[id(job)] = epoch
         if not live:
             return
         for job in live:
@@ -599,6 +697,18 @@ class SwarmService:
             job.status = RUNNING
             if job.t_first_run is None:
                 job.t_first_run = time.monotonic()
+        if worker.device is not None:
+            # multi-device host: pin each job's carry to this worker's
+            # mesh-slice lead device BEFORE stacking — the compiled
+            # launch follows its operands, so N workers genuinely run
+            # N device streams. Per-job (not post-stack) because a
+            # batch can mix residencies: a freshly-migrated job's
+            # restored state lives on the default device while its
+            # batch-mate's carry lives on this worker's — stacking
+            # across devices is an error, not a transfer (CPU
+            # single-device fallback: device is None, no-op)
+            for job in live:
+                job.state = jax.device_put(job.state, worker.device)
         form, cgains, sparams, cfg = live[0]._problem
         chunk = live[0].spec.chunk_ticks
         B = len(live)
@@ -611,33 +721,66 @@ class SwarmService:
         bform = jax.tree.map(
             lambda *xs: jnp.stack(xs),
             *[live[i]._problem[0] for i in idx])
+        if worker.device is not None:
+            bform = jax.device_put(bform, worker.device)
         t0 = time.monotonic()
         bstate, metrics = self._execu.run(
             lambda: sim.batched_rollout(bstate, bform, cgains, sparams,
                                         cfg, chunk, None, 0),
-            stage=f"serve:round{self._round}")
+            stage=f"serve:w{worker.slot}:round{self._round}")
         q_all = np.asarray(metrics.q)          # (T, P, n, 3) — the host sync
+        done_live = []
         for i, job in enumerate(live):
-            job.state = jax.tree.map(lambda x: x[i], bstate)
             qb = np.ascontiguousarray(q_all[:, i])
-            job.crc = zlib.crc32(qb.tobytes(), job.crc) & 0xFFFFFFFF
-            job.chunk_digests.append(job.crc)
-            job.chunks_done += 1
-            job.run_chunks += 1
-            job.ticket._push(ChunkEvent(
-                job.req.request_id, job.chunks_done - 1,
-                {"chunk": job.chunks_done - 1,
-                 "tick_end": job.chunks_done * chunk,
-                 "digest": job.crc,
-                 "batch": B}))
+            # stale-check AND mutations share one lock hold: a
+            # lease-lapse failover landing between an unlocked check
+            # and these writes would let this (now-zombie) residency
+            # repopulate job.state after the supervisor nulled it —
+            # the next residency would then skip its restore and run
+            # with _problem=None
+            with self._lock:
+                if job.finished or job.epoch != epochs[id(job)]:
+                    continue           # failed over mid-launch: zombie
+                job.state = jax.tree.map(lambda x: x[i], bstate)
+                job.crc = zlib.crc32(qb.tobytes(), job.crc) & 0xFFFFFFFF
+                job.chunk_digests.append(job.crc)
+                job.chunks_done += 1
+                job.run_chunks += 1
+                if job.suspect:
+                    # EXONERATED: it survived a (solo, by the
+                    # quarantine pick rule) chunk — the kill it
+                    # witnessed was not its doing, and the kill ledger
+                    # resets with it so only a job that KEEPS killing
+                    # workers can ever accumulate to the poison bound
+                    job.suspect = False
+                    job.solo_kills = 0
+                    job.excluded_workers.clear()
+                done_live.append(job)
+                ev = ChunkEvent(
+                    job.req.request_id, job.chunks_done - 1,
+                    {"chunk": job.chunks_done - 1,
+                     "tick_end": job.chunks_done * chunk,
+                     "digest": job.crc,
+                     "batch": B,
+                     "worker": worker.slot})
+            job.ticket._push(ev)
         with self._lock:
-            self.stats["chunks"] += len(live)
+            self.stats["chunks"] += len(done_live)
         self._adm.note_service((time.monotonic() - t0) / max(1, B))
-        self._sample_boundary(len(live))
+        self._sample_boundary(len(done_live), worker)
 
-        for job in live:
+        for job in done_live:
+            # snapshot under the lock: a concurrent failover (fenced
+            # zombie scenario) may null job.state the instant after —
+            # this residency then finishes/checkpoints from ITS
+            # consistent snapshot, and the once-guard/epoch checks
+            # arbitrate who wins
+            with self._lock:
+                if job.finished or job.epoch != epochs[id(job)]:
+                    continue
+                state_ref = job.state
             if job.chunks_done >= job.chunks_total:
-                q_final = np.asarray(job.state.swarm.q)
+                q_final = np.asarray(state_ref.swarm.q)
                 self._finish(job, COMPLETED, value={
                     "q": q_final,
                     "ticks": job.chunks_done * chunk,
@@ -649,6 +792,9 @@ class SwarmService:
                 continue
             if self._expired(job):
                 self._timeout(job)
+                continue
+            if job.cancelled is not None:
+                self._cancel_at_boundary(job)
                 continue
             # checkpoint-backed preemption: a job past its quantum with
             # other work waiting is evicted through the codec; the next
@@ -664,28 +810,44 @@ class SwarmService:
                 self.telemetry.counter("serve_preempted_total").inc()
             # durability checkpoint every chunk when journaled: a
             # SIGKILL between rounds costs at most one chunk of work
+            # (from the snapshot — job.state may be nulled by a
+            # concurrent failover)
             if self._ckpt_dir is not None:
-                self._checkpoint(job, to_disk=True)
+                self._checkpoint(job, to_disk=True, state=state_ref)
             elif preempt:
-                self._checkpoint(job, to_disk=False)
-            if preempt:
-                job.state = None
-                job._problem = None
-                job.status = PREEMPTED
-                job.run_chunks = 0
-            else:
-                job.status = QUEUED
-            self._adm.requeue(job)
+                self._checkpoint(job, to_disk=False, state=state_ref)
+            # epoch guard AND the enqueue itself share one lock hold:
+            # the failover supervisor serializes against this exact
+            # section (its contains-check + requeue also run under
+            # _lock), so a job can never be enqueued twice by a
+            # boundary requeue racing a lease-lapse failover
+            with self._lock:
+                if job.finished or job.epoch != epochs[id(job)]:
+                    continue           # failed over while checkpointing
+                if preempt:
+                    job.state = None
+                    job._problem = None
+                    job.status = PREEMPTED
+                    job.run_chunks = 0
+                else:
+                    job.status = QUEUED
+                job.worker = None
+                self._adm.requeue(job)
 
     # ---------------------------------------------------- single-shot work
 
-    def _single(self, job: _Job) -> None:
+    def _single(self, job: _Job, epoch: int, worker) -> None:
         """Non-chunked kinds: the only boundaries are start and finish,
         and the deadline is enforced at both (work that finishes past
         its deadline is discarded with a structured error — the client
         was promised the deadline, not a late answer)."""
+        if self._stale(job, epoch):
+            return
         if self._expired(job):
             self._timeout(job)
+            return
+        if job.cancelled is not None:
+            self._cancel_at_boundary(job)
             return
         job.status = RUNNING
         job.t_first_run = time.monotonic()
@@ -693,10 +855,13 @@ class SwarmService:
         fn = {"assign": self._do_assign,
               "gains": self._do_gains}.get(kind) or self._kinds[kind]
         t0 = time.monotonic()
-        value = self._execu.run(lambda: fn(job.req.params),
-                                stage=f"{kind}:{job.req.request_id}")
+        value = self._execu.run(
+            lambda: fn(job.req.params),
+            stage=f"{kind}:{job.req.request_id}:w{worker.slot}")
         self._adm.note_service(time.monotonic() - t0)
-        self._sample_boundary(1)
+        self._sample_boundary(1, worker)
+        if self._stale(job, epoch):
+            return                     # failed over mid-execution
         if self._expired(job):
             self._timeout(job, late=True)
             return
@@ -764,6 +929,148 @@ class SwarmService:
         if self._ckpt_dir is not None:
             ckptlib.clear_checkpoints(self._ckpt_dir, self._stem(job))
 
+    # ------------------------------------------- failover + cancellation
+
+    def cancel(self, request_id: str,
+               reason: str = "cancelled by client"):
+        """Cancel one accepted request with a structured ``cancelled``
+        error — the wire layer's disconnect semantics (a dead client's
+        queue entries are cancelled, NEVER the running batch). Returns
+        ``"queued"`` (the job was still queued: cancelled immediately),
+        ``"resident"`` (mid-batch: marked, cancelled at its next chunk
+        boundary — the same cancellation quantum deadlines use), or
+        ``None`` (unknown or already terminal). Both non-None returns
+        are truthy: callers that only care about "was there anything to
+        cancel" can keep treating the result as a bool."""
+        with self._lock:
+            job = self._jobs.get(request_id)
+            if job is None or job.finished:
+                return None
+            job.cancelled = reason
+        if self._adm.cancel(job):      # was queued: cancel right now
+            self._cancel_at_boundary(job)
+            return "queued"
+        return "resident"
+
+    def _cancel_at_boundary(self, job: _Job) -> None:
+        with self._lock:
+            self.stats["cancelled"] += 1
+        self._finish(job, FAILED, error=ServeError(
+            E_CANCELLED, job.cancelled or "cancelled"))
+        if self._ckpt_dir is not None:
+            ckptlib.clear_checkpoints(self._ckpt_dir, self._stem(job))
+
+    def _failover_job(self, job: _Job, epoch: int, dead_uid: str,
+                      solo: bool = False) -> None:
+        """Fail one orphaned in-flight job over to the surviving
+        workers (called by the pool supervisor with the dead worker's
+        in-flight set). The dead incarnation joins the job's excluded
+        set and the job is QUARANTINED (scheduled solo until a
+        surviving chunk exonerates it). Only ``solo`` kills — the job
+        was alone in the batch, with nobody else to blame — count
+        toward the poison bound: at ``max_worker_exclusions`` of them
+        the request terminates with a structured ``poisoned`` error
+        instead of ping-ponging the fleet, while an innocent batch-mate
+        of a co-incidental kill completes its quarantine round and
+        walks free. Otherwise the job migrates THROUGH the checkpoint
+        codec (its resident state is serialized here and restored
+        template-validated on whichever surviving worker the placement
+        hash names) and re-queues."""
+        with self._lock:
+            if job.finished or job.epoch != epoch:
+                return                 # already terminal or re-owned
+            if self._adm.contains(job):
+                # a lease-lapsed (fenced, still-running) worker already
+                # requeued this job at its chunk boundary before the
+                # orphan snapshot was processed: the job is SAFE in the
+                # queue — failing it over again would enqueue a second
+                # copy (both picked into one batch, chunks run twice,
+                # the bit-exact digest ruined). The boundary requeue
+                # holds this same lock, so the check cannot race it.
+                return
+            job.epoch += 1
+            job.worker = None
+            job.excluded_workers.add(dead_uid)
+            job.failovers += 1
+            # quarantine: until a surviving chunk exonerates it, this
+            # job is scheduled in a batch of ONE (admission pick) — the
+            # next kill, if it comes, implicates exactly this request
+            job.suspect = True
+            if solo:
+                job.solo_kills += 1
+            exclusions = job.solo_kills
+        if exclusions >= self.cfg.max_worker_exclusions:
+            with self._lock:
+                self.stats["poisoned"] += 1
+            self.telemetry.counter("serve_poisoned_total").inc()
+            self._journal_event("poisoned", request_id=job.req.request_id,
+                                excluded=sorted(job.excluded_workers))
+            self.log.error(
+                "request %s POISONED: killed %d worker(s) while "
+                "quarantined solo (%s) — terminating instead of "
+                "wedging the fleet", job.req.request_id, exclusions,
+                sorted(job.excluded_workers))
+            self._finish(job, FAILED, error=ServeError(
+                E_POISONED,
+                f"request killed {exclusions} worker(s) while alone in "
+                f"the batch ({sorted(job.excluded_workers)}) — excluded "
+                "everywhere and terminated (max_worker_exclusions="
+                f"{self.cfg.max_worker_exclusions})"))
+            if self._ckpt_dir is not None:
+                ckptlib.clear_checkpoints(self._ckpt_dir, self._stem(job))
+            return
+        # checkpoint-backed migration: serialize the orphaned resident
+        # state through the codec so the next residency — on a DIFFERENT
+        # worker — restores it template-validated and bit-identically
+        # (the disk frame doubles as the crash-durability checkpoint)
+        if job.bucket[0] == "rollout" and job.state is not None:
+            self._checkpoint(job, to_disk=self._ckpt_dir is not None)
+            job.state = None
+            job._problem = None
+        with self._lock:
+            if job.finished:
+                return                 # raced a terminal path mid-ckpt
+            job.status = QUEUED
+            job.run_chunks = 0
+            self.stats["requeued"] += 1
+            self._adm.requeue(job)
+        self.telemetry.counter("serve_requeued_total").inc()
+        self._journal_event("requeue", request_id=job.req.request_id,
+                            dead_worker=dead_uid, chunk=job.chunks_done)
+
+    def _requeue_unowned(self, pairs: list) -> None:
+        """Hand back jobs a ZOMBIE worker dequeued but never registered
+        in-flight (the slot was replaced between its generation check
+        and the pick): nobody owns them — not the queue, not any
+        worker's in-flight set — so without this they would silently
+        never run. Epoch-guarded and queue-checked like every requeue."""
+        for job, epoch in pairs:
+            with self._lock:
+                if job.finished or job.epoch != epoch \
+                        or self._adm.contains(job):
+                    continue
+                job.status = QUEUED
+                job.worker = None
+                self._adm.requeue(job)
+
+    def _journal_event(self, event: str, **fields) -> None:
+        """Append one worker-lifecycle record (failover / requeue /
+        poisoned) to the journal's append-only events log — the
+        torn-tail-tolerant frame log (`resilience.checkpoint
+        .read_frame_log`): appends are not atomic, and a crash
+        mid-append must cost at most the record being written."""
+        if self._journal is None:
+            return
+        try:
+            ckptlib.append_frame(
+                self._journal / "events.log", dict(fields),
+                ckptlib.make_manifest("serve_event", "-", chunk=0,
+                                      event=event, t_wall=time.time()))
+        except OSError as e:
+            self.log.warning("events.log append failed (%s) — the "
+                             "lifecycle ledger loses this %s record",
+                             e, event)
+
     def _finish(self, job: _Job, status: str, value=None,
                 error: Optional[ServeError] = None,
                 journal: bool = True) -> None:
@@ -782,7 +1089,8 @@ class SwarmService:
             error=error,
             latency_s=max(0.0, t_done - job.req.t_submit),
             queued_s=max(0.0, queued_s), chunks=job.chunks_done,
-            preemptions=job.preemptions, resumed=job.resumed)
+            preemptions=job.preemptions, resumed=job.resumed,
+            failovers=job.failovers)
         # durable-then-visible: the done-frame is written before the
         # client can observe the result, so "resolved but not journaled"
         # is impossible and recovery never re-runs finished work
@@ -796,6 +1104,7 @@ class SwarmService:
                     request_id=job.req.request_id, status=status,
                     latency_s=res.latency_s, queued_s=res.queued_s,
                     preemptions=job.preemptions, resumed=job.resumed,
+                    failovers=job.failovers,
                     tenant=job.req.tenant, req_kind=job.req.kind,
                     t_done=t_done))
         job.status = status
@@ -835,6 +1144,23 @@ class SwarmService:
         assert self._journal is not None
         if not self._journal.is_dir():
             return
+        events = self._journal / "events.log"
+        if events.is_file():
+            # the worker-lifecycle ledger is APPEND-only: a crash
+            # mid-append leaves a torn trailing record, which the
+            # frame-log reader treats as clean EOF (any NON-trailing
+            # corruption still raises CheckpointCorrupt loudly)
+            frames, torn = ckptlib.read_frame_log(events)
+            for _, man in frames:
+                key = {"failover": "failovers", "requeue": "requeued",
+                       "poisoned": "poisoned"}.get(man.get("event"))
+                if key is not None:
+                    self.stats[key] += 1
+            if torn:
+                self.log.warning(
+                    "events.log ends in a torn record (crash "
+                    "mid-append) — dropped it as clean EOF; %d prior "
+                    "lifecycle record(s) recovered", len(frames))
         for done in sorted(self._journal.glob("req_*.done")):
             payload, man = _read_frame(done)
             err = payload.get("error")
@@ -845,7 +1171,8 @@ class SwarmService:
                 latency_s=float(man.get("latency_s", 0.0)),
                 queued_s=float(man.get("queued_s", 0.0)),
                 preemptions=int(man.get("preemptions", 0)),
-                resumed=bool(man.get("resumed", False)))
+                resumed=bool(man.get("resumed", False)),
+                failovers=int(man.get("failovers", 0)))
         for reqf in sorted(self._journal.glob("req_*.req")):
             payload, man = _read_frame(reqf)
             rid = man["request_id"]
@@ -883,12 +1210,15 @@ class SwarmService:
 
     # --------------------------------------------------------- telemetry
 
-    def _sample_boundary(self, live: int) -> None:
+    def _sample_boundary(self, live: int, worker=None) -> None:
         """Chunk-boundary scheduler gauges (docs/OBSERVABILITY.md): the
         batch-bucket occupancy (live device-batch slots / max_batch —
         the continuous-batching fill factor `serve_throughput` plots)
         and the admission queue depth, recorded both as last-value
-        gauges and as distributions over the run."""
+        gauges and as distributions over the run. With a worker handed
+        in, the same occupancy sample also lands in that worker's
+        labeled per-worker distribution (the failover drills read it to
+        show surviving workers absorbing the dead one's share)."""
         t = self.telemetry
         occ = live / max(1, self.cfg.max_batch)
         depth = self._adm.pending()
@@ -900,6 +1230,11 @@ class SwarmService:
         t.histogram("serve_bucket_occupancy_hist").observe(occ)
         t.gauge("serve_queue_depth").set(depth)
         t.histogram("serve_queue_depth_hist").observe(depth)
+        if worker is not None:
+            lbl = {"worker": str(worker.slot)}
+            t.histogram("serve_worker_occupancy_hist",
+                        labels=lbl).observe(occ)
+            t.counter("serve_worker_chunks_total", labels=lbl).inc(live)
 
     def serve_stats(self) -> ServeStats:
         """Plain-data swarmscope snapshot of this service's registry

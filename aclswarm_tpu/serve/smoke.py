@@ -17,6 +17,17 @@ The end-to-end shape of the promise, in under a minute on CPU:
    BIT-IDENTICAL to an uninterrupted in-parent run.
 
     JAX_PLATFORMS=cpu python -m aclswarm_tpu.serve.smoke
+
+``--multiworker`` runs the WORKER-crash half of the story instead
+(docs/SERVICE.md §multi-worker): a 2-worker journaled service, the
+worker owning the rollout bucket is killed mid-batch by a
+worker-targeted `CrashPlan`, and the supervisor fails the orphaned
+rollout over to the surviving worker THROUGH the checkpoint codec —
+zero losses, the migrated resume bit-identical to an uncontended run,
+and the service never stops serving (the kill is a failover, not an
+outage). `scripts/check.sh` runs both modes.
+
+    JAX_PLATFORMS=cpu python -m aclswarm_tpu.serve.smoke --multiworker
 """
 from __future__ import annotations
 
@@ -31,8 +42,9 @@ import time
 from pathlib import Path
 
 from aclswarm_tpu.resilience import checkpoint as ckptlib
-from aclswarm_tpu.resilience.crash import ENV_VAR
-from aclswarm_tpu.serve import ServiceConfig, SwarmService
+from aclswarm_tpu.resilience.crash import ENV_VAR, CrashPlan, arm
+from aclswarm_tpu.serve import (ServiceConfig, SwarmService, bucket_of,
+                                place_slot)
 from aclswarm_tpu.serve.service import _read_frame
 
 KILL_ROUND = 2
@@ -139,15 +151,92 @@ def run_smoke() -> int:
     return 0
 
 
+def run_multiworker() -> int:
+    """The worker-crash failover drill: SIGKILL one of two workers
+    (thread-abrupt death — the in-process analogue of a worker process
+    SIGKILL: no cleanup, in-flight work orphaned), assert zero loss +
+    a bit-identical cross-worker migrated resume."""
+    t0 = time.time()
+    roll = REQUESTS[0]["params"]
+    # the bit-parity oracle: an uncontended single-worker run
+    ref = SwarmService(ServiceConfig(max_batch=1))
+    want = ref.submit("rollout", roll).result(300)
+    ref.close()
+    assert want.ok
+
+    with tempfile.TemporaryDirectory(prefix="aclswarm_mw_smoke_") as d:
+        svc = SwarmService(ServiceConfig(
+            workers=2, max_batch=1, quantum_chunks=8, journal_dir=d,
+            supervise_poll_s=0.02, rejoin_base_s=0.05))
+        # kill the worker that OWNS the rollout bucket, at its round 2:
+        # one chunk done + checkpointed, the next mid-flight. The
+        # rollout goes in ALONE so the victim's round schedule is
+        # deterministic (chunk 1 = round 1, chunk 2 = round 2); the
+        # single-shot requests follow once the kill has landed,
+        # proving the degraded fleet keeps serving THROUGH a failover.
+        slot = place_slot(bucket_of("rollout", roll), [0, 1])
+        arm(CrashPlan(f"serve.w{slot}", 2, "raise"))
+        tickets = [svc.submit(REQUESTS[0]["kind"], REQUESTS[0]["params"],
+                              tenant=REQUESTS[0]["tenant"],
+                              request_id=REQUESTS[0]["request_id"])]
+        deadline = time.monotonic() + 120
+        while svc.stats["failovers"] < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        tickets += [svc.submit(r["kind"], r["params"], tenant=r["tenant"],
+                               request_id=r["request_id"])
+                    for r in REQUESTS[1:]]
+        results = {r["request_id"]: t.result(timeout=300)
+                   for r, t in zip(REQUESTS, tickets)}
+        arm(None)
+        stats = dict(svc.stats)
+        alive_through = svc.alive
+        svc.close()
+
+        losses = [rid for rid, res in results.items()
+                  if res.status not in ("completed",)]
+        if losses:
+            print(f"FAIL: requests did not complete across the worker "
+                  f"kill: {losses}")
+            return 1
+        roll_res = results["smoke-roll"]
+        if roll_res.failovers < 1:
+            print("FAIL: the rollout never migrated (failovers="
+                  f"{roll_res.failovers}) — the kill missed its worker")
+            return 1
+        if roll_res.value["digest"] != want.value["digest"]:
+            print(f"FAIL: migrated digest {roll_res.value['digest']:#x} "
+                  f"!= uncontended {want.value['digest']:#x}")
+            return 1
+        if stats["failovers"] < 1 or stats["requeued"] < 1:
+            print(f"FAIL: failover not recorded in stats: {stats}")
+            return 1
+        if not alive_through:
+            print("FAIL: service reported dead during a routine "
+                  "worker failover")
+            return 1
+    print("PASS: worker kill mid-batch lost nothing — 3/3 requests "
+          f"terminal, rollout migrated off worker {slot} after "
+          f"{roll_res.failovers} failover(s), resume bit-identical "
+          f"(digest {roll_res.value['digest']:#010x}), "
+          f"{time.time() - t0:.1f}s")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--child", action="store_true",
                     help="(internal) the killable service run")
     ap.add_argument("--dir", default=None,
                     help="(internal) journal directory")
+    ap.add_argument("--multiworker", action="store_true",
+                    help="worker-crash failover drill (2 workers, kill "
+                         "one mid-batch, bit-identical migrated resume)")
     args = ap.parse_args(argv)
     if args.child:
         return child(args.dir)
+    if args.multiworker:
+        return run_multiworker()
     return run_smoke()
 
 

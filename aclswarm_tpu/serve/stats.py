@@ -50,18 +50,23 @@ class ServeStats:
     rounds: int                  # scheduler rounds executed
     chunks: int                  # device chunks executed
     spans_recorded: int
+    workers: int                 # configured worker slots (fleet size)
+    workers_up: int              # slots currently up (worker_up gauges)
+    per_worker: dict             # slot -> {up, chunks, occupancy_mean}
 
     @classmethod
     def of(cls, service) -> "ServeStats":
         reg = service.telemetry
         counts = {}
         for key in ("accepted", "rejected", "completed", "failed",
-                    "preempted", "resumed", "deadline_miss"):
+                    "preempted", "resumed", "deadline_miss",
+                    "failover", "requeued", "poisoned"):
             counts[key] = int(reg.counter(f"serve_{key}_total").value)
         occ = reg.histogram("serve_bucket_occupancy_hist")
         dep = reg.histogram("serve_queue_depth_hist")
         occ_row, dep_row = occ.to_row(), dep.to_row()
         lat = {}
+        per_worker: dict = {}
         for m in reg.metrics():
             if m.name == "serve_latency_s" and m.labels.get("tenant"):
                 row = m.to_row()
@@ -69,6 +74,17 @@ class ServeStats:
                     "count": row["count"],
                     "p50": row.get("p50"), "p95": row.get("p95"),
                     "p99": row.get("p99")}
+            elif m.labels.get("worker") is not None:
+                w = per_worker.setdefault(
+                    m.labels["worker"],
+                    {"up": False, "chunks": 0, "occupancy_mean": 0.0})
+                if m.name == "serve_worker_up":
+                    w["up"] = bool(m.value)
+                elif m.name == "serve_worker_chunks_total":
+                    w["chunks"] = int(m.value)
+                elif m.name == "serve_worker_occupancy_hist":
+                    w["occupancy_mean"] = round(float(
+                        m.to_row().get("mean", 0.0)), 3)
         with service._lock:
             rounds = int(service.stats.get("rounds", 0))
             chunks = int(service.stats.get("chunks", 0))
@@ -81,12 +97,17 @@ class ServeStats:
             queue_depth_mean=float(dep_row.get("mean", 0.0)),
             queue_depth_p95=float(dep_row.get("p95", 0.0)),
             latency_s=lat, rounds=rounds, chunks=chunks,
-            spans_recorded=int(reg.recorder.recorded))
+            spans_recorded=int(reg.recorder.recorded),
+            workers=int(reg.gauge("serve_workers_total").value),
+            workers_up=sum(1 for w in per_worker.values() if w["up"]),
+            per_worker=per_worker)
 
     def compact(self) -> dict:
         """The bench-row summary: bucket occupancy, queue depth,
-        preemption count (plus the admission ledger) — small enough to
-        ride every structured one-line row, degraded ones included."""
+        preemption count, the admission ledger, and the fleet
+        provenance (worker count + failover events — a row served by a
+        degraded fleet says so) — small enough to ride every structured
+        one-line row, degraded ones included."""
         return {
             "occupancy_mean": round(self.occupancy_mean, 3),
             "queue_depth": self.queue_depth,
@@ -94,6 +115,8 @@ class ServeStats:
             "accepted": self.counts.get("accepted", 0),
             "rejected": self.counts.get("rejected", 0),
             "deadline_miss": self.counts.get("deadline_miss", 0),
+            "workers": self.workers,
+            "failovers": self.counts.get("failover", 0),
         }
 
     @staticmethod
@@ -102,7 +125,8 @@ class ServeStats:
         ever started (probe failure, watchdog) still carry the
         telemetry block so row consumers need no key-presence logic."""
         return {"occupancy_mean": 0.0, "queue_depth": 0, "preempted": 0,
-                "accepted": 0, "rejected": 0, "deadline_miss": 0}
+                "accepted": 0, "rejected": 0, "deadline_miss": 0,
+                "workers": 0, "failovers": 0}
 
     def to_row(self) -> dict:
         return dataclasses.asdict(self)

@@ -1,0 +1,567 @@
+"""Wire front end for swarmserve: external client processes submit over
+the interop shm rings (docs/SERVICE.md §wire protocol; ROADMAP open
+item 2(a)).
+
+The serving layer was deliberately in-process through PR 7; this module
+is the transport boundary. The design reuses what already exists
+instead of inventing a protocol:
+
+- **transport**: `interop.transport.Channel` — the named SPSC
+  shared-memory rings (`native/shmring.cpp`), one ring per direction
+  per connection, plus one well-known *control* ring for handshakes;
+- **wire format**: the journal's codec-framed records
+  (`resilience.checkpoint.dumps/loads` — magic, version, CRC,
+  length-prefixed array table). A request ON THE WIRE is byte-for-byte
+  the record the journal stores, so there is exactly one serialization
+  surface to version and one CRC to trust. Versioning rides the frame's
+  ``format_version`` plus a ``wire_version`` manifest field checked at
+  hello time.
+
+Connection lifecycle (client-created rings, server-owned control)::
+
+    server:  WireServer(service, base)        # creates {base}.ctl
+    client:  WireClient(base)                 # creates {base}.{cid}.c2s
+                                              #     and {base}.{cid}.s2c,
+                                              # then HELLO on the ctl ring
+    client:  submit(...) -> Ticket            # wire.submit -> accept/
+                                              # reject frame
+    server:  streams wire.event / wire.result frames back per request
+    client:  close()                          # BYE (clean) — or just die
+
+Failure semantics (the loud-disconnect contract):
+
+- a frame that fails the codec CRC (or does not parse) is REJECTED with
+  a loud log + ``wire_crc_rejected_total`` — never partially applied;
+- a client that stops talking (no submit/ping within
+  ``client_lease_s``) is declared dead: its entries are cancelled with
+  a structured ``cancelled`` error — still-QUEUED ones immediately,
+  RESIDENT ones only at their next chunk boundary — never the running
+  batch mid-kernel; the terminal results are journaled and their
+  delivery dropped loudly;
+- per-connection deadlines: every submit may carry ``deadline_s``; the
+  connection's ``default_deadline_s`` applies otherwise, so one slow
+  client cannot park unbounded work.
+
+The server is a thin adapter: admission, fairness, journaling, failover
+and every promise stay in `SwarmService` — a wire client gets exactly
+the in-process semantics, one process boundary later.
+"""
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import queue as queuelib
+import threading
+import time
+import uuid
+from pathlib import Path
+from typing import Optional
+
+from aclswarm_tpu.interop import transport
+from aclswarm_tpu.resilience import checkpoint as ckptlib
+from aclswarm_tpu.serve.api import (E_QUEUE_FULL, E_SHUTDOWN, FAILED,
+                                    ChunkEvent, RejectedError, Result,
+                                    ServeError, Ticket)
+from aclswarm_tpu.serve.api import _SENTINEL as _TICKET_SENTINEL
+from aclswarm_tpu.utils import get_logger
+
+WIRE_VERSION = 1
+# frame kinds (the manifest's `kind` field — same slot the journal uses)
+K_HELLO = "wire_hello"
+K_HELLO_ACK = "wire_hello_ack"
+K_SUBMIT = "wire_submit"
+K_ACCEPT = "wire_accept"
+K_REJECT = "wire_reject"
+K_EVENT = "wire_event"
+K_RESULT = "wire_result"
+K_ERROR = "wire_error"
+K_PING = "wire_ping"
+K_BYE = "wire_bye"
+
+RING_CAPACITY = 1 << 20
+
+
+@contextlib.contextmanager
+def _ctl_writer_lock(base: str):
+    """Cross-process writer lock for the shared control ring. The shm
+    rings are strictly SINGLE-producer (`native/shmring.cpp` uses plain
+    non-CAS head writes), but every client writes its HELLO to the one
+    well-known ctl ring — two clients connecting concurrently would
+    interleave their head updates and misframe the ring for everyone
+    after. A flock on a well-known lock file serializes the (rare,
+    tiny) ctl writes; connection rings stay lock-free SPSC."""
+    path = Path("/dev/shm") if Path("/dev/shm").is_dir() \
+        else Path("/tmp")
+    lock = path / f"aclswarm.{base.strip('/')}.ctl.lock"
+    with open(lock, "a+b") as f:
+        fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+
+
+def _frame(kind: str, payload: dict, **extra) -> bytes:
+    return ckptlib.dumps(payload, ckptlib.make_manifest(
+        kind, "-", chunk=0, wire_version=WIRE_VERSION, **extra))
+
+
+def _send(channel, frame: bytes, grace_s: float = 2.0, log=None,
+          what: str = "frame") -> bool:
+    """Backpressure-bounded raw send; a drop after the grace is LOUD
+    (the receiving side stopped draining — a dead or wedged peer).
+    The loop is `transport.send_bytes_reliable` — one home for the
+    bounded-send semantics."""
+    return transport.send_bytes_reliable(channel, frame,
+                                         grace_s=grace_s, poll_s=0.001,
+                                         log=log, what=what)
+
+
+class _Conn:
+    """Server-side state for one client connection."""
+
+    def __init__(self, cid: str, c2s, s2c):
+        self.cid = cid
+        self.c2s = c2s
+        self.s2c = s2c
+        self.last_seen = time.monotonic()
+        self.pending: dict[str, Ticket] = {}    # rid -> live ticket
+        self.dead = False
+
+
+class WireServer:
+    """Serve `SwarmService` requests to external processes over shm
+    rings. One dispatcher thread owns every ring (SPSC discipline: the
+    server is the single reader of ctl + every c2s, the single writer
+    of every s2c)."""
+
+    def __init__(self, service, base: str = "aclswarm-serve", *,
+                 client_lease_s: float = 10.0,
+                 default_deadline_s: Optional[float] = None,
+                 poll_s: float = 0.002, log=None):
+        self.svc = service
+        self.base = base
+        self.client_lease_s = float(client_lease_s)
+        self.default_deadline_s = default_deadline_s
+        self.poll_s = float(poll_s)
+        self.log = log or get_logger("serve.wire")
+        self._ctl = transport.Channel(f"{base}.ctl", create=True,
+                                      capacity=RING_CAPACITY)
+        self._conns: dict[str, _Conn] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="swarmserve-wire")
+        self._thread.start()
+
+    # ------------------------------------------------------------- loop
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            # the single dispatcher must never die of one bad ring or
+            # one buggy frame handler: a silent dispatcher death wedges
+            # EVERY wire client while the service looks healthy — the
+            # same round-level containment the worker loop has
+            try:
+                busy = self._one_pass()
+            except Exception:           # noqa: BLE001 — logged, loud
+                self.log.exception(
+                    "wire dispatcher pass failed — continuing (a "
+                    "repeating error here means a corrupt ring; close "
+                    "the offending client)")
+                busy = False
+            if not busy:
+                time.sleep(self.poll_s)
+
+    def _one_pass(self) -> bool:
+        busy = self._drain_ctl()
+        now = time.monotonic()
+        for conn in list(self._conns.values()):
+            try:
+                busy |= self._drain_client(conn)
+                busy |= self._pump_results(conn)
+            except OSError as e:
+                # a corrupt/oversized record on THIS connection's ring
+                # (recv_bytes raises): the connection is unrecoverable
+                # — misframed forever — but the server is not
+                self.log.error("wire: ring error on %s (%s) — "
+                               "declaring the client gone", conn.cid, e)
+                self._client_gone(conn, f"ring error: {e}")
+            if not conn.dead \
+                    and now - conn.last_seen > self.client_lease_s:
+                self._client_gone(
+                    conn, f"client lease ({self.client_lease_s:g} s)"
+                          " missed — client died or wedged")
+            if conn.dead and not conn.pending:
+                self._close_conn(conn)
+        return busy
+
+    def _decode(self, raw: bytes, where: str):
+        """Codec-framed decode with CRC rejection: a corrupt frame is
+        counted + logged and the connection moves on — a bad frame must
+        never be partially applied or kill the dispatcher."""
+        try:
+            payload, man = ckptlib.loads(raw, where)
+        except ckptlib.CheckpointError as e:
+            self.svc.telemetry.counter("wire_crc_rejected_total").inc()
+            self.log.error("wire: REJECTED corrupt frame on %s: %s",
+                           where, e)
+            return None
+        if man.get("wire_version") != WIRE_VERSION:
+            self.svc.telemetry.counter("wire_version_rejected_total").inc()
+            self.log.error(
+                "wire: REJECTED frame on %s: wire_version %r != %d",
+                where, man.get("wire_version"), WIRE_VERSION)
+            return None
+        return payload, man
+
+    def _drain_ctl(self) -> bool:
+        busy = False
+        while True:
+            raw = self._ctl.recv_bytes()
+            if raw is None:
+                return busy
+            busy = True
+            dec = self._decode(raw, self._ctl.name)
+            if dec is None:
+                continue
+            payload, man = dec
+            if man.get("kind") != K_HELLO:
+                self.log.warning("wire: non-hello frame kind %r on the "
+                                 "control ring — ignored", man.get("kind"))
+                continue
+            cid = str(payload.get("client", ""))
+            if not cid or cid in self._conns:
+                self.log.warning("wire: bad/duplicate hello %r", cid)
+                continue
+            try:
+                c2s = transport.open_when_ready(f"{self.base}.{cid}.c2s")
+                s2c = transport.open_when_ready(f"{self.base}.{cid}.s2c")
+            except OSError as e:
+                self.log.error("wire: hello from %r but its rings never "
+                               "appeared: %s", cid, e)
+                continue
+            conn = _Conn(cid, c2s, s2c)
+            self._conns[cid] = conn
+            _send(conn.s2c, _frame(K_HELLO_ACK, {
+                "server": self.base,
+                "workers": int(self.svc.stats.get("workers", 1))}),
+                log=self.log, what="hello-ack")
+            self.log.info("wire: client %s connected", cid)
+
+    def _drain_client(self, conn: _Conn) -> bool:
+        busy = False
+        while not conn.dead:
+            raw = conn.c2s.recv_bytes()
+            if raw is None:
+                return busy
+            busy = True
+            conn.last_seen = time.monotonic()
+            dec = self._decode(raw, conn.c2s.name)
+            if dec is None:
+                # CRC-rejected: tell the client something arrived broken
+                _send(conn.s2c, _frame(K_ERROR, {
+                    "error": "corrupt frame rejected (CRC)"}),
+                    log=self.log, what="crc-error")
+                continue
+            payload, man = dec
+            kind = man.get("kind")
+            if kind == K_PING:
+                continue
+            if kind == K_BYE:
+                self._client_gone(conn, "clean BYE", clean=True)
+                return True
+            if kind == K_SUBMIT:
+                self._handle_submit(conn, payload)
+            else:
+                self.log.warning("wire: unknown frame kind %r from %s",
+                                 kind, conn.cid)
+        return busy
+
+    def _handle_submit(self, conn: _Conn, payload: dict) -> None:
+        rid = str(payload.get("request_id") or uuid.uuid4().hex[:12])
+        # the client frame always carries the key (None when the caller
+        # set no deadline), so the connection default applies on None,
+        # not on key absence — otherwise it would be dead code
+        deadline_s = payload.get("deadline_s")
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        try:
+            ticket = self.svc.submit(
+                str(payload["kind"]), payload.get("params") or {},
+                tenant=str(payload.get("tenant", conn.cid)),
+                request_id=rid, deadline_s=deadline_s)
+        except RejectedError as e:
+            _send(conn.s2c, _frame(K_REJECT, {
+                "request_id": rid, "reason": str(e),
+                "retry_after_s": e.retry_after_s}),
+                log=self.log, what="reject")
+            return
+        except (ValueError, KeyError) as e:
+            _send(conn.s2c, _frame(K_ERROR, {
+                "request_id": rid,
+                "error": f"{type(e).__name__}: {e}"}),
+                log=self.log, what="refusal")
+            return
+        conn.pending[rid] = ticket
+        _send(conn.s2c, _frame(K_ACCEPT, {"request_id": rid}),
+              log=self.log, what="accept")
+
+    def _pump_results(self, conn: _Conn) -> bool:
+        """Forward buffered chunk events and terminal results. Runs for
+        dead connections too (a batch in flight when the client died
+        still terminates — results are discarded at the journal, not
+        the scheduler), but skips the sends."""
+        busy = False
+        for rid in list(conn.pending):
+            ticket = conn.pending[rid]
+            # capture done BEFORE draining: events always precede the
+            # resolution, so everything pushed before a True here is in
+            # the queue we are about to drain. Capturing after would
+            # race a resolve landing mid-drain and drop the trailing
+            # chunk event(s) when the rid is retired below.
+            done_now = ticket.done
+            while True:
+                try:
+                    ev = ticket._events.get_nowait()
+                except queuelib.Empty:
+                    break
+                if ev is _TICKET_SENTINEL:
+                    ticket._events.put(_TICKET_SENTINEL)   # keep sticky
+                    break
+                busy = True
+                if not conn.dead and isinstance(ev, ChunkEvent):
+                    _send(conn.s2c, _frame(K_EVENT, {
+                        "request_id": rid, "seq": ev.seq,
+                        "payload": dict(ev.payload)}),
+                        log=self.log, what="event")
+            if done_now:
+                busy = True
+                res = ticket.result(timeout=0)
+                if not conn.dead:
+                    _send(conn.s2c, _frame(K_RESULT, {
+                        "request_id": rid, "status": res.status,
+                        "value": res.value,
+                        "error": res.error.to_row() if res.error
+                        else None,
+                        "latency_s": res.latency_s,
+                        "queued_s": res.queued_s,
+                        "chunks": res.chunks,
+                        "preemptions": res.preemptions,
+                        "resumed": res.resumed,
+                        "failovers": res.failovers}),
+                        log=self.log, what="result")
+                del conn.pending[rid]
+        return busy
+
+    def _client_gone(self, conn: _Conn, reason: str,
+                     clean: bool = False) -> None:
+        """Loud disconnect: cancel the dead client's entries with a
+        structured ``cancelled`` error — queued ones immediately,
+        resident ones at their next chunk boundary — never the running
+        batch mid-kernel. Every ticket stays registered so
+        `_pump_results` retires it when its terminal (cancelled or
+        completed-and-discarded) result lands."""
+        conn.dead = True
+        outcome = {rid: self.svc.cancel(
+            rid, f"wire client {conn.cid} gone ({reason})")
+            for rid in list(conn.pending)}
+        queued = sum(1 for o in outcome.values() if o == "queued")
+        resident = sum(1 for o in outcome.values() if o == "resident")
+        terminal = len(outcome) - queued - resident
+        (self.log.info if clean else self.log.error)(
+            "wire: client %s disconnected (%s) — %d queued entr%s "
+            "cancelled now, %d resident request(s) cancelled at their "
+            "next chunk boundary, %d already terminal; results are "
+            "discarded", conn.cid, reason, queued,
+            "y" if queued == 1 else "ies", resident, terminal)
+        self.svc.telemetry.counter("wire_client_disconnects_total").inc()
+
+    def _close_conn(self, conn: _Conn) -> None:
+        self._conns.pop(conn.cid, None)
+        # the CLIENT owns its rings; the server only unmaps
+        conn.c2s.close(unlink=False)
+        conn.s2c.close(unlink=False)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(10.0)
+        for conn in list(self._conns.values()):
+            if not conn.dead:
+                _send(conn.s2c, _frame(K_ERROR, {
+                    "error": f"{E_SHUTDOWN}: wire server closing"}),
+                    grace_s=0.2)
+            self._close_conn(conn)
+        self._ctl.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class WireClient:
+    """External-process client: submit requests over the shm rings and
+    hold ordinary `Ticket`s — the same per-chunk stream + terminal
+    `Result` surface the in-process API gives, resolved by a background
+    reader thread. A rejected submit resolves the ticket with the same
+    structured ``queue_full`` failure `submit_and_wait` produces."""
+
+    def __init__(self, base: str = "aclswarm-serve",
+                 client_id: Optional[str] = None, *,
+                 tenant: Optional[str] = None,
+                 hello_timeout_s: float = 10.0,
+                 ping_s: float = 2.0, log=None):
+        self.base = base
+        self.cid = client_id or uuid.uuid4().hex[:8]
+        self.tenant = tenant or self.cid
+        self.ping_s = float(ping_s)
+        self.log = log or get_logger("serve.wire.client")
+        # the client OWNS its connection rings; the server opens them
+        # after the hello
+        self._c2s = transport.Channel(f"{base}.{self.cid}.c2s",
+                                      create=True,
+                                      capacity=RING_CAPACITY)
+        self._s2c = transport.Channel(f"{base}.{self.cid}.s2c",
+                                      create=True,
+                                      capacity=RING_CAPACITY)
+        self._ctl = transport.open_when_ready(f"{base}.ctl",
+                                              grace_s=hello_timeout_s)
+        self._tickets: dict[str, Ticket] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._connected = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"wire-client-{self.cid}")
+        self._thread.start()
+        # the ctl ring is shared by every connecting client but the shm
+        # ring is single-producer: serialize the hello behind the
+        # cross-process writer lock
+        with _ctl_writer_lock(base):
+            sent = _send(self._ctl, _frame(K_HELLO, {"client": self.cid}),
+                         grace_s=hello_timeout_s, log=self.log,
+                         what="hello")
+        if not sent:
+            self.close()
+            raise OSError(f"wire hello to {base}.ctl not accepted within "
+                          f"{hello_timeout_s:g} s (no server draining?)")
+        if not self._connected.wait(hello_timeout_s):
+            self.close()
+            raise OSError(f"wire server on {base!r} never acked the "
+                          f"hello within {hello_timeout_s:g} s")
+
+    # -------------------------------------------------------------- API
+
+    def submit(self, kind: str, params: dict, *,
+               request_id: Optional[str] = None,
+               tenant: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> Ticket:
+        rid = request_id or uuid.uuid4().hex[:12]
+        with self._lock:
+            if rid in self._tickets:
+                return self._tickets[rid]
+            ticket = Ticket(rid)
+            self._tickets[rid] = ticket
+        ok = _send(self._c2s, _frame(K_SUBMIT, {
+            "request_id": rid, "kind": kind, "params": params,
+            "tenant": tenant or self.tenant, "deadline_s": deadline_s}),
+            log=self.log, what=f"submit {rid}")
+        if not ok:
+            ticket._resolve(Result(
+                request_id=rid, status=FAILED,
+                error=ServeError(E_SHUTDOWN,
+                                 "wire submit never left the ring "
+                                 "(server not draining)")))
+        return ticket
+
+    def submit_and_wait(self, kind: str, params: dict, *,
+                        timeout: Optional[float] = None,
+                        **kw) -> Result:
+        return self.submit(kind, params, **kw).result(timeout=timeout)
+
+    # ------------------------------------------------------------- loop
+
+    def _run(self) -> None:
+        last_ping = time.monotonic()
+        while not self._stop.is_set():
+            raw = self._s2c.recv_bytes()
+            now = time.monotonic()
+            if now - last_ping >= self.ping_s:
+                # liveness: the server cancels queued entries of a
+                # client whose lease lapses — pings keep it alive while
+                # this process waits on long results
+                self._c2s.send_bytes(_frame(K_PING, {}))
+                last_ping = now
+            if raw is None:
+                time.sleep(0.002)
+                continue
+            try:
+                payload, man = ckptlib.loads(raw, self._s2c.name)
+            except ckptlib.CheckpointError as e:
+                self.log.error("wire client: corrupt server frame: %s", e)
+                continue
+            self._handle(payload, man.get("kind"))
+
+    def _handle(self, payload: dict, kind: Optional[str]) -> None:
+        if kind == K_HELLO_ACK:
+            self._connected.set()
+            return
+        rid = str(payload.get("request_id", ""))
+        ticket = self._tickets.get(rid)
+        if kind == K_EVENT and ticket is not None:
+            ticket._push(ChunkEvent(rid, int(payload.get("seq", 0)),
+                                    dict(payload.get("payload") or {})))
+        elif kind == K_RESULT and ticket is not None:
+            err = payload.get("error")
+            ticket._resolve(Result(
+                request_id=rid, status=str(payload["status"]),
+                value=payload.get("value"),
+                error=ServeError(**err) if err else None,
+                latency_s=float(payload.get("latency_s", 0.0)),
+                queued_s=float(payload.get("queued_s", 0.0)),
+                chunks=int(payload.get("chunks", 0)),
+                preemptions=int(payload.get("preemptions", 0)),
+                resumed=bool(payload.get("resumed", False)),
+                failovers=int(payload.get("failovers", 0))))
+        elif kind == K_REJECT and ticket is not None:
+            ticket._resolve(Result(
+                request_id=rid, status=FAILED,
+                error=ServeError(
+                    E_QUEUE_FULL, str(payload.get("reason", "rejected")),
+                    detail={"retry_after_s":
+                            float(payload.get("retry_after_s", 0.0))})))
+        elif kind == K_ERROR:
+            msg = str(payload.get("error", "server error"))
+            if ticket is not None:
+                ticket._resolve(Result(
+                    request_id=rid, status=FAILED,
+                    error=ServeError("wire_error", msg)))
+            else:
+                self.log.error("wire client: server error: %s", msg)
+        elif kind == K_ACCEPT:
+            pass                     # the ticket already exists
+        else:
+            self.log.warning("wire client: unknown frame kind %r", kind)
+
+    def close(self, bye: bool = True) -> None:
+        """Clean shutdown: BYE tells the server to cancel anything
+        still queued for this client (loudly, with structured errors)
+        instead of waiting out the lease."""
+        if bye:
+            try:
+                self._c2s.send_bytes(_frame(K_BYE, {}))
+            except Exception:        # noqa: BLE001 — ring may be gone
+                pass
+        self._stop.set()
+        self._thread.join(5.0)
+        self._ctl.close()
+        self._c2s.close()
+        self._s2c.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

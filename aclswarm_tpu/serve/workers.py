@@ -1,0 +1,402 @@
+"""Supervised multi-worker execution for swarmserve (docs/SERVICE.md).
+
+PR 6 deliberately shipped ONE worker thread = one device stream = one
+single point of failure — the exact design the paper's fleet forbids
+(every vehicle runs the pipeline onboard; the swarm survives member
+loss). This module removes it: a `WorkerPool` runs N supervised device
+workers (one per mesh slice on a multi-device host via
+`parallel.mesh.slice_devices`, N host threads sharing the device on the
+CPU fallback host) and treats worker death as a ROUTINE event:
+
+- **placement = matching under drift**: the admission layer shards
+  shape buckets across workers with rendezvous hashing — each bucket
+  deterministically owns one alive worker (so a compiled shape lives on
+  exactly one worker, never recompiled N times), and when the alive set
+  churns only the buckets placed on the dead worker re-match (the
+  minimal-disruption property; the same streaming-assignment-under-
+  drift shape as PAPERS.md's consensus-based distributed resource
+  matching, arXiv:1904.04318);
+- **heartbeat + lease**: every worker stamps a heartbeat each loop
+  iteration; the supervisor declares a worker dead when its thread
+  exits OR its lease lapses (a wedged-but-alive thread), fences it so a
+  zombie can never touch migrated jobs (per-job epoch counters make
+  stale writes no-ops), and requeues its in-flight work;
+- **checkpoint-backed migration**: an orphaned rollout is serialized
+  through the resilience codec (disk when journaled, in-memory frame
+  otherwise) and restored template-validated on a DIFFERENT worker —
+  resume is bit-identical, proven by `serve.smoke --multiworker` and
+  `benchmarks/serve_multiworker_soak.py`;
+- **poison bound**: each migration records the dead worker incarnation
+  in the job's excluded set; after ``max_worker_exclusions`` distinct
+  kills the request terminates with a structured ``poisoned`` error
+  instead of ping-ponging the fleet to death;
+- **circuit breaker + backoff-gated rejoin**: a dead worker slot
+  respawns after a `utils.retry.RetryPolicy` backoff that grows with
+  consecutive deaths; past ``max_worker_restarts`` the slot retires
+  (circuit open). While capacity is degraded the admission retry-after
+  hint scales by total/alive (`AdmissionControl.set_capacity`).
+
+Host-side only: the pool schedules the same jitted entry points the
+single worker drove; the compiled surface (HLO baseline) is unchanged.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import zlib
+from typing import List, Optional, Tuple
+
+from aclswarm_tpu.resilience import InjectedCrash
+from aclswarm_tpu.utils.retry import RetryPolicy, delay_for
+
+# worker-targeted crash sites: `serve.w{slot}` consulted with the
+# SLOT's cumulative round count (stable across respawns, so one drill
+# can script repeated kills of the same slot); the process-level
+# `serve` site keeps its PR-6 global-round semantics in service.py
+WORKER_SITE = "serve.w{slot}"
+
+# worker lifecycle states
+UP = "up"
+COOLDOWN = "cooldown"      # dead; rejoin gated by the backoff policy
+RETIRED = "retired"        # circuit open: max_worker_restarts exceeded
+EXITED = "exited"          # clean exit (stop/drain) — NOT a death
+
+
+@dataclasses.dataclass
+class Worker:
+    """One supervised worker slot. ``uid`` names the INCARNATION
+    (slot.generation): exclusion sets hold uids, so a respawned slot is
+    a fresh candidate while placement stays keyed on the stable slot."""
+
+    slot: int
+    gen: int = 0
+    thread: Optional[threading.Thread] = None
+    state: str = COOLDOWN
+    last_beat: float = 0.0
+    round: int = 0              # cumulative across incarnations
+    fails: int = 0              # CONSECUTIVE deaths (backoff input);
+    #                             reset by a completed round, so an
+    #                             always-on fleet absorbing occasional
+    #                             isolated deaths never retires a slot
+    rejoin_at: float = 0.0
+    fenced: bool = False        # lease-lapsed zombie: must not touch jobs
+    device: object = None       # this slot's mesh-slice lead device
+    inflight: List[Tuple[object, int]] = dataclasses.field(
+        default_factory=list)   # [(job, epoch-at-pick)]
+
+    @property
+    def uid(self) -> str:
+        return f"{self.slot}.{self.gen}"
+
+
+def place_slot(bucket, candidates: List[int],
+               key: Optional[bytes] = None) -> Optional[int]:
+    """Rendezvous (highest-random-weight) hash of a shape bucket onto
+    the candidate worker slots: every caller agrees on the owner
+    without coordination, and removing one slot re-matches ONLY the
+    buckets it owned — the minimal re-matching under churn that makes
+    worker death cheap. Deterministic (crc32, no `random`).
+    ``key`` is the precomputed ``repr(bucket).encode()`` — the hot
+    eligibility path caches it per job (buckets are immutable) so
+    queue scans don't re-encode on every poll."""
+    if not candidates:
+        return None
+    if key is None:
+        key = repr(bucket).encode()
+    return max(candidates,
+               key=lambda s: (zlib.crc32(key + f":{s}".encode()), -s))
+
+
+class WorkerPool:
+    """N supervised worker threads + one supervisor thread.
+
+    The pool owns worker LIFECYCLE (spawn, heartbeat, lease, declare-
+    dead, failover, backoff-gated rejoin); the service keeps ownership
+    of request state (rounds, finish, journal). The split keeps lock
+    ordering simple: admission's queue lock may nest the pool lock
+    (``on_take``), the pool lock never nests admission's."""
+
+    def __init__(self, service, cfg):
+        self.svc = service
+        self.cfg = cfg
+        self.log = service.log
+        self._lock = threading.Lock()
+        self._slots = [Worker(slot=i) for i in range(max(1, cfg.workers))]
+        self._supervisor: Optional[threading.Thread] = None
+        self._started = False
+        self._rejoin_policy = RetryPolicy(
+            attempts=max(1, cfg.max_worker_restarts + 1),
+            base_s=cfg.rejoin_base_s, max_s=cfg.rejoin_max_s)
+        # immutable snapshot of alive workers, rebuilt under the pool
+        # lock and read LOCK-FREE by eligibility predicates (which run
+        # under admission's queue lock — taking the pool lock there
+        # would invert the lock order)
+        self._alive_view: Tuple[Worker, ...] = ()
+        service.telemetry.gauge("serve_workers_total").set(
+            len(self._slots))
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        """Spawn every worker slot + the supervisor (idempotent)."""
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+        devices = self._slice_devices()
+        for w, dev in zip(self._slots, devices):
+            w.device = dev
+            self._spawn(w)
+        self._supervisor = threading.Thread(
+            target=self._supervise, daemon=True, name="swarmserve-sup")
+        self._supervisor.start()
+
+    def _slice_devices(self) -> list:
+        """One mesh slice per worker (`parallel.mesh.slice_devices`);
+        None per slot when the host has a single device (the CPU
+        fallback: N threads share the default stream)."""
+        try:
+            from aclswarm_tpu.parallel.mesh import slice_devices
+            slices = slice_devices(len(self._slots))
+        except Exception as e:          # noqa: BLE001 — degrade loudly
+            self.log.warning("worker device slicing unavailable (%s); "
+                             "workers share the default device", e)
+            return [None] * len(self._slots)
+        distinct = {d.id for sl in slices for d in sl}
+        if len(distinct) <= 1:
+            # single-device host: no point pinning — the workers share
+            # the default stream and the placement stays implicit
+            return [None] * len(self._slots)
+        return [sl[0] if sl else None for sl in slices]
+
+    def _spawn(self, w: Worker) -> None:
+        with self._lock:
+            w.gen += 1
+            w.state = UP
+            w.fenced = False
+            w.last_beat = time.monotonic()
+            w.inflight = []
+            t = threading.Thread(target=self._run_worker, args=(w,),
+                                 daemon=True,
+                                 name=f"swarmserve-w{w.slot}.{w.gen}")
+            w.thread = t
+            self._rebuild_alive_view()
+        self.svc.telemetry.gauge(
+            "serve_worker_up", labels={"worker": str(w.slot)}).set(1)
+        self._publish_capacity()
+        t.start()
+
+    def _rebuild_alive_view(self) -> None:
+        self._alive_view = tuple(w for w in self._slots if w.state == UP)
+
+    def _publish_capacity(self) -> None:
+        alive = sum(1 for w in self._slots if w.state == UP)
+        self.svc._adm.set_capacity(alive, len(self._slots))
+        self.svc.telemetry.gauge("serve_workers_up").set(alive)
+
+    @property
+    def started(self) -> bool:
+        return self._started
+
+    def any_alive(self) -> bool:
+        """True while anything can still make progress: a live worker
+        thread, or the supervisor (which can respawn one)."""
+        if any(w.thread is not None and w.thread.is_alive()
+               for w in self._slots):
+            return True
+        return (self._supervisor is not None
+                and self._supervisor.is_alive())
+
+    def inflight_total(self) -> int:
+        with self._lock:
+            return sum(len(w.inflight) for w in self._slots)
+
+    def join(self, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        threads = [w.thread for w in self._slots if w.thread is not None]
+        threads += [self._supervisor] if self._supervisor else []
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+
+    # --------------------------------------------------------- scheduling
+
+    def eligible(self, job, w: Worker) -> bool:
+        """Is ``job`` placed on worker ``w``? Runs under admission's
+        queue lock — reads the published alive view only, never the
+        pool lock. A job's excluded incarnations (workers it already
+        died on) are skipped; the rendezvous hash over the remaining
+        alive slots names exactly one owner."""
+        view = self._alive_view
+        if w.fenced or w.state != UP:
+            return False
+        cands = [x.slot for x in view
+                 if x.uid not in job.excluded_workers]
+        key = job.__dict__.get("_place_key")
+        if key is None:
+            key = job.__dict__["_place_key"] = repr(job.bucket).encode()
+        return place_slot(job.bucket, cands, key=key) == w.slot
+
+    # -------------------------------------------------------- worker loop
+
+    def _mark_exited(self, w: Worker, my_gen: int) -> None:
+        """Record a CLEAN exit (stop/drain): the supervisor must not
+        mistake it for a death and fail over nothing."""
+        with self._lock:
+            if w.gen == my_gen and w.state == UP:
+                w.state = EXITED
+                self._rebuild_alive_view()
+        self._publish_capacity()
+
+    def _run_worker(self, w: Worker) -> None:
+        svc = self.svc
+        my_gen = w.gen
+        while not svc._stop.is_set():
+            w.last_beat = time.monotonic()
+            if w.fenced or w.gen != my_gen:
+                return              # zombie: the supervisor replaced us
+
+            taken: dict = {}
+
+            def _take(jobs, w=w, my_gen=my_gen, taken=taken):
+                # runs under admission's queue lock: the dequeue, the
+                # epoch capture, and the in-flight registration are ONE
+                # atomic step. The picked batch is returned through
+                # `taken`, never re-read from the shared slot record —
+                # a replacement incarnation's in-flight list must be
+                # invisible to this thread.
+                with self._lock:
+                    pairs = [(j, j.epoch) for j in jobs]
+                    taken["pairs"] = pairs
+                    if w.gen == my_gen and not w.fenced:
+                        w.inflight = pairs
+                        for j in jobs:
+                            j.worker = w.slot
+                    else:
+                        taken["stale"] = True
+
+            jobs = svc._adm.pick(self.cfg.max_batch,
+                                 timeout=self.cfg.idle_poll_s,
+                                 eligible=lambda j: self.eligible(j, w),
+                                 on_take=_take)
+            if not jobs:
+                if (svc._draining.is_set() and svc._adm.empty()
+                        and self.inflight_total() == 0):
+                    self._mark_exited(w, my_gen)
+                    return          # all tenants idle: clean exit
+                continue
+            pairs = taken["pairs"]
+            if taken.get("stale"):
+                # the slot was replaced between the loop-top gen check
+                # and the pick: this thread is a zombie, but it just
+                # dequeued real jobs that are registered NOWHERE — hand
+                # them straight back so the live fleet runs them
+                svc._requeue_unowned(pairs)
+                return
+            w.round += 1
+            try:
+                svc._worker_round(pairs, w)
+            except InjectedCrash as e:
+                # the scripted worker kill: die ABRUPTLY, in-flight work
+                # still registered — exactly what a SIGKILLed worker
+                # process leaves behind. The supervisor detects the dead
+                # thread and fails the work over to a surviving worker.
+                self.log.warning("serve worker %s dying as scripted: %s",
+                                 w.uid, e)
+                return
+            except Exception as e:      # noqa: BLE001 — recorded
+                svc._fail_round(pairs, e)
+            with self._lock:
+                if w.gen == my_gen:
+                    w.inflight = []
+            # a COMPLETED round closes the breaker window: `fails`
+            # counts consecutive deaths, not lifetime deaths — an
+            # always-on fleet absorbing an isolated death every few
+            # hours must never creep toward permanent retirement
+            if w.gen == my_gen and not w.fenced:
+                w.fails = 0
+        self._mark_exited(w, my_gen)        # stop flag: clean exit
+
+    # ---------------------------------------------------------- failover
+
+    def _supervise(self) -> None:
+        """Heartbeat/lease monitor + backoff-gated respawner. Exits when
+        the service stops, or when every slot has retired (circuit open
+        fleet-wide — pending journal frames await recovery by a new
+        process), or when a drain has fully completed."""
+        svc = self.svc
+        cfg = self.cfg
+        while not svc._stop.is_set():
+            time.sleep(cfg.supervise_poll_s)
+            now = time.monotonic()
+            for w in self._slots:
+                if w.state == UP:
+                    if w.thread is not None and not w.thread.is_alive():
+                        self._declare_dead(w, "worker thread died")
+                    elif now - w.last_beat > cfg.lease_s:
+                        w.fenced = True   # zombie fence BEFORE requeue
+                        self._declare_dead(
+                            w, f"heartbeat lease ({cfg.lease_s:g} s) "
+                               "missed — worker wedged")
+                elif w.state == COOLDOWN and now >= w.rejoin_at:
+                    if svc._draining.is_set() and svc._adm.empty() \
+                            and self.inflight_total() == 0:
+                        continue    # nothing left to rejoin for
+                    self.log.warning(
+                        "serve worker slot %d rejoining after backoff "
+                        "(%d consecutive death(s))", w.slot, w.fails)
+                    self._spawn(w)
+            states = {w.state for w in self._slots}
+            if not states & {UP, COOLDOWN}:
+                # nothing left to monitor or respawn
+                if RETIRED in states:
+                    self.log.error(
+                        "serve worker fleet circuit-open: every "
+                        "non-exited slot exceeded max_worker_restarts="
+                        "%d — pending requests stay journaled for "
+                        "recovery by a new process",
+                        cfg.max_worker_restarts)
+                return
+            if svc._draining.is_set() and svc._adm.empty() \
+                    and self.inflight_total() == 0 \
+                    and not any(w.thread is not None
+                                and w.thread.is_alive()
+                                for w in self._slots):
+                return              # drain complete
+
+    def _declare_dead(self, w: Worker, reason: str) -> None:
+        """Declare one worker dead and make its loss routine: requeue
+        every in-flight job to the surviving workers (through the
+        checkpoint codec), open this slot's breaker, and re-derive the
+        backpressure hint from what is left."""
+        svc = self.svc
+        with self._lock:
+            w.fails += 1
+            uid = w.uid
+            retire = w.fails > self.cfg.max_worker_restarts
+            w.state = RETIRED if retire else COOLDOWN
+            if not retire:
+                w.rejoin_at = time.monotonic() + delay_for(
+                    self._rejoin_policy, min(w.fails - 1,
+                                             self._rejoin_policy.attempts
+                                             - 1))
+            orphans, w.inflight = w.inflight, []
+            self._rebuild_alive_view()
+        svc.telemetry.gauge(
+            "serve_worker_up", labels={"worker": str(w.slot)}).set(0)
+        svc.telemetry.counter("serve_failover_total").inc()
+        with svc._lock:
+            svc.stats["failovers"] += 1
+        self._publish_capacity()
+        (self.log.error if retire else self.log.warning)(
+            "serve worker %s declared dead (%s): %d in-flight job(s) "
+            "to fail over; slot %s", uid, reason, len(orphans),
+            "RETIRED (circuit open)" if retire
+            else f"rejoins in {max(0.0, w.rejoin_at - time.monotonic()):.2f} s")
+        svc._journal_event("failover", worker=uid, reason=reason,
+                           orphans=len(orphans), retired=retire)
+        for job, epoch in orphans:
+            # a SOLO orphan has nobody else to blame for the death —
+            # only those kills count toward the poison bound
+            svc._failover_job(job, epoch, uid,
+                              solo=len(orphans) == 1)

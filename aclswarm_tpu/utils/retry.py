@@ -161,6 +161,14 @@ def poll_until(fn: Callable[[], bool], *, grace_s: float,
     call is immediate, and the deadline bounds TOTAL wait — False means
     the grace expired with ``fn`` still failing.
 
+    The deadline is computed ONCE from the monotonic ``clock`` and every
+    sleep is capped to the remaining budget: a poll interval larger than
+    what is left can never overshoot the deadline (the old behavior
+    slept the full ``poll_s`` past the boundary, so ``grace_s=0.01,
+    poll_s=1.0`` waited ~1 s — a 100x overshoot the serve layer's
+    lease arithmetic cannot absorb). After the final capped sleep ``fn``
+    gets one last immediate check before False.
+
     ``cancel`` (optional) aborts the poll early with False; a set event
     also cuts the in-flight inter-poll sleep short (event-based wait),
     so a cancelled poller returns within one poll interval."""
@@ -170,13 +178,15 @@ def poll_until(fn: Callable[[], bool], *, grace_s: float,
             return False
         if fn():
             return True
-        if clock() > deadline:
+        remaining = deadline - clock()
+        if remaining <= 0:
             return False
+        step = min(poll_s, remaining)
         if cancel is not None:
-            if cancel.wait(poll_s):
+            if cancel.wait(step):
                 return False
         else:
-            sleep(poll_s)
+            sleep(step)
 
 
 class Watchdog:

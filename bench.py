@@ -204,7 +204,8 @@ def main(argv=None):
         "serve": dict(serve_fields.get("serve", {}),
                       request_latency_s=round(res.latency_s, 2)),
         # compact swarmscope snapshot (occupancy, queue depth,
-        # preemptions — docs/OBSERVABILITY.md); present on degraded
+        # preemptions, and the fleet provenance: worker count +
+        # failover events — docs/OBSERVABILITY.md); present on degraded
         # rows too, so row consumers never branch on key presence
         "telemetry": telemetry,
     }

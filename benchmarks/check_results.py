@@ -53,6 +53,22 @@ _SOAK_KEYS = set(_SOAK_COUNTS) | {"name", "n", "backend",
                                   "wall_s", "quick"}
 _SOAK_PCTS = ("p50", "p95", "p99")
 
+# the multi-worker chaos-soak artifact (benchmarks/
+# serve_multiworker_soak.py; docs/SERVICE.md §multi-worker): summary-
+# shaped, exact key set, counted promises that must reconcile, and the
+# acceptance criteria baked in as schema — N>=3 workers, repeated
+# single-worker kills, zero silent losses, >=1 bit-identical migrated
+# resume, fairness preserved. An artifact that stops proving those is
+# rejected, not quietly re-interpreted.
+SERVE_MW_SOAK = "serve_multiworker_soak.json"
+_MW_COUNTS = ("accepted", "completed", "rejected", "preempted",
+              "timed_out", "failed", "poisoned", "silent_losses",
+              "worker_kills", "requeued", "migrated_resumes",
+              "tenants", "workers")
+_MW_KEYS = set(_MW_COUNTS) | {"name", "n", "backend",
+                              "migrated_bit_identical", "fairness_ok",
+                              "latency_s", "wall_s", "quick"}
+
 # the serve_throughput artifact (benchmarks/serve_throughput.py; ROADMAP
 # open item 2(c)): JSON-lines, one row per offered-load level, exact key
 # set — request Hz vs batch-bucket occupancy is the continuous-batching
@@ -249,6 +265,90 @@ def check_serve_soak(obj, where: str) -> list[str]:
         probs.append(f"{where}: 'n' must be a positive int")
     return probs
 
+def check_serve_multiworker_soak(obj, where: str) -> list[str]:
+    """Validate the serve_multiworker_soak summary (exact key set,
+    reconciling counts, AND the acceptance bars: >= 3 workers, >= 1
+    worker kill, zero silent losses, >= 1 bit-identical migrated
+    resume, fairness preserved on non-quick artifacts)."""
+    if not isinstance(obj, dict):
+        return [f"{where}: not a JSON object"]
+    probs = []
+    missing, unknown = _MW_KEYS - set(obj), set(obj) - _MW_KEYS
+    if missing:
+        probs.append(f"{where}: missing keys {sorted(missing)}")
+    if unknown:
+        probs.append(f"{where}: unknown keys {sorted(unknown)} "
+                     "(exact-key-set schema)")
+    if obj.get("name") != "serve_multiworker_soak":
+        probs.append(f"{where}: 'name' must be 'serve_multiworker_soak'")
+    for k in _MW_COUNTS:
+        if k in obj and not _is_count(obj[k]):
+            probs.append(f"{where}: '{k}' must be a non-negative int, "
+                         f"got {obj[k]!r}")
+    if all(_is_count(obj.get(k)) for k in
+           ("accepted", "completed", "timed_out", "failed",
+            "silent_losses")):
+        total = (obj["completed"] + obj["timed_out"] + obj["failed"]
+                 + obj["silent_losses"])
+        if total != obj["accepted"]:
+            probs.append(
+                f"{where}: accepted ({obj['accepted']}) != completed + "
+                f"timed_out + failed + silent_losses ({total}) — the "
+                "terminal ledger must reconcile")
+    if _is_count(obj.get("poisoned")) and _is_count(obj.get("failed")) \
+            and obj["poisoned"] > obj["failed"]:
+        probs.append(f"{where}: poisoned ({obj['poisoned']}) > failed "
+                     f"({obj['failed']}) — poisoned is a failure class")
+    for k in ("migrated_bit_identical", "fairness_ok", "quick"):
+        if k in obj and not isinstance(obj[k], bool):
+            probs.append(f"{where}: '{k}' must be a bool")
+    if not obj.get("quick"):
+        # the committed (non-quick) artifact IS the acceptance evidence
+        if _is_count(obj.get("workers")) and obj["workers"] < 3:
+            probs.append(f"{where}: committed soak needs >= 3 workers, "
+                         f"got {obj['workers']}")
+        if _is_count(obj.get("worker_kills")) and obj["worker_kills"] < 1:
+            probs.append(f"{where}: committed soak recorded no worker "
+                         "kill — it proves nothing")
+        if obj.get("silent_losses") not in (0, None):
+            probs.append(f"{where}: silent_losses must be 0 "
+                         f"(got {obj.get('silent_losses')!r})")
+        if _is_count(obj.get("migrated_resumes")) \
+                and obj["migrated_resumes"] < 1:
+            probs.append(f"{where}: committed soak owes >= 1 migrated "
+                         "resume")
+        if obj.get("migrated_bit_identical") is False:
+            probs.append(f"{where}: migrated resumes were not "
+                         "bit-identical — broken promise committed")
+        if obj.get("fairness_ok") is False:
+            probs.append(f"{where}: fairness_ok=false — a tenant was "
+                         "starved during failover")
+    lat = obj.get("latency_s")
+    if lat is not None:
+        if not isinstance(lat, dict):
+            probs.append(f"{where}: 'latency_s' must be an object")
+        else:
+            miss = set(_SOAK_PCTS) - set(lat)
+            unk = set(lat) - set(_SOAK_PCTS)
+            if miss:
+                probs.append(f"{where}: latency_s missing {sorted(miss)}")
+            if unk:
+                probs.append(f"{where}: latency_s unknown keys "
+                             f"{sorted(unk)}")
+            for k in _SOAK_PCTS:
+                v = lat.get(k)
+                if v is not None and not (_finite_num(v) and v >= 0):
+                    probs.append(f"{where}: latency_s.{k} must be a "
+                                 f"finite non-negative number, got {v!r}")
+    if "wall_s" in obj and not (_finite_num(obj["wall_s"])
+                                and obj["wall_s"] >= 0):
+        probs.append(f"{where}: 'wall_s' must be a finite non-negative "
+                     f"number, got {obj['wall_s']!r}")
+    if "n" in obj and not (_is_count(obj["n"]) and obj["n"] > 0):
+        probs.append(f"{where}: 'n' must be a positive int")
+    return probs
+
+
 # resilience metadata (docs/RESILIENCE.md): optional on any row, but
 # when present the values must be well-formed — a malformed degraded
 # marker is worse than none (it reads as "not degraded")
@@ -355,6 +455,10 @@ def check_file(path: Path) -> list[str]:
         if whole is None:
             return [f"{path.name}: unparseable serve-soak artifact"]
         return check_serve_soak(whole, path.name)
+    if path.name == SERVE_MW_SOAK:
+        if whole is None:
+            return [f"{path.name}: unparseable multiworker-soak artifact"]
+        return check_serve_multiworker_soak(whole, path.name)
     if path.name in (SERVE_THROUGHPUT, TELEMETRY_OVERHEAD):
         rows, probs = [], []
         for i, line in enumerate(lines, 1):

@@ -1,0 +1,313 @@
+"""Multi-worker chaos soak for swarmserve — the worker-failover
+flagship benchmark (docs/SERVICE.md §multi-worker; ROADMAP open item
+2(b)).
+
+Three tenants submit a mixed stream (two rollout shape buckets, n=5
+and n=8, several carrying `FaultSchedule` scripts, plus single-shot
+assignment/gain-design work) into an N=3-worker journaled service
+while scripted `CrashPlan`s repeatedly SIGKILL individual workers
+MID-BATCH (thread-abrupt death: in-flight work orphaned with no
+cleanup — the same observable a killed worker process leaves) and one
+deliberately POISONED request kills every worker that touches it. The
+parent audits the fleet's promises:
+
+- **zero silent losses**: every accepted request reaches a terminal
+  result AND a journal done-frame — across every worker kill;
+- **bit-identical migrated resume**: every completed rollout's digest
+  matches an uncontended single-worker reference run, including the
+  requests that migrated workers mid-flight (checkpoint-codec
+  migration, `Result.failovers > 0`);
+- **poison bound**: the poisoned request terminates with a structured
+  ``poisoned`` error after ``max_worker_exclusions`` distinct kills —
+  it cannot ping-pong the fleet;
+- **fairness under failover**: no tenant is starved while the fleet
+  degrades — every tenant's first completion lands within the first
+  ``2 x tenants`` completions (the round-robin guarantee, now asserted
+  THROUGH worker churn);
+- **latency SLO evidence**: p50/p95/p99 accepted→terminal wall
+  latency, committed to
+  `benchmarks/results/serve_multiworker_soak.json` (exact-key-set
+  schema: `benchmarks/check_results.py`).
+
+Run:
+
+    JAX_PLATFORMS=cpu python benchmarks/serve_multiworker_soak.py \
+        [--quick] [--out benchmarks/results/serve_multiworker_soak.json]
+
+Exit 1 on any broken promise — the artifact is only committed from a
+green run.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+RESULTS = Path(__file__).resolve().parent / "results"
+WORKERS = 3
+TENANTS = ("alpha", "beta", "gamma")
+
+
+def request_mix(quick: bool) -> list[dict]:
+    """Deterministic mixed stream: two rollout shape buckets + faults +
+    single-shot kinds, spread across three tenants."""
+    ticks = 60 if quick else 120
+    mix = [
+        {"kind": "rollout", "tenant": "alpha", "request_id": "a-roll0",
+         "params": {"n": 5, "ticks": ticks, "chunk_ticks": 20,
+                    "seed": 10}},
+        {"kind": "rollout", "tenant": "alpha", "request_id": "a-roll1",
+         "params": {"n": 5, "ticks": ticks, "chunk_ticks": 20, "seed": 11,
+                    "faults": {"dropout_frac": 0.4, "drop_tick": 15,
+                               "rejoin_tick": 55}}},
+        {"kind": "rollout", "tenant": "beta", "request_id": "b-roll0",
+         "params": {"n": 8, "ticks": ticks, "chunk_ticks": 20, "seed": 20,
+                    "faults": {"link_loss": 0.2}}},
+        {"kind": "rollout", "tenant": "beta", "request_id": "b-roll1",
+         "params": {"n": 8, "ticks": ticks, "chunk_ticks": 20,
+                    "seed": 21}},
+        {"kind": "assign", "tenant": "gamma", "request_id": "g-assign",
+         "params": {"n": 16, "seed": 30}},
+        {"kind": "gains", "tenant": "gamma", "request_id": "g-gains",
+         "params": {"n": 5, "seed": 31}},
+    ]
+    if not quick:
+        mix += [
+            {"kind": "rollout", "tenant": "gamma",
+             "request_id": "g-roll0",
+             "params": {"n": 5, "ticks": ticks, "chunk_ticks": 20,
+                        "seed": 32}},
+            {"kind": "assign", "tenant": "beta", "request_id": "b-assign",
+             "params": {"n": 16, "seed": 22, "solver": "lap"}},
+        ]
+    return mix
+
+
+def _reference_digests(specs: list[dict]) -> dict[str, dict]:
+    """Uncontended single-worker oracle for every rollout spec: final
+    digest plus the per-chunk digest chain (a mismatch report that
+    names the FIRST diverging chunk is evidence; a bare final-digest
+    mismatch is just an alarm)."""
+    from aclswarm_tpu.serve import ServiceConfig, SwarmService
+
+    ref = SwarmService(ServiceConfig(max_batch=4))
+    tickets = [(s["request_id"],
+                ref.submit(s["kind"], s["params"], tenant=s["tenant"]))
+               for s in specs]
+    out = {}
+    for rid, t in tickets:
+        res = t.result(600)
+        assert res.ok, f"reference run failed for {rid}"
+        out[rid] = {"digest": int(res.value["digest"]),
+                    "chunks": [int(d) for d
+                               in res.value["chunk_digests"]]}
+    ref.close()
+    return out
+
+
+def run_soak(out: str | None, quick: bool) -> int:
+    from aclswarm_tpu.resilience import InjectedCrash, arm_many
+    from aclswarm_tpu.resilience.crash import CrashPlan
+    from aclswarm_tpu.serve import (ServiceConfig, SwarmService,
+                                    bucket_of, place_slot)
+    from aclswarm_tpu.serve.service import _read_frame
+
+    t_start = time.time()
+    problems: list[str] = []
+    mix = request_mix(quick)
+    roll_specs = [s for s in mix if s["kind"] == "rollout"]
+    # reference FIRST: warms the in-process compile cache the soak
+    # service reuses, so the kills land on execution, not compilation
+    ref = _reference_digests(roll_specs)
+
+    with tempfile.TemporaryDirectory(prefix="aclswarm_mw_soak_") as d:
+        svc = SwarmService(ServiceConfig(
+            workers=WORKERS, max_batch=2, quantum_chunks=1,
+            max_queue_per_tenant=6, max_queue_total=24, journal_dir=d,
+            supervise_poll_s=0.02, rejoin_base_s=0.05, rejoin_max_s=0.5,
+            max_worker_restarts=8))
+
+        def poison(params):
+            raise InjectedCrash("poisoned request: kills its worker")
+
+        svc.register("poison", poison)
+
+        # repeated single-worker kills: target the slots that OWN the
+        # two rollout buckets (rendezvous placement is deterministic),
+        # each at a round with that bucket's work in flight; a second
+        # kill on the n=5 slot after its respawn makes the kills
+        # REPEATED on one slot, not just one-per-slot
+        slots = list(range(WORKERS))
+        slot5 = place_slot(bucket_of("rollout", roll_specs[0]["params"]),
+                           slots)
+        slot8 = place_slot(bucket_of("rollout", roll_specs[2]["params"]),
+                           slots)
+        plans = [CrashPlan(f"serve.w{slot5}", 2, "raise"),
+                 CrashPlan(f"serve.w{slot5}", 5, "raise")]
+        if slot8 != slot5:
+            plans.append(CrashPlan(f"serve.w{slot8}", 3, "raise"))
+        arm_many(plans)
+
+        tickets = []
+        for spec in mix:
+            tickets.append((spec, svc.submit(
+                spec["kind"], spec["params"], tenant=spec["tenant"],
+                request_id=spec["request_id"])))
+        # the poisoned request rides tenant gamma's queue mid-stream
+        tickets.append((
+            {"kind": "poison", "tenant": "gamma",
+             "request_id": "g-poison"},
+            svc.submit("poison", {}, tenant="gamma",
+                       request_id="g-poison")))
+
+        order: list[tuple[str, str]] = []      # (tenant, rid) by finish
+        results = {}
+        for spec, t in tickets:
+            res = t.result(timeout=900)
+            results[spec["request_id"]] = (spec, res)
+        for spec, t in sorted(tickets,
+                              key=lambda st: results[
+                                  st[0]["request_id"]][1].latency_s):
+            order.append((spec["tenant"], spec["request_id"]))
+        arm_many([])
+        stats = dict(svc.stats)
+        svc.close()
+
+        # ---- audit: ledger, losses, migration parity, poison, fairness
+        accepted = len(tickets)
+        statuses = {rid: res.status for rid, (_, res) in results.items()}
+        completed = sum(1 for s in statuses.values() if s == "completed")
+        timed_out = sum(1 for s in statuses.values() if s == "timed_out")
+        failed = sum(1 for s in statuses.values() if s == "failed")
+        silent = accepted - (completed + timed_out + failed)
+        if silent:
+            problems.append(f"{silent} request(s) without a terminal "
+                            "status (SILENT LOSS)")
+        # every accepted request must ALSO be terminal in the journal
+        for reqf in Path(d).glob("req_*.req"):
+            if not reqf.with_suffix(".done").exists():
+                problems.append(
+                    f"journal: {reqf.name} accepted but never terminal")
+
+        pres = results["g-poison"][1]
+        if pres.status != "failed" or pres.error.code != "poisoned":
+            problems.append(
+                "poisoned request did not terminate with the structured "
+                f"poisoned error (got {pres.status}/"
+                f"{pres.error.code if pres.error else None})")
+
+        migrated = [rid for rid, (_, res) in results.items()
+                    if res.ok and res.failovers > 0]
+        mismatches = []
+        for rid, want in ref.items():
+            if statuses.get(rid) != "completed":
+                continue
+            res = results[rid][1]
+            if int(res.value["digest"]) == want["digest"]:
+                continue
+            mismatches.append(rid)
+            got_chain = [int(d) for d in res.value["chunk_digests"]]
+            diverge = next(
+                (i for i, (a, b) in enumerate(
+                    zip(got_chain, want["chunks"])) if a != b),
+                min(len(got_chain), len(want["chunks"])))
+            problems.append(
+                f"migrated/contended digest mismatch: {rid} "
+                f"(first divergent chunk {diverge}; got "
+                f"{len(got_chain)} chunks {[hex(d) for d in got_chain]}"
+                f" vs ref {[hex(d) for d in want['chunks']]}; "
+                f"failovers={res.failovers} "
+                f"preemptions={res.preemptions} chunks={res.chunks})")
+        migrated_rollouts = [r for r in migrated if r in ref]
+        bit_identical = not mismatches and bool(ref)
+        if not migrated_rollouts:
+            problems.append("no rollout ever migrated workers — the "
+                            "kills missed every in-flight batch")
+
+        # fairness through failover: every tenant's FIRST completion
+        # within the first 2 x tenants terminals (poison excluded)
+        clean_order = [(t, r) for t, r in order if r != "g-poison"]
+        first_idx = {}
+        for i, (tenant, _) in enumerate(clean_order):
+            first_idx.setdefault(tenant, i)
+        fairness_ok = (set(first_idx) == set(TENANTS)
+                       and max(first_idx.values()) < 2 * len(TENANTS))
+        if not fairness_ok:
+            problems.append(
+                f"tenant starved during failover: first-completion "
+                f"indices {first_idx}")
+
+        if stats["failovers"] < 3:
+            problems.append(
+                f"expected >= 3 worker kills (2 scripted + poison), "
+                f"got failovers={stats['failovers']}")
+
+        lat = sorted(res.latency_s for _, res in results.values())
+
+    row = {
+        "name": "serve_multiworker_soak",
+        "n": 8,                       # largest rollout shape in the mix
+        "backend": _backend(),
+        "workers": WORKERS,
+        "tenants": len(TENANTS),
+        "accepted": accepted,
+        "completed": completed,
+        "rejected": int(stats["rejected"]),
+        "preempted": int(stats["preempted"]),
+        "timed_out": timed_out,
+        "failed": failed,
+        "poisoned": int(stats["poisoned"]),
+        "silent_losses": int(silent),
+        "worker_kills": int(stats["failovers"]),
+        "requeued": int(stats["requeued"]),
+        "migrated_resumes": len(migrated_rollouts),
+        "migrated_bit_identical": bool(bit_identical
+                                       and migrated_rollouts),
+        "fairness_ok": bool(fairness_ok),
+        "latency_s": {
+            "p50": round(float(np.percentile(lat, 50)), 3),
+            "p95": round(float(np.percentile(lat, 95)), 3),
+            "p99": round(float(np.percentile(lat, 99)), 3),
+        },
+        "wall_s": round(time.time() - t_start, 1),
+        "quick": bool(quick),
+    }
+    print(json.dumps(row, indent=1))
+    if problems:
+        print(f"SOAK FAILED ({len(problems)} broken promise(s)):")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    if out:
+        p = Path(out)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(row, indent=1) + "\n")
+        print(f"wrote {p}")
+    return 0
+
+
+def _backend() -> str:
+    import jax
+    return jax.default_backend()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="smaller mix (CI smoke; artifact not committed)")
+    ap.add_argument("--out",
+                    default=str(RESULTS / "serve_multiworker_soak.json"),
+                    help="artifact path ('' to skip writing)")
+    args = ap.parse_args(argv)
+    return run_soak(args.out or None, args.quick)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,10 @@
 #   JAX_PLATFORMS=cpu python -m aclswarm_tpu.serve.smoke
 #                               serve smoke: SIGKILL the serving worker
 #                               mid-batch, recover, zero losses
+#   JAX_PLATFORMS=cpu python -m aclswarm_tpu.serve.smoke --multiworker
+#                               worker-crash failover smoke: kill one of
+#                               two workers mid-batch, zero loss +
+#                               bit-identical migrated resume
 #   pytest tests/test_analysis.py tests/test_invariants.py \
 #          tests/test_results_schema.py tests/test_resilience.py \
 #          tests/test_serve.py                      guard self-tests
@@ -45,7 +49,8 @@ import sys
 sys.path.insert(0, "benchmarks")
 from check_results import RESULTS, check_file  # noqa: E402
 
-for name in ("serve_throughput.json", "telemetry_overhead.json"):
+for name in ("serve_throughput.json", "telemetry_overhead.json",
+             "serve_multiworker_soak.json"):
     path = RESULTS / name
     if not path.exists():
         print(f"FAIL: missing owed artifact benchmarks/results/{name}")
@@ -65,6 +70,11 @@ echo "== serve smoke: start the service, submit 3 mixed requests, =="
 echo "== SIGKILL the worker mid-batch, recover the journal — zero =="
 echo "== losses + bit-identical resume (docs/SERVICE.md) =="
 JAX_PLATFORMS=cpu python -m aclswarm_tpu.serve.smoke
+
+echo "== multi-worker crash-failover smoke: kill one of two workers =="
+echo "== mid-batch — zero loss, bit-identical migrated resume, the =="
+echo "== service keeps serving (docs/SERVICE.md §multi-worker) =="
+JAX_PLATFORMS=cpu python -m aclswarm_tpu.serve.smoke --multiworker
 
 # tier-1 duration guard: the verify command (ROADMAP.md) runs under a
 # hard 870 s timeout and tees its log to /tmp/_t1.log; fail loudly once
@@ -95,9 +105,10 @@ else
     echo "no tier-1 log at $T1_LOG — skipping (run tier-1 first)"
 fi
 
-echo "== guard self-tests (lint fixtures, audit grid, invariant contracts, resilience, serve, telemetry) =="
+echo "== guard self-tests (lint fixtures, audit grid, invariant contracts, resilience, serve, wire, telemetry) =="
 exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_analysis.py tests/test_invariants.py \
     tests/test_results_schema.py tests/test_resilience.py \
-    tests/test_serve.py tests/test_telemetry.py \
+    tests/test_serve.py tests/test_serve_wire.py \
+    tests/test_telemetry.py \
     -q -m 'not slow' -p no:cacheprovider

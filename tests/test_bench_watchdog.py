@@ -35,6 +35,17 @@ def test_wedge_emits_single_degraded_line_rc0():
     d = lines[0]
     assert d["metric"] == "sinkhorn_assign_n1000_hz"
     assert d["degraded"] is True and "error" in d and d["value"] == 0.0
+    _assert_fleet_telemetry(d)
+
+
+def _assert_fleet_telemetry(row: dict) -> None:
+    """EVERY bench outcome — degraded included — carries the telemetry
+    block with the fleet-provenance keys (PR 8): `workers` (serving
+    capacity behind the row) and `failovers` (worker deaths survived
+    while producing it). Zeroed when no service ever started."""
+    tel = row["telemetry"]
+    assert isinstance(tel["workers"], int) and tel["workers"] >= 0
+    assert isinstance(tel["failovers"], int) and tel["failovers"] >= 0
 
 
 def test_probe_timeout_emits_degraded_line_fast_rc0():
@@ -56,6 +67,10 @@ def test_probe_timeout_emits_degraded_line_fast_rc0():
     assert d["metric"] == "sinkhorn_assign_n1000_hz"
     assert d["degraded"] is True
     assert "probe" in d["error"] and d["value"] == 0.0
+    # no service ever started: the fleet keys are present and zeroed
+    _assert_fleet_telemetry(d)
+    assert d["telemetry"]["workers"] == 0
+    assert d["telemetry"]["failovers"] == 0
 
 
 def test_probe_reports_backend_name():

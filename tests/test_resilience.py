@@ -160,6 +160,132 @@ class TestManifestRejection:
                 batch_flex=True)
 
 
+# -------------------------------------------- append log (torn tail)
+
+class TestFrameLog:
+    """The append-only frame log's recovery contract (docs/RESILIENCE
+    .md): a truncated/CRC-failing TRAILING record is a crash mid-append
+    and reads as clean EOF; any non-trailing corruption raises
+    `CheckpointCorrupt` loudly."""
+
+    def _log(self, path, n=3):
+        for i in range(n):
+            ckptlib.append_frame(
+                path, {"i": i, "blob": np.arange(4) + i},
+                ckptlib.make_manifest("ev", "-", chunk=i, event="e"))
+        return path.read_bytes()
+
+    def test_roundtrip_and_clean_eof(self, tmp_path):
+        p = tmp_path / "events.log"
+        self._log(p, 3)
+        frames, torn = ckptlib.read_frame_log(p)
+        assert not torn and len(frames) == 3
+        assert [m["chunk"] for _, m in frames] == [0, 1, 2]
+        assert np.array_equal(frames[2][0]["blob"], np.arange(4) + 2)
+        empty = tmp_path / "empty.log"
+        empty.write_bytes(b"")
+        assert ckptlib.read_frame_log(empty) == ([], False)
+
+    @pytest.mark.parametrize("cut", [1, 2, 3])
+    def test_truncated_tail_is_clean_eof(self, tmp_path, cut):
+        """Byte-level truncation anywhere inside the LAST record —
+        mid-length-prefix, mid-header, mid-body — drops exactly that
+        record and flags the torn tail."""
+        p = tmp_path / "events.log"
+        buf = self._log(p, 3)
+        # find the start of record 3: re-read 2-record log length
+        p2 = tmp_path / "two.log"
+        two = self._log(p2, 2)
+        offsets = {1: len(two) + 2,          # inside record 3's prefix
+                   2: len(two) + 6,          # inside its frame header
+                   3: len(buf) - 3}          # inside its body/CRC
+        p.write_bytes(buf[:offsets[cut]])
+        frames, torn = ckptlib.read_frame_log(p)
+        assert torn and len(frames) == 2
+        assert [m["chunk"] for _, m in frames] == [0, 1]
+
+    def test_crc_failing_trailing_record_is_clean_eof(self, tmp_path):
+        p = tmp_path / "events.log"
+        buf = bytearray(self._log(p, 3))
+        buf[-1] ^= 0xFF                      # corrupt the LAST record
+        p.write_bytes(bytes(buf))
+        frames, torn = ckptlib.read_frame_log(p)
+        assert torn and len(frames) == 2
+
+    def test_non_trailing_corruption_raises_loudly(self, tmp_path):
+        p = tmp_path / "events.log"
+        buf = bytearray(self._log(p, 3))
+        two = self._log(tmp_path / "two.log", 2)
+        buf[len(two) - 8] ^= 0xFF            # corrupt record 2's body
+        p.write_bytes(bytes(buf))
+        with pytest.raises(CheckpointCorrupt, match="non-trailing"):
+            ckptlib.read_frame_log(p)
+
+    def test_serve_recovery_tolerates_torn_events_log(self, tmp_path):
+        """End to end: a serve journal whose events.log ends mid-append
+        recovers cleanly (counters from the intact records), while
+        mid-log corruption fails recovery loudly."""
+        from aclswarm_tpu.serve import ServiceConfig, SwarmService
+
+        log = tmp_path / "events.log"
+        for i in range(3):
+            ckptlib.append_frame(
+                log, {"request_id": f"r{i}", "dead_worker": "0.1",
+                      "chunk": i},
+                ckptlib.make_manifest("serve_event", "-", chunk=0,
+                                      event="requeue", t_wall=0.0))
+        buf = log.read_bytes()
+        log.write_bytes(buf[:-5])            # torn trailing append
+        svc = SwarmService(ServiceConfig(journal_dir=str(tmp_path)),
+                           start=False)
+        assert svc.stats["requeued"] == 2    # intact records recovered
+        svc.close(drain=False)
+        # non-trailing corruption: recovery must NOT silently continue
+        # (byte 30 sits in record 0's CRC-covered body; the reserved
+        # header bytes are deliberately NOT covered)
+        bad = bytearray(buf)
+        bad[30] ^= 0xFF
+        log.write_bytes(bytes(bad))
+        with pytest.raises(CheckpointCorrupt):
+            SwarmService(ServiceConfig(journal_dir=str(tmp_path)),
+                         start=False)
+
+
+# ------------------------------------------------ multi-plan crash arming
+
+class TestMultiPlanArming:
+    def test_decode_many_and_each_plan_one_shot(self):
+        plans = CrashPlan.decode_many("serve.w0:2:raise,serve.w1:5")
+        assert plans == [CrashPlan("serve.w0", 2, "raise"),
+                         CrashPlan("serve.w1", 5, "raise")]
+        crashlib.arm_many(plans)
+        crashlib.maybe_crash("serve.w0", 1)      # no match: no-op
+        with pytest.raises(InjectedCrash):
+            crashlib.maybe_crash("serve.w0", 2)
+        # consuming one plan leaves the OTHER armed (repeated kills)
+        crashlib.maybe_crash("serve.w0", 2)      # spent: no-op
+        with pytest.raises(InjectedCrash):
+            crashlib.maybe_crash("serve.w1", 5)
+        assert crashlib.active_plans() == []
+
+    def test_env_multi_plan_consumed_one_at_a_time(self, monkeypatch):
+        monkeypatch.setenv(crashlib.ENV_VAR, "a:1:raise,b:2:raise")
+        with pytest.raises(InjectedCrash):
+            crashlib.maybe_crash("a", 1)
+        # only the matched spec was removed from the env
+        import os
+        assert os.environ[crashlib.ENV_VAR] == "b:2:raise"
+        with pytest.raises(InjectedCrash):
+            crashlib.maybe_crash("b", 2)
+        assert crashlib.ENV_VAR not in os.environ
+
+    def test_single_plan_api_unchanged(self):
+        crashlib.arm(CrashPlan("t", 1))
+        assert crashlib.active_plan() == CrashPlan("t", 1)
+        crashlib.arm(None)
+        assert crashlib.active_plan() is None
+
+
 # ----------------------------------------------------------- retry layer
 
 class TestRetry:
@@ -234,6 +360,55 @@ class TestRetry:
         clock[0] = 0.0
         assert not retrylib.poll_until(ready, grace_s=3.0, poll_s=1.0,
                                        clock=lambda: clock[0], sleep=tick)
+
+    def test_poll_until_never_overshoots_deadline(self):
+        """Regression (PR 8): the deadline is computed once from the
+        monotonic clock and the FINAL sleep is capped to the remaining
+        budget — a poll interval larger than the grace must not
+        overshoot (the old loop slept the full poll_s past the
+        boundary: grace_s=0.01 with poll_s=1.0 waited ~1 s)."""
+        clock = [0.0]
+        sleeps: list[float] = []
+
+        def tick(s):
+            sleeps.append(s)
+            clock[0] += s
+
+        assert not retrylib.poll_until(
+            lambda: False, grace_s=0.01, poll_s=1.0,
+            clock=lambda: clock[0], sleep=tick)
+        assert clock[0] == pytest.approx(0.01)     # not 1.0
+        assert sleeps == [pytest.approx(0.01)]     # capped final sleep
+
+        # a poll_s that does not divide the grace: last sleep is the
+        # exact remainder, total wait == grace
+        clock[0] = 0.0
+        sleeps.clear()
+        assert not retrylib.poll_until(
+            lambda: False, grace_s=2.5, poll_s=1.0,
+            clock=lambda: clock[0], sleep=tick)
+        assert sleeps == [1.0, 1.0, pytest.approx(0.5)]
+        assert clock[0] == pytest.approx(2.5)
+
+        # the cancel-event path caps the final wait identically
+        import threading
+
+        class _Ev(threading.Event):
+            def __init__(self, log):
+                super().__init__()
+                self._log = log
+
+            def wait(self, t=None):
+                self._log.append(t)
+                clock[0] += t
+                return False
+
+        waits: list[float] = []
+        clock[0] = 0.0
+        assert not retrylib.poll_until(
+            lambda: False, grace_s=0.25, poll_s=1.0,
+            clock=lambda: clock[0], cancel=_Ev(waits))
+        assert waits == [pytest.approx(0.25)]
 
     def test_watchdog_finish_vs_fire_atomic(self):
         fired = []

@@ -207,6 +207,80 @@ def test_serve_soak_artifact_committed():
     assert row["preempted"] > 0 and row["rejected"] > 0
 
 
+def _mw_row(**over):
+    row = {
+        "name": "serve_multiworker_soak", "n": 8, "backend": "cpu",
+        "workers": 3, "tenants": 3, "accepted": 9, "completed": 8,
+        "rejected": 0, "preempted": 16, "timed_out": 0, "failed": 1,
+        "poisoned": 1, "silent_losses": 0, "worker_kills": 5,
+        "requeued": 6, "migrated_resumes": 3,
+        "migrated_bit_identical": True, "fairness_ok": True,
+        "latency_s": {"p50": 4.6, "p95": 5.6, "p99": 5.6},
+        "wall_s": 11.7, "quick": False,
+    }
+    row.update(over)
+    return row
+
+
+def test_serve_multiworker_soak_schema_accepts_valid_row(tmp_path):
+    p = tmp_path / "serve_multiworker_soak.json"
+    p.write_text(json.dumps(_mw_row(), indent=1) + "\n")
+    assert check_file(p) == []
+
+
+def test_serve_multiworker_soak_schema_flags_drift(tmp_path):
+    """Exact key set + the acceptance bars AS schema: a committed
+    artifact that stops proving N>=3 workers / zero loss / migrated
+    bit-identical resume / fairness is rejected, not re-interpreted."""
+    p = tmp_path / "serve_multiworker_soak.json"
+    cases = [
+        ({k: v for k, v in _mw_row().items() if k != "worker_kills"},
+         "missing keys"),
+        (_mw_row(extra=1), "unknown keys"),
+        (_mw_row(requeued=-1), "non-negative"),
+        (_mw_row(completed=7), "must reconcile"),
+        (_mw_row(poisoned=2, failed=1), "failure class"),
+        (_mw_row(workers=2), ">= 3 workers"),
+        (_mw_row(worker_kills=0), "no worker kill"),
+        (_mw_row(silent_losses=1, completed=7), "silent_losses"),
+        (_mw_row(migrated_resumes=0), "migrated resume"),
+        (_mw_row(migrated_bit_identical=False), "not bit-identical"),
+        (_mw_row(fairness_ok=False), "starved"),
+        (_mw_row(latency_s={"p50": float("inf"), "p95": 1.0,
+                            "p99": 2.0}), "finite"),
+    ]
+    for row, needle in cases:
+        p.write_text(json.dumps(row, indent=1) + "\n")
+        probs = check_file(p)
+        assert probs and any(needle in x for x in probs), (row, probs)
+    # a QUICK run may legitimately be thinner — the bars only bind the
+    # committed (non-quick) artifact
+    p.write_text(json.dumps(
+        _mw_row(quick=True, workers=2, worker_kills=0,
+                migrated_resumes=0), indent=1) + "\n")
+    assert check_file(p) == []
+
+
+def test_serve_multiworker_soak_artifact_committed():
+    """The multi-worker failover evidence (ISSUE 8 acceptance): N>=3
+    workers, repeated single-worker kills mid-batch, zero silent
+    losses, >= 1 bit-identical cross-worker migrated resume, no tenant
+    starved, and the poison bound exercised."""
+    from check_results import check_serve_multiworker_soak
+    path = RESULTS / "serve_multiworker_soak.json"
+    assert path.exists(), \
+        "benchmarks/results/serve_multiworker_soak.json missing " \
+        "(python benchmarks/serve_multiworker_soak.py)"
+    row = json.loads(path.read_text())
+    assert check_serve_multiworker_soak(row, path.name) == []
+    assert row["workers"] >= 3 and row["worker_kills"] >= 2
+    assert row["silent_losses"] == 0
+    assert row["migrated_resumes"] >= 1
+    assert row["migrated_bit_identical"] is True
+    assert row["fairness_ok"] is True
+    assert row["poisoned"] >= 1          # the ping-pong bound fired
+
+
 def test_resilience_overhead_artifact_committed():
     """The checkpoint-tax evidence (acceptance: <5% at n=10 at the
     default cadence) is committed and on schema."""

@@ -246,18 +246,24 @@ class TestRecovery:
         `serve.smoke`/`serve_soak`): the worker dies mid-batch via an
         injected crash; a new service on the same journal re-admits
         every accepted request, resumes the rollout from its checkpoint,
-        and terminates all of them."""
+        and terminates all of them. ``max_worker_restarts=0`` retires
+        the slot on its first death (circuit open immediately) — the
+        recovery-by-new-process scenario, as opposed to the in-process
+        failover the multiworker tests prove."""
         svc = SwarmService(ServiceConfig(max_batch=1, quantum_chunks=1,
-                                         journal_dir=str(tmp_path)))
+                                         journal_dir=str(tmp_path),
+                                         max_worker_restarts=0,
+                                         supervise_poll_s=0.02))
         crashlib.arm(CrashPlan("serve", 2, "raise"))
         svc.submit("rollout", ROLL_FAULTED, tenant="a",
                    request_id="roll")
         svc.submit("assign", {"n": 10, "seed": 4}, tenant="b",
                    request_id="asg")
         deadline = time.monotonic() + 60
-        while svc._worker.is_alive() and time.monotonic() < deadline:
+        while svc.alive and time.monotonic() < deadline:
             time.sleep(0.05)
-        assert not svc._worker.is_alive()      # died mid-batch
+        assert not svc.alive                   # died mid-batch, slot
+        #                                        retired: fleet dead
         done = {p.name for p in tmp_path.glob("req_*.done")}
         reqs = {p.name for p in tmp_path.glob("req_*.req")}
         assert reqs == {"req_roll.req", "req_asg.req"}
@@ -309,7 +315,7 @@ class TestFairnessAndShutdown:
         flood = [svc.submit("rollout", dict(ROLL, seed=50 + i),
                             tenant="a") for i in range(6)]
         tb = svc.submit("rollout", dict(ROLL, seed=99), tenant="b")
-        svc._worker.start()
+        svc.start()
         rb = tb.result(timeout=240)
         assert rb.ok
         done_of_a = sum(1 for t in flood if t.done)
@@ -321,8 +327,10 @@ class TestFairnessAndShutdown:
     def test_all_tenants_idle_clean_shutdown(self):
         svc = SwarmService(ServiceConfig())
         assert svc.submit("assign", {"n": 8}).result(timeout=240).ok
-        svc.close()                      # drain: idle -> worker exits
-        assert not svc._worker.is_alive()
+        svc.close()                      # drain: idle -> workers exit
+        assert not svc.alive
+        # a clean drain-exit is NOT a worker death: no failover fired
+        assert svc.stats["failovers"] == 0
         svc.close()                      # idempotent
         with pytest.raises(RejectedError, match="shutdown"):
             svc.submit("assign", {"n": 8})
@@ -383,6 +391,207 @@ class TestSubmitAndWait:
         svc.close(drain=False)
 
 
+# -------------------------------------------- multi-worker + failover
+
+MW_ROLL = {"n": 5, "ticks": 80, "chunk_ticks": 20, "seed": 6}
+
+
+def _mw_bucket():
+    from aclswarm_tpu.serve import bucket_of
+    return bucket_of("rollout", MW_ROLL)
+
+
+def _mw_config(**kw):
+    base = dict(workers=2, max_batch=1, quantum_chunks=8,
+                supervise_poll_s=0.02, rejoin_base_s=0.02,
+                rejoin_max_s=0.2)
+    base.update(kw)
+    return ServiceConfig(**base)
+
+
+class TestMultiWorker:
+    def test_place_slot_deterministic_and_minimal_rematch(self):
+        """Rendezvous placement: deterministic, total over buckets, and
+        removing one slot re-matches ONLY the buckets it owned."""
+        from aclswarm_tpu.serve import place_slot
+        buckets = [("rollout", n, 20, "auction", 20)
+                   for n in (5, 8, 16, 100)] + [("single", "assign")]
+        before = {b: place_slot(b, [0, 1, 2]) for b in buckets}
+        assert before == {b: place_slot(b, [0, 1, 2]) for b in buckets}
+        assert all(s in (0, 1, 2) for s in before.values())
+        dead = 0
+        after = {b: place_slot(b, [1, 2]) for b in buckets}
+        for b in buckets:
+            if before[b] != dead:
+                assert after[b] == before[b], \
+                    "a surviving slot's bucket re-matched needlessly"
+            else:
+                assert after[b] in (1, 2)
+        assert place_slot(("x",), []) is None
+
+    def test_submit_and_wait_returns_migrated_result_not_worker_died(
+            self):
+        """Client-side liveness THROUGH a failover: a worker kill
+        mid-rollout must surface the migrated result — the service is
+        degraded, not dead, so `submit_and_wait` must keep waiting
+        instead of reporting worker_died."""
+        ref = SwarmService(ServiceConfig())
+        want = ref.submit("rollout", MW_ROLL).result(240)
+        ref.close()
+
+        svc = SwarmService(_mw_config())
+        from aclswarm_tpu.serve import place_slot
+        slot = place_slot(_mw_bucket(), [0, 1])
+        crashlib.arm(CrashPlan(f"serve.w{slot}", 2, "raise"))
+        r = submit_and_wait(svc, "rollout", MW_ROLL, poll_s=0.1,
+                            client_timeout_s=240)
+        assert r.ok, f"expected migrated result, got {r.error}"
+        assert r.failovers >= 1
+        assert r.value["digest"] == want.value["digest"]
+        assert svc.stats["failovers"] >= 1
+        assert svc.stats["requeued"] >= 1
+        svc.close()
+
+    def test_stream_survives_migration_no_sticky_end_marker(self):
+        """`Ticket.stream` across a worker kill: chunk events stay
+        contiguous (no repeats, no gaps), and the stream does NOT
+        terminate mid-migration — only the terminal result closes it."""
+        svc = SwarmService(_mw_config())
+        from aclswarm_tpu.serve import place_slot
+        slot = place_slot(_mw_bucket(), [0, 1])
+        crashlib.arm(CrashPlan(f"serve.w{slot}", 2, "raise"))
+        t = svc.submit("rollout", MW_ROLL, tenant="a")
+        chunks = [ev.payload["chunk"] for ev in t.stream(timeout=240)]
+        # the stream only ended because the request resolved
+        assert t.done and t.result(timeout=5).ok
+        assert chunks == list(range(MW_ROLL["ticks"]
+                                    // MW_ROLL["chunk_ticks"]))
+        svc.close()
+
+    def test_poisoned_request_bounded_and_fleet_survives(self):
+        """A request that kills every worker it touches terminates with
+        a structured `poisoned` error after max_worker_exclusions
+        distinct kills — and the fleet keeps serving other tenants."""
+        from aclswarm_tpu.resilience import InjectedCrash
+        svc = SwarmService(_mw_config(max_worker_exclusions=2,
+                                      max_worker_restarts=6))
+        svc.register("poison", lambda p: (_ for _ in ()).throw(
+            InjectedCrash("poison")))
+        tp = svc.submit("poison", {}, tenant="evil")
+        rp = tp.result(timeout=120)
+        assert rp.status == FAILED and rp.error.code == "poisoned"
+        assert rp.failovers == 2
+        assert svc.stats["poisoned"] == 1
+        # bystander work still completes on the (respawned) fleet
+        assert svc.submit("assign", {"n": 8, "seed": 2},
+                          tenant="good").result(timeout=120).ok
+        assert svc.alive
+        svc.close()
+
+    def test_innocent_batch_mates_of_kills_are_exonerated_not_poisoned(
+            self):
+        """Quarantine semantics: two healthy rollouts share the batch
+        the scripted kills keep orphaning. They become suspects, run
+        their quarantine rounds solo, get exonerated by the surviving
+        chunk, and COMPLETE bit-identically — only solo-implicated
+        kills count toward the poison bound, so innocents never reach
+        it (regression: with a plain exclusion count, batch-mates of
+        two kills terminated `poisoned` despite being healthy)."""
+        specs = [dict(MW_ROLL, seed=41), dict(MW_ROLL, seed=42)]
+        ref = SwarmService(ServiceConfig(max_batch=4))
+        want = [ref.submit("rollout", s).result(240) for s in specs]
+        ref.close()
+
+        from aclswarm_tpu.serve import place_slot
+        svc = SwarmService(_mw_config(max_batch=2,
+                                      max_worker_exclusions=2,
+                                      max_worker_restarts=9))
+        # kill the bucket owner twice: both rollouts are in-flight
+        # batch-mates each time (rounds interleave solo quarantine
+        # rounds in between, where exoneration happens)
+        slot = place_slot(_mw_bucket(), [0, 1])
+        crashlib.arm(None)
+        from aclswarm_tpu.resilience import arm_many
+        from aclswarm_tpu.resilience.crash import CrashPlan as CP
+        arm_many([CP(f"serve.w{slot}", 2, "raise"),
+                  CP(f"serve.w{slot}", 5, "raise")])
+        ts = [svc.submit("rollout", s, tenant="a") for s in specs]
+        res = [t.result(timeout=240) for t in ts]
+        arm_many([])
+        assert svc.stats["failovers"] >= 1
+        assert svc.stats["poisoned"] == 0
+        for r, w in zip(res, want):
+            assert r.ok, f"innocent batch-mate terminated: {r.error}"
+            assert r.value["digest"] == w.value["digest"]
+        assert any(r.failovers >= 1 for r in res)
+        svc.close()
+
+    def test_retry_after_scales_with_surviving_capacity(self):
+        """Graceful degradation: the EWMA backpressure hint re-derives
+        from surviving capacity — half the fleet dead doubles the
+        drain estimate for the same backlog."""
+        from aclswarm_tpu.serve.admission import AdmissionControl
+        adm = AdmissionControl(8, 32)
+        adm.note_service(1.0)       # pull the EWMA somewhere known
+
+        class _J:
+            def __init__(self):
+                self.req = type("R", (), {"tenant": "t"})()
+                self.held = False
+                self.bucket = ("x",)
+        adm.admit(_J(), force=True)
+        full = adm.retry_after()
+        adm.set_capacity(alive=1, total=2)
+        assert adm.retry_after() == pytest.approx(min(30.0, 2 * full))
+        adm.set_capacity(alive=0, total=2)
+        assert adm.retry_after() == 30.0    # ceiling while fleet is down
+        adm.set_capacity(alive=2, total=2)
+        assert adm.retry_after() == pytest.approx(full)
+
+    def test_cancel_queued_now_resident_at_boundary(self):
+        """`cancel` (the wire layer's disconnect semantics): a QUEUED
+        request cancels immediately with a structured error; a RESIDENT
+        request is never cancelled mid-batch — it terminates at its
+        next chunk boundary."""
+        svc = SwarmService(ServiceConfig(max_batch=1), start=False)
+        t1 = svc.submit("rollout", dict(ROLL, seed=31), tenant="a",
+                        request_id="c1")
+        assert svc.cancel("c1", "client vanished")
+        r1 = t1.result(timeout=5)
+        assert r1.status == FAILED and r1.error.code == "cancelled"
+        assert "client vanished" in r1.error.message
+        assert not svc.cancel("c1")          # already terminal
+        assert not svc.cancel("nonexistent")
+        # resident: a long rollout is mid-batch when cancel arrives
+        t2 = svc.submit("rollout", {"n": 5, "ticks": 10_000,
+                                    "chunk_ticks": 20, "seed": 32},
+                        tenant="a", request_id="c2")
+        svc.start()
+        # wait until it has produced at least one chunk (resident)
+        next(iter(t2.stream(timeout=120)))
+        assert svc.cancel("c2", "client vanished mid-run")
+        r2 = t2.result(timeout=120)
+        assert r2.status == FAILED and r2.error.code == "cancelled"
+        assert r2.chunks >= 1               # boundary cancel, not mid-
+        svc.close()
+
+    def test_worker_telemetry_and_compact_fleet_keys(self):
+        """Per-worker ServeStats ride the registry: worker_up gauges,
+        failover/requeue/poisoned counters, per-worker occupancy — and
+        `compact()` carries the bench-row fleet keys."""
+        svc = SwarmService(_mw_config(workers=2))
+        assert svc.submit("rollout", dict(ROLL, seed=77)).result(240).ok
+        st = svc.serve_stats()
+        assert st.workers == 2 and st.workers_up == 2
+        assert set(st.per_worker) <= {"0", "1"}
+        assert sum(w["chunks"] for w in st.per_worker.values()) >= 3
+        c = st.compact()
+        assert c["workers"] == 2 and c["failovers"] == 0
+        from aclswarm_tpu.serve import ServeStats
+        assert set(ServeStats.empty_compact()) == set(c)
+        svc.close()
+
+
 # ----------------------------------------------------------- soak sizes
 
 @pytest.mark.slow
@@ -396,6 +605,32 @@ def test_serve_soak_quick_subprocess():
     assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
     assert '"silent_losses": 0' in r.stdout
     assert '"resume_bit_identical": true' in r.stdout
+
+
+@pytest.mark.slow
+def test_serve_multiworker_soak_quick_subprocess():
+    """The multi-worker chaos soak (repeated worker kills + poison +
+    migration parity + fairness audit) in quick sizing."""
+    r = subprocess.run(
+        [sys.executable,
+         str(REPO / "benchmarks" / "serve_multiworker_soak.py"),
+         "--quick", "--out", ""],
+        capture_output=True, text=True, timeout=570, cwd=str(REPO))
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert '"silent_losses": 0' in r.stdout
+    assert '"migrated_bit_identical": true' in r.stdout
+    assert '"fairness_ok": true' in r.stdout
+
+
+@pytest.mark.slow
+def test_serve_multiworker_smoke_subprocess():
+    """The scripts/check.sh multi-worker failover smoke stays green."""
+    r = subprocess.run(
+        [sys.executable, "-m", "aclswarm_tpu.serve.smoke",
+         "--multiworker"],
+        capture_output=True, text=True, timeout=570, cwd=str(REPO))
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert "PASS" in r.stdout
 
 
 @pytest.mark.slow

@@ -1,0 +1,209 @@
+"""Wire front end for swarmserve (`aclswarm_tpu.serve.wire`;
+docs/SERVICE.md §wire protocol).
+
+External-process semantics over the shm rings, tested in-process with
+real rings: submit/accept/event/result round trips match the direct
+API bit-for-bit, a CRC-failing frame is rejected loudly without
+touching service state, admission rejection crosses the wire with its
+retry-after hint, and a client that stops talking has its QUEUED
+entries cancelled with a structured error while resident work finishes
+its batch (loud disconnect, never a batch cancellation).
+
+Requires the native transport (``make -C native``) — skipped loudly
+otherwise, like the rest of the shm tests.
+"""
+from __future__ import annotations
+
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from aclswarm_tpu.interop import native as nat
+from aclswarm_tpu.serve import FAILED, ServiceConfig, SwarmService
+
+pytestmark = [pytest.mark.serve,
+              pytest.mark.skipif(not nat.build(),
+                                 reason="native transport not built "
+                                        "(make -C native)")]
+
+ROLL = {"n": 5, "ticks": 60, "chunk_ticks": 20, "seed": 5}
+
+
+def _base() -> str:
+    return "asw-wiretest-" + uuid.uuid4().hex[:6]
+
+
+@pytest.fixture
+def stack():
+    """(service, server, client) on a unique ring namespace."""
+    from aclswarm_tpu.serve.wire import WireClient, WireServer
+
+    svc = SwarmService(ServiceConfig(max_batch=2))
+    base = _base()
+    srv = WireServer(svc, base, client_lease_s=30.0)
+    cli = WireClient(base, tenant="ext")
+    yield svc, srv, cli
+    cli.close()
+    srv.close()
+    svc.close()
+
+
+class TestWireRoundTrip:
+    def test_submit_stream_result_matches_direct_api(self, stack):
+        svc, srv, cli = stack
+        want = svc.submit("rollout", ROLL, tenant="direct").result(240)
+        t = cli.submit("rollout", ROLL)
+        res = t.result(timeout=240)
+        assert res.ok and res.chunks == 3
+        # the wire result is the SAME value the in-process API returns
+        assert int(res.value["digest"]) == int(want.value["digest"])
+        assert np.array_equal(np.asarray(res.value["q"]),
+                              np.asarray(want.value["q"]))
+        events = list(t.stream(timeout=1))
+        assert [e.payload["chunk"] for e in events] == [0, 1, 2]
+        assert events[-1].payload["digest"] == res.value["digest"]
+
+    def test_single_shot_kinds_and_malformed_refusal(self, stack):
+        svc, srv, cli = stack
+        ra = cli.submit("assign", {"n": 10, "seed": 1}).result(120)
+        assert ra.ok
+        assert sorted(np.asarray(ra.value["perm"])) == list(range(10))
+        # a malformed request is refused with a structured wire error,
+        # not accepted-and-failed (admission-time validation holds
+        # across the wire)
+        rbad = cli.submit("rollout", {"n": 5, "ticks": 50,
+                                      "chunk_ticks": 20}).result(60)
+        assert rbad.status == FAILED
+        assert rbad.error.code == "wire_error"
+        assert "chunks run whole" in rbad.error.message
+        assert svc.stats["accepted"] == 1   # only the assign
+
+    def test_crc_rejection_is_loud_and_isolated(self, stack):
+        svc, srv, cli = stack
+        cli._c2s.send_bytes(b"\x00garbage that is not a frame")
+        deadline = time.monotonic() + 10
+        reject = svc.telemetry.counter("wire_crc_rejected_total")
+        while reject.value < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert reject.value == 1
+        # the connection survives: the next valid frame is served
+        assert cli.submit("assign", {"n": 6}).result(120).ok
+
+    def test_concurrent_client_connections_serialize_on_ctl(self):
+        """The shm ring is single-producer, but every client HELLOs on
+        the one shared control ring: the cross-process writer lock must
+        serialize them (regression: two concurrent connects interleaved
+        their head updates and misframed the ctl ring for everyone)."""
+        import threading
+
+        from aclswarm_tpu.serve.wire import WireClient, WireServer
+
+        svc = SwarmService(ServiceConfig(max_batch=4))
+        base = _base()
+        srv = WireServer(svc, base)
+        oks, errs = [], []
+
+        def connect(i):
+            try:
+                c = WireClient(base, tenant=f"c{i}")
+                oks.append(c.submit("assign",
+                                    {"n": 6, "seed": i}).result(120).ok)
+                c.close()
+            except Exception as e:      # noqa: BLE001 — recorded
+                errs.append(repr(e))
+
+        threads = [threading.Thread(target=connect, args=(i,))
+                   for i in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+        assert not errs and oks == [True] * 5, (oks, errs)
+        srv.close()
+        svc.close()
+
+    def test_queue_full_rejection_crosses_the_wire(self):
+        from aclswarm_tpu.serve.wire import WireClient, WireServer
+
+        svc = SwarmService(ServiceConfig(max_queue_per_tenant=1),
+                           start=False)
+        base = _base()
+        srv = WireServer(svc, base)
+        cli = WireClient(base, tenant="ext")
+        t1 = cli.submit("assign", {"n": 6}, request_id="w-keep")
+        r2 = cli.submit("assign", {"n": 6},
+                        request_id="w-bounce").result(30)
+        assert r2.status == FAILED and r2.error.code == "queue_full"
+        assert r2.error.detail["retry_after_s"] > 0
+        assert not t1.done                  # accepted, still owed
+        cli.close()
+        srv.close()
+        svc.close(drain=False)
+
+    def test_connection_default_deadline_applies(self):
+        """Regression: the client frame always carries a ``deadline_s``
+        key (None when unset), so the server must apply its
+        per-connection default on a None VALUE, not on key absence —
+        otherwise `default_deadline_s` is dead code and a slow client
+        parks unbounded work."""
+        from aclswarm_tpu.serve.wire import WireClient, WireServer
+
+        svc = SwarmService(ServiceConfig())
+        base = _base()
+        srv = WireServer(svc, base, default_deadline_s=0.0)
+        cli = WireClient(base, tenant="ext")
+        r = cli.submit("rollout", ROLL).result(timeout=60)
+        assert r.status == "timed_out"
+        assert r.error.code == "deadline_exceeded"
+        # an explicit per-request deadline overrides the default
+        r2 = cli.submit("assign", {"n": 6},
+                        deadline_s=60.0).result(timeout=60)
+        assert r2.ok
+        cli.close()
+        srv.close()
+        svc.close()
+
+    def test_dead_client_cancels_entries_at_boundaries(self):
+        """Loud disconnect semantics: the client vanishes (no BYE, no
+        pings) with two long rollouts in flight. Every entry terminates
+        with a structured ``cancelled`` error — queued entries
+        immediately, the RESIDENT one only at its next chunk boundary
+        (``Result.chunks >= 1``: the running batch is never cancelled
+        mid-kernel), and the disconnect is counted + logged."""
+        from aclswarm_tpu.serve.wire import WireClient, WireServer
+
+        svc = SwarmService(ServiceConfig(max_batch=1, quantum_chunks=99))
+        base = _base()
+        srv = WireServer(svc, base, client_lease_s=1.0)
+        cli = WireClient(base, tenant="ext", ping_s=0.2)
+        cli.submit("rollout", dict(ROLL, ticks=10_000),
+                   request_id="w-a")
+        cli.submit("rollout", dict(ROLL, ticks=10_000, seed=9),
+                   request_id="w-b")
+        # wait until at least one is resident and producing chunks
+        deadline = time.monotonic() + 120
+        while svc.stats["chunks"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert svc.stats["chunks"] >= 1
+        # the client DIES: reader+pinger stop, rings stay (no BYE)
+        cli._stop.set()
+        cli._thread.join(5)
+        deadline = time.monotonic() + 60
+        while svc.stats["cancelled"] < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert svc.stats["cancelled"] == 2
+        disc = svc.telemetry.counter("wire_client_disconnects_total")
+        assert disc.value == 1
+        results = {rid: svc._done_prior.get(rid)
+                   for rid in ("w-a", "w-b")}
+        assert all(r is not None and r.status == FAILED
+                   and r.error.code == "cancelled"
+                   for r in results.values()), results
+        # the resident request reached a boundary before terminating —
+        # it was never killed mid-batch
+        assert max(r.chunks for r in results.values()) >= 1
+        assert all(r.chunks < 500 for r in results.values())
+        srv.close()
+        svc.close(drain=False)
