@@ -198,18 +198,29 @@ def loads(buf: bytes, path="<bytes>") -> tuple[Any, dict]:
 _LOG_LEN = struct.Struct("<I")      # per-record length prefix
 
 
-def append_frame(path, payload, manifest: dict) -> None:
+def append_frame(path, payload, manifest: dict, fh=None) -> None:
     """Append one length-prefixed frame to an append-only log. UNLIKE
     `write_checkpoint` this is NOT atomic — appends are how an
     always-on service records a stream of events (the serve layer's
     worker-lifecycle ledger), and a crash mid-append legitimately
     leaves a torn trailing record. `read_frame_log` is the matching
-    reader that treats exactly that torn tail as clean EOF."""
+    reader that treats exactly that torn tail as clean EOF.
+
+    ``fh`` (an append-mode binary file object) skips the per-record
+    open/close: high-rate writers (the swarmtrace lifecycle stream)
+    keep one persistent handle instead of paying two syscalls per
+    event; the record is flushed to the OS before returning either
+    way."""
+    frame = dumps(payload, manifest)
+    record = _LOG_LEN.pack(len(frame)) + frame
+    if fh is not None:
+        fh.write(record)
+        fh.flush()
+        return
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    frame = dumps(payload, manifest)
     with open(path, "ab") as f:
-        f.write(_LOG_LEN.pack(len(frame)) + frame)
+        f.write(record)
 
 
 def read_frame_log(path) -> tuple[list, bool]:

@@ -90,12 +90,17 @@ class Request:
     acceptance (``t_submit``, wall clock — it must survive a process
     restart, so no monotonic clocks here)."""
 
-    kind: str                 # 'rollout' | 'assign' | 'gains' | registered
+    kind: str                 # 'rollout' | 'assign' | 'gains' | 'stats'
+    #                           | registered
     params: dict
     tenant: str = "default"
     request_id: str = ""
     deadline_s: Optional[float] = None
     t_submit: float = 0.0     # wall-clock acceptance time (service-set)
+    trace_id: str = ""        # swarmtrace causal id: minted at submit
+    #                           (wire client or direct API) and carried
+    #                           through journal frames, checkpoint
+    #                           manifests, and every lifecycle event
 
     @property
     def t_deadline(self) -> Optional[float]:
@@ -131,6 +136,9 @@ class Result:
     resumed: bool = False            # continued from a journaled checkpoint
     failovers: int = 0               # worker-death migrations survived
     #                                  (checkpoint-backed, bit-identical)
+    trace_id: str = ""               # the request's swarmtrace id — the
+    #                                  key `telemetry.postmortem` joins
+    #                                  the journal timeline on
 
     @property
     def ok(self) -> bool:

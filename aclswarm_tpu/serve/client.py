@@ -62,7 +62,8 @@ def submit_and_wait(service, kind: str, params: dict, *,
                     request_id: Optional[str] = None,
                     deadline_s: Optional[float] = None,
                     client_timeout_s: Optional[float] = None,
-                    poll_s: float = 5.0) -> Result:
+                    poll_s: float = 5.0,
+                    trace_id: Optional[str] = None) -> Result:
     """Submit one request and block for its terminal `Result`. Every
     non-answer comes back as a structured result (status ``failed``) so
     callers can treat every path uniformly — only programming errors
@@ -76,11 +77,15 @@ def submit_and_wait(service, kind: str, params: dict, *,
       journal recovery is how the promise gets honored).
 
     The wait polls ``service.alive`` every ``poll_s`` — legitimate
-    long-running work is indistinguishable from a hang without it."""
+    long-running work is indistinguishable from a hang without it.
+    ``trace_id`` threads a caller-held swarmtrace id through to the
+    service (suites tracing their own cells); omitted, the service
+    mints one and the terminal `Result.trace_id` carries it back."""
     try:
         ticket = service.submit(kind, params, tenant=tenant,
                                 request_id=request_id,
-                                deadline_s=deadline_s)
+                                deadline_s=deadline_s,
+                                trace_id=trace_id)
     except RejectedError as e:
         return Result(request_id=request_id or "", status=FAILED,
                       error=ServeError(

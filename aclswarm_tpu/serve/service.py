@@ -71,12 +71,18 @@ from aclswarm_tpu.serve.api import (COMPLETED, E_CANCELLED, E_DEADLINE,
                                     RejectedError, Request, Result,
                                     ServeError, Ticket)
 from aclswarm_tpu.serve.stats import ServeStats
-from aclswarm_tpu.telemetry import MetricsRegistry
+from aclswarm_tpu.telemetry import (LifecycleLog, MetricsRegistry,
+                                    install_crash_dump, mint_trace_id)
 from aclswarm_tpu.utils import get_logger
 from aclswarm_tpu.utils.retry import RetryPolicy
 
-BUILTIN_KINDS = ("rollout", "assign", "gains")
+BUILTIN_KINDS = ("rollout", "assign", "gains", "stats")
 CRASH_SITE = "serve"        # maybe_crash site: one boundary per round
+
+# lifecycle events journaled even with cfg.trace=False: the PR-8
+# worker-failure ledger recovery restores its counters from (turning
+# tracing off must not also turn off the failover evidence)
+_LEDGER_EVENTS = frozenset({"failover", "migrated", "poisoned"})
 
 
 @dataclasses.dataclass(frozen=True)
@@ -119,6 +125,14 @@ class ServiceConfig:
     # per-request state without bound; journal done-frames persist
     # regardless, so recovery-time replay is unaffected)
     done_retention: int = 1024
+    # swarmtrace (docs/OBSERVABILITY.md §swarmtrace): journal the full
+    # lifecycle-event stream (submitted/batched/chunk/.../resolved) to
+    # the journal's events.log. Off disables only the trace events —
+    # the failover/migrated/poisoned ledger PR 8 recovery counts from
+    # is always journaled. The off switch exists for the overhead A/B
+    # (`benchmarks/trace_soak.py`); production keeps it on (<2% of the
+    # serve path, enforced by the committed artifact's schema).
+    trace: bool = True
 
 
 @dataclasses.dataclass
@@ -352,7 +366,20 @@ class SwarmService:
         self._journal = Path(cfg.journal_dir) if cfg.journal_dir else None
         self._ckpt_dir = (self._journal / "ckpt"
                           if self._journal is not None else None)
+        # swarmtrace: the lifecycle stream shares the journal's
+        # events.log with the PR-8 worker ledger (one torn-tail-tolerant
+        # frame log, one reader), and the span ring is armed to flush on
+        # SIGTERM/atexit/worker-death so the last ~N spans survive a
+        # crash (`telemetry.spans.install_crash_dump`)
+        self._trace: Optional[LifecycleLog] = None
+        self._span_dump = None
         if self._journal is not None:
+            self._journal.mkdir(parents=True, exist_ok=True)
+            self._trace = LifecycleLog(self._journal / "events.log",
+                                       log=self.log)
+            self._span_dump = install_crash_dump(
+                self.telemetry.recorder,
+                self._journal / "spans_dump.jsonl", log=self.log)
             self._recover()
         # the worker fleet (serve.workers): N supervised device workers
         # with heartbeat/lease failover — worker death is routine, not
@@ -374,7 +401,8 @@ class SwarmService:
 
     def submit(self, kind: str, params: dict, *, tenant: str = "default",
                request_id: Optional[str] = None,
-               deadline_s: Optional[float] = None) -> Ticket:
+               deadline_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Ticket:
         """Admit one request. Returns the `Ticket` the service now owes
         a terminal result on; raises `RejectedError` (backpressure /
         shutdown) or `ValueError` (malformed request) WITHOUT accepting.
@@ -382,13 +410,20 @@ class SwarmService:
         ``request_id`` is the idempotency key: re-submitting an id the
         service has seen (this process, or this journal — including
         already-terminal requests from before a crash) returns the
-        existing ticket and never enqueues duplicate work."""
+        existing ticket and never enqueues duplicate work.
+
+        ``trace_id`` is the swarmtrace causal id: callers that already
+        hold one (the wire client mints at its end of the pipe) pass it
+        through; otherwise one is minted here — either way the id rides
+        the journal acceptance frame, every checkpoint manifest, every
+        lifecycle event, and the terminal `Result`."""
         if deadline_s is None:
             deadline_s = self.cfg.default_deadline_s
         rid = request_id or uuid.uuid4().hex[:12]
         req = Request(kind=kind, params=params, tenant=tenant,
                       request_id=rid, deadline_s=deadline_s,
-                      t_submit=time.time())
+                      t_submit=time.time(),
+                      trace_id=trace_id or mint_trace_id())
         with self._lock:
             # idempotency first: re-submitting a known id must return
             # the existing ticket even while the service is draining
@@ -422,7 +457,16 @@ class SwarmService:
                     ckptlib.make_manifest(
                         "serve_req", ckptlib.config_hash(params), chunk=0,
                         request_id=rid, tenant=tenant, req_kind=kind,
-                        deadline_s=deadline_s, t_submit=req.t_submit))
+                        deadline_s=deadline_s, t_submit=req.t_submit,
+                        trace_id=req.trace_id))
+                # the acceptance events land BEFORE the job becomes
+                # pickable: a fast worker's `batched` record must never
+                # precede `submitted` in the causal file order
+                self._journal_event("submitted", job, kind=kind,
+                                    tenant=tenant, deadline_s=deadline_s,
+                                    t_submit=req.t_submit)
+                self._journal_event("admitted", job,
+                                    queue_depth=self._adm.pending())
                 self._adm.release(job)
         except BaseException as e:
             rejected = isinstance(e, RejectedError)
@@ -531,6 +575,13 @@ class SwarmService:
                 timeout, len(pending), E_SHUTDOWN)
         for job in pending:
             self._finish(job, FAILED, error=err, journal=False)
+        if self._span_dump is not None:
+            # clean close: final flush, then disarm the atexit/SIGTERM
+            # hooks so long-lived test processes don't accumulate them
+            self._span_dump.dump("close")
+            self._span_dump.uninstall()
+        if self._trace is not None:
+            self._trace.close()
 
     # --------------------------------------------------------- internals
 
@@ -613,7 +664,8 @@ class SwarmService:
 
     # -------------------------------------------------- rollout batching
 
-    def _ensure_state(self, job: _Job) -> None:
+    def _ensure_state(self, job: _Job, epoch: Optional[int] = None
+                      ) -> None:
         """Materialize the resident carry: fresh problem at chunk 0, or
         a template-validated restore of the preemption/crash checkpoint
         (THE checkpoint-backed path — restore goes through the codec
@@ -644,6 +696,14 @@ class SwarmService:
             job.crc = int(payload["crc"])
             job.chunk_digests = [int(d) for d in payload["chunk_digests"]]
             job.preemptions = int(payload["preemptions"])
+            if epoch is not None:
+                self._journal_event_owned("resumed", job, epoch,
+                                          from_chunk=job.chunks_done,
+                                          preemptions=job.preemptions)
+            else:
+                self._journal_event("resumed", job,
+                                    from_chunk=job.chunks_done,
+                                    preemptions=job.preemptions)
         else:
             job.state = state
 
@@ -658,13 +718,16 @@ class SwarmService:
                    "preemptions": int(job.preemptions)}
         man = ckptlib.make_manifest(
             "serve_rollout", ckptlib.config_hash(job.req.params),
-            chunk=job.chunks_done, request_id=job.req.request_id)
+            chunk=job.chunks_done, request_id=job.req.request_id,
+            trace_id=job.req.trace_id)
         if to_disk:
             assert self._ckpt_dir is not None
             ckptlib.write_checkpoint(self._ckpt_dir, self._stem(job),
                                      payload, man)
         else:
             job._ckpt_bytes = ckptlib.dumps(payload, man)
+        self._journal_event("checkpointed", job, chunk=job.chunks_done,
+                            durable=bool(to_disk))
 
     def _rollout_round(self, pairs: list, worker) -> None:
         """One chunk for one shape bucket: deadline/cancel gate ->
@@ -679,96 +742,130 @@ class SwarmService:
 
         from aclswarm_tpu import sim
 
-        live, epochs = [], {}
-        for job, epoch in pairs:
-            if self._stale(job, epoch):
-                continue
-            if self._expired(job):
-                self._timeout(job)
-            elif job.cancelled is not None:
-                self._cancel_at_boundary(job)
-            else:
-                live.append(job)
-                epochs[id(job)] = epoch
+        # swarmtrace stage spans: the serve.round parent is split into
+        # pack/stack/dispatch/device-sync/unpack/resolve children, each
+        # auto-feeding its span_serve.round.<stage>_s histogram — the
+        # per-stage breakdown `benchmarks/serve_latency_breakdown.py`
+        # commits (docs/OBSERVABILITY.md §swarmtrace)
+        span = self.telemetry.span
+        wat = {"worker": worker.slot}
+        with span("serve.round.pack", **wat):
+            live, epochs = [], {}
+            for job, epoch in pairs:
+                if self._stale(job, epoch):
+                    continue
+                if self._expired(job):
+                    self._timeout(job)
+                elif job.cancelled is not None:
+                    self._cancel_at_boundary(job)
+                else:
+                    live.append(job)
+                    epochs[id(job)] = epoch
+            for job in live:
+                self._journal_event_owned(
+                    "batched", job, epochs[id(job)], worker=worker.slot,
+                    round=worker.round, batch=len(live),
+                    bucket=str(job.bucket[0]), chunk=job.chunks_done)
+                self._ensure_state(job, epochs[id(job)])
+                job.status = RUNNING
+                if job.t_first_run is None:
+                    job.t_first_run = time.monotonic()
+            if live and worker.device is not None:
+                # multi-device host: pin each job's carry to this
+                # worker's mesh-slice lead device BEFORE stacking — the
+                # compiled launch follows its operands, so N workers
+                # genuinely run N device streams. Per-job (not
+                # post-stack) because a batch can mix residencies: a
+                # freshly-migrated job's restored state lives on the
+                # default device while its batch-mate's carry lives on
+                # this worker's — stacking across devices is an error,
+                # not a transfer (CPU single-device fallback: device is
+                # None, no-op)
+                for job in live:
+                    job.state = jax.device_put(job.state, worker.device)
         if not live:
             return
-        for job in live:
-            self._ensure_state(job)
-            job.status = RUNNING
-            if job.t_first_run is None:
-                job.t_first_run = time.monotonic()
-        if worker.device is not None:
-            # multi-device host: pin each job's carry to this worker's
-            # mesh-slice lead device BEFORE stacking — the compiled
-            # launch follows its operands, so N workers genuinely run
-            # N device streams. Per-job (not post-stack) because a
-            # batch can mix residencies: a freshly-migrated job's
-            # restored state lives on the default device while its
-            # batch-mate's carry lives on this worker's — stacking
-            # across devices is an error, not a transfer (CPU
-            # single-device fallback: device is None, no-op)
-            for job in live:
-                job.state = jax.device_put(job.state, worker.device)
-        form, cgains, sparams, cfg = live[0]._problem
-        chunk = live[0].spec.chunk_ticks
-        B = len(live)
-        P = 1
-        while P < B:
-            P *= 2
-        idx = list(range(B)) + [0] * (P - B)   # pow-2 pad: bounded shapes
-        bstate = jax.tree.map(
-            lambda *xs: jnp.stack(xs), *[live[i].state for i in idx])
-        bform = jax.tree.map(
-            lambda *xs: jnp.stack(xs),
-            *[live[i]._problem[0] for i in idx])
-        if worker.device is not None:
-            bform = jax.device_put(bform, worker.device)
+        with span("serve.round.stack", **wat):
+            form, cgains, sparams, cfg = live[0]._problem
+            chunk = live[0].spec.chunk_ticks
+            B = len(live)
+            P = 1
+            while P < B:
+                P *= 2
+            idx = list(range(B)) + [0] * (P - B)   # pow-2 pad: bounded
+            bstate = jax.tree.map(
+                lambda *xs: jnp.stack(xs), *[live[i].state for i in idx])
+            bform = jax.tree.map(
+                lambda *xs: jnp.stack(xs),
+                *[live[i]._problem[0] for i in idx])
+            if worker.device is not None:
+                bform = jax.device_put(bform, worker.device)
         t0 = time.monotonic()
-        bstate, metrics = self._execu.run(
-            lambda: sim.batched_rollout(bstate, bform, cgains, sparams,
-                                        cfg, chunk, None, 0),
-            stage=f"serve:w{worker.slot}:round{self._round}")
-        q_all = np.asarray(metrics.q)          # (T, P, n, 3) — the host sync
-        done_live = []
-        for i, job in enumerate(live):
-            qb = np.ascontiguousarray(q_all[:, i])
-            # stale-check AND mutations share one lock hold: a
-            # lease-lapse failover landing between an unlocked check
-            # and these writes would let this (now-zombie) residency
-            # repopulate job.state after the supervisor nulled it —
-            # the next residency would then skip its restore and run
-            # with _problem=None
+        with span("serve.round.dispatch", **wat):
+            bstate, metrics = self._execu.run(
+                lambda: sim.batched_rollout(bstate, bform, cgains, sparams,
+                                            cfg, chunk, None, 0),
+                stage=f"serve:w{worker.slot}:round{self._round}")
+        with span("serve.round.device_sync", **wat):
+            q_all = np.asarray(metrics.q)      # (T, P, n, 3) — the host sync
+        with span("serve.round.unpack", **wat):
+            done_live = []
+            for i, job in enumerate(live):
+                qb = np.ascontiguousarray(q_all[:, i])
+                # stale-check AND mutations share one lock hold: a
+                # lease-lapse failover landing between an unlocked check
+                # and these writes would let this (now-zombie) residency
+                # repopulate job.state after the supervisor nulled it —
+                # the next residency would then skip its restore and run
+                # with _problem=None
+                with self._lock:
+                    if job.finished or job.epoch != epochs[id(job)]:
+                        continue       # failed over mid-launch: zombie
+                    job.state = jax.tree.map(lambda x: x[i], bstate)
+                    job.crc = zlib.crc32(qb.tobytes(), job.crc) & 0xFFFFFFFF
+                    job.chunk_digests.append(job.crc)
+                    job.chunks_done += 1
+                    job.run_chunks += 1
+                    if job.suspect:
+                        # EXONERATED: it survived a (solo, by the
+                        # quarantine pick rule) chunk — the kill it
+                        # witnessed was not its doing, and the kill ledger
+                        # resets with it so only a job that KEEPS killing
+                        # workers can ever accumulate to the poison bound
+                        job.suspect = False
+                        job.solo_kills = 0
+                        job.excluded_workers.clear()
+                    done_live.append(job)
+                    ev = ChunkEvent(
+                        job.req.request_id, job.chunks_done - 1,
+                        {"chunk": job.chunks_done - 1,
+                         "tick_end": job.chunks_done * chunk,
+                         "digest": job.crc,
+                         "batch": B,
+                         "worker": worker.slot,
+                         "trace_id": job.req.trace_id})
+                    # the chunk record lands under the same lock hold as
+                    # the digest update: a concurrent failover can never
+                    # journal a migration of this chunk BEFORE the chunk
+                    # itself exists in the stream (causal file order)
+                    self._journal_event(
+                        "chunk", job, k=job.chunks_done - 1,
+                        digest=int(job.crc), worker=worker.slot,
+                        round=worker.round,
+                        tick_end=job.chunks_done * chunk)
+                job.ticket._push(ev)
             with self._lock:
-                if job.finished or job.epoch != epochs[id(job)]:
-                    continue           # failed over mid-launch: zombie
-                job.state = jax.tree.map(lambda x: x[i], bstate)
-                job.crc = zlib.crc32(qb.tobytes(), job.crc) & 0xFFFFFFFF
-                job.chunk_digests.append(job.crc)
-                job.chunks_done += 1
-                job.run_chunks += 1
-                if job.suspect:
-                    # EXONERATED: it survived a (solo, by the
-                    # quarantine pick rule) chunk — the kill it
-                    # witnessed was not its doing, and the kill ledger
-                    # resets with it so only a job that KEEPS killing
-                    # workers can ever accumulate to the poison bound
-                    job.suspect = False
-                    job.solo_kills = 0
-                    job.excluded_workers.clear()
-                done_live.append(job)
-                ev = ChunkEvent(
-                    job.req.request_id, job.chunks_done - 1,
-                    {"chunk": job.chunks_done - 1,
-                     "tick_end": job.chunks_done * chunk,
-                     "digest": job.crc,
-                     "batch": B,
-                     "worker": worker.slot})
-            job.ticket._push(ev)
-        with self._lock:
-            self.stats["chunks"] += len(done_live)
-        self._adm.note_service((time.monotonic() - t0) / max(1, B))
-        self._sample_boundary(len(done_live), worker)
+                self.stats["chunks"] += len(done_live)
+            self._adm.note_service((time.monotonic() - t0) / max(1, B))
+            self._sample_boundary(len(done_live), worker)
 
+        with span("serve.round.resolve", **wat):
+            self._resolve_round(done_live, epochs, chunk)
+
+    def _resolve_round(self, done_live: list, epochs: dict,
+                       chunk: int) -> None:
+        """Post-chunk request state machine: complete / deadline /
+        cancel / preempt / checkpoint / requeue, per job."""
         for job in done_live:
             # snapshot under the lock: a concurrent failover (fenced
             # zombie scenario) may null job.state the instant after —
@@ -808,6 +905,9 @@ class SwarmService:
                 with self._lock:
                     self.stats["preempted"] += 1
                 self.telemetry.counter("serve_preempted_total").inc()
+                self._journal_event("preempted", job,
+                                    chunk=job.chunks_done,
+                                    run_chunks=job.run_chunks)
             # durability checkpoint every chunk when journaled: a
             # SIGKILL between rounds costs at most one chunk of work
             # (from the snapshot — job.state may be nulled by a
@@ -832,6 +932,12 @@ class SwarmService:
                 else:
                     job.status = QUEUED
                 job.worker = None
+                # journaled before the job becomes pickable (same lock
+                # hold): the next residency's `batched` record must
+                # follow this `queued` in the causal file order
+                self._journal_event(
+                    "queued", job,
+                    reason="preempt" if preempt else "boundary")
                 self._adm.requeue(job)
 
     # ---------------------------------------------------- single-shot work
@@ -852,8 +958,12 @@ class SwarmService:
         job.status = RUNNING
         job.t_first_run = time.monotonic()
         kind = job.req.kind
+        self._journal_event_owned("batched", job, epoch,
+                                  worker=worker.slot, round=worker.round,
+                                  batch=1, bucket=str(job.bucket[0]))
         fn = {"assign": self._do_assign,
-              "gains": self._do_gains}.get(kind) or self._kinds[kind]
+              "gains": self._do_gains,
+              "stats": self._do_stats}.get(kind) or self._kinds[kind]
         t0 = time.monotonic()
         value = self._execu.run(
             lambda: fn(job.req.params),
@@ -914,6 +1024,27 @@ class SwarmService:
         g = np.asarray(gainslib.solve_gains(pts, adj))
         return {"gains": g, "n": n}
 
+    def _do_stats(self, params: dict):
+        """Built-in ``stats`` kind: the swarmscope scrape surface as a
+        request, so OFF-PROCESS clients fetch `prometheus_text()` /
+        `snapshot()` over the existing wire protocol — the fleet is
+        scrapeable without importing the package (a `WireClient`
+        submit, or any future transport binding, is a scraper).
+        ``format``: ``'prometheus'`` (default) returns ``{'text': ...}``;
+        ``'snapshot'`` returns the full registry snapshot plus the
+        service counter dict — both codec-serializable, so they cross
+        the wire and the journal unchanged."""
+        fmt = str(params.get("format", "prometheus"))
+        if fmt == "prometheus":
+            return {"format": fmt, "text": self.telemetry.prometheus_text()}
+        if fmt == "snapshot":
+            with self._lock:
+                counters = {k: v for k, v in self.stats.items()}
+            return {"format": fmt, "snapshot": self.telemetry.snapshot(),
+                    "serve": counters}
+        raise ValueError(f"unknown stats format {fmt!r} "
+                         "(expected 'prometheus' or 'snapshot')")
+
     # ------------------------------------------------------ finalization
 
     def _expired(self, job: _Job) -> bool:
@@ -925,6 +1056,8 @@ class SwarmService:
                f"chunk boundary {job.chunks_done}/{job.chunks_total}")
         if late:
             msg += " (work completed late; result discarded per contract)"
+        self._journal_event("deadline", job, chunk=job.chunks_done,
+                            late=late)
         self._finish(job, TIMED_OUT, error=ServeError(E_DEADLINE, msg))
         if self._ckpt_dir is not None:
             ckptlib.clear_checkpoints(self._ckpt_dir, self._stem(job))
@@ -955,6 +1088,8 @@ class SwarmService:
     def _cancel_at_boundary(self, job: _Job) -> None:
         with self._lock:
             self.stats["cancelled"] += 1
+        self._journal_event("cancelled", job,
+                            reason=job.cancelled or "cancelled")
         self._finish(job, FAILED, error=ServeError(
             E_CANCELLED, job.cancelled or "cancelled"))
         if self._ckpt_dir is not None:
@@ -1003,7 +1138,7 @@ class SwarmService:
             with self._lock:
                 self.stats["poisoned"] += 1
             self.telemetry.counter("serve_poisoned_total").inc()
-            self._journal_event("poisoned", request_id=job.req.request_id,
+            self._journal_event("poisoned", job,
                                 excluded=sorted(job.excluded_workers))
             self.log.error(
                 "request %s POISONED: killed %d worker(s) while "
@@ -1033,10 +1168,15 @@ class SwarmService:
             job.status = QUEUED
             job.run_chunks = 0
             self.stats["requeued"] += 1
+            # the migration record precedes pickability (same lock
+            # hold): the surviving worker's `batched` must follow it in
+            # the causal file order, so a postmortem reads
+            # chunk -> migrated -> batched -> resumed, gap-free
+            self._journal_event("migrated", job, dead_worker=dead_uid,
+                                chunk=job.chunks_done,
+                                failovers=job.failovers)
             self._adm.requeue(job)
         self.telemetry.counter("serve_requeued_total").inc()
-        self._journal_event("requeue", request_id=job.req.request_id,
-                            dead_worker=dead_uid, chunk=job.chunks_done)
 
     def _requeue_unowned(self, pairs: list) -> None:
         """Hand back jobs a ZOMBIE worker dequeued but never registered
@@ -1053,23 +1193,42 @@ class SwarmService:
                 job.worker = None
                 self._adm.requeue(job)
 
-    def _journal_event(self, event: str, **fields) -> None:
-        """Append one worker-lifecycle record (failover / requeue /
-        poisoned) to the journal's append-only events log — the
-        torn-tail-tolerant frame log (`resilience.checkpoint
-        .read_frame_log`): appends are not atomic, and a crash
-        mid-append must cost at most the record being written."""
-        if self._journal is None:
+    def _journal_event_owned(self, event: str, job: _Job, epoch: int,
+                             **fields) -> None:
+        """Emit a request event ONLY while this residency still owns
+        the job (finished/epoch checked under the lock): a fenced
+        zombie worker must never append a `batched` record after the
+        job's `migrated`/`resolved` — causal file order is the
+        postmortem's ground truth."""
+        with self._lock:
+            if job.finished or job.epoch != epoch:
+                return
+            self._journal_event(event, job, **fields)
+
+    def _journal_event(self, event: str, job: Optional[_Job] = None,
+                       **fields) -> None:
+        """Append one schema'd lifecycle record to the journal's
+        torn-tail-tolerant events.log (`telemetry.lifecycle`): the
+        swarmtrace stream `telemetry.postmortem` reconstructs timelines
+        from. ``job=None`` emits a fleet-scope event (worker death).
+        With ``cfg.trace`` off only the failover/migrated/poisoned
+        ledger (the PR-8 recovery counters) is journaled."""
+        if self._trace is None:
             return
-        try:
-            ckptlib.append_frame(
-                self._journal / "events.log", dict(fields),
-                ckptlib.make_manifest("serve_event", "-", chunk=0,
-                                      event=event, t_wall=time.time()))
-        except OSError as e:
-            self.log.warning("events.log append failed (%s) — the "
-                             "lifecycle ledger loses this %s record",
-                             e, event)
+        if not self.cfg.trace and event not in _LEDGER_EVENTS:
+            return
+        self._trace.emit(
+            event,
+            request_id=job.req.request_id if job is not None else None,
+            trace_id=job.req.trace_id if job is not None else "",
+            **fields)
+
+    def _flush_spans(self, reason: str) -> None:
+        """Dump the span ring to the journal NOW (the worker-death
+        path: a SIGKILLed or wedged worker cannot flush itself, so the
+        supervisor flushes on its behalf when it declares it dead)."""
+        if self._span_dump is not None:
+            self._span_dump.dump(reason)
 
     def _finish(self, job: _Job, status: str, value=None,
                 error: Optional[ServeError] = None,
@@ -1090,7 +1249,7 @@ class SwarmService:
             latency_s=max(0.0, t_done - job.req.t_submit),
             queued_s=max(0.0, queued_s), chunks=job.chunks_done,
             preemptions=job.preemptions, resumed=job.resumed,
-            failovers=job.failovers)
+            failovers=job.failovers, trace_id=job.req.trace_id)
         # durable-then-visible: the done-frame is written before the
         # client can observe the result, so "resolved but not journaled"
         # is impossible and recovery never re-runs finished work
@@ -1106,7 +1265,15 @@ class SwarmService:
                     preemptions=job.preemptions, resumed=job.resumed,
                     failovers=job.failovers,
                     tenant=job.req.tenant, req_kind=job.req.kind,
-                    t_done=t_done))
+                    t_done=t_done, trace_id=job.req.trace_id))
+        # the terminal trace record: journaled whether or not the
+        # done-frame was (a close()-raced submit resolves its ticket
+        # with journal=False, but the timeline still owes its ending)
+        self._journal_event(
+            "resolved", job, status=status, chunks=job.chunks_done,
+            latency_s=res.latency_s, preemptions=job.preemptions,
+            failovers=job.failovers,
+            error_code=error.code if error else None)
         job.status = status
         self.telemetry.counter("serve_" + {
             COMPLETED: "completed", TIMED_OUT: "deadline_miss",
@@ -1152,7 +1319,11 @@ class SwarmService:
             # corruption still raises CheckpointCorrupt loudly)
             frames, torn = ckptlib.read_frame_log(events)
             for _, man in frames:
+                # `migrated` is the swarmtrace name for the per-job
+                # failover record; `requeue` its pre-trace spelling —
+                # one reader serves both generations of journal
                 key = {"failover": "failovers", "requeue": "requeued",
+                       "migrated": "requeued",
                        "poisoned": "poisoned"}.get(man.get("event"))
                 if key is not None:
                     self.stats[key] += 1
@@ -1172,16 +1343,21 @@ class SwarmService:
                 queued_s=float(man.get("queued_s", 0.0)),
                 preemptions=int(man.get("preemptions", 0)),
                 resumed=bool(man.get("resumed", False)),
-                failovers=int(man.get("failovers", 0)))
+                failovers=int(man.get("failovers", 0)),
+                trace_id=str(man.get("trace_id", "")))
         for reqf in sorted(self._journal.glob("req_*.req")):
             payload, man = _read_frame(reqf)
             rid = man["request_id"]
             if rid in self._done_prior:
                 continue
+            # the acceptance frame carries the ORIGINAL trace_id: a
+            # request's causal identity survives the process that
+            # accepted it (the whole point of minting at submit)
             req = Request(kind=man["req_kind"], params=payload["params"],
                           tenant=man["tenant"], request_id=rid,
                           deadline_s=man.get("deadline_s"),
-                          t_submit=float(man["t_submit"]))
+                          t_submit=float(man["t_submit"]),
+                          trace_id=str(man.get("trace_id", "")))
             try:
                 job = self._make_job(req)
             except ValueError as e:     # journaled garbage: loud error
@@ -1198,6 +1374,10 @@ class SwarmService:
                     self.stats["resumed"] += 1
                 self.telemetry.counter("serve_resumed_total").inc()
             self._jobs[rid] = job
+            # the recovery re-queue is itself a trace event: the
+            # postmortem reads the crash gap as queued(recovery) ->
+            # batched on whichever incarnation picks the job up
+            self._journal_event("queued", job, reason="recovery")
             self._adm.admit(job, force=True)
             with self._lock:
                 self.stats["accepted"] += 1

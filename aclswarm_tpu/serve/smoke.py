@@ -28,6 +28,17 @@ and the service never stops serving (the kill is a failover, not an
 outage). `scripts/check.sh` runs both modes.
 
     JAX_PLATFORMS=cpu python -m aclswarm_tpu.serve.smoke --multiworker
+
+``--postmortem`` is the swarmtrace drill (docs/OBSERVABILITY.md
+§swarmtrace): a 2-worker journaled service, the worker owning the
+rollout bucket killed mid-flight, and then — from the ON-DISK journal
+alone — `telemetry.postmortem` must reconstruct the migrated request's
+causally-ordered timeline: complete (submitted → resolved), gap-free
+chunk coverage across the kill, one trace_id on every record, a
+non-zero failover gap in the per-stage breakdown, and the span ring
+flushed by the supervisor on the worker's behalf.
+
+    JAX_PLATFORMS=cpu python -m aclswarm_tpu.serve.smoke --postmortem
 """
 from __future__ import annotations
 
@@ -223,6 +234,79 @@ def run_multiworker() -> int:
     return 0
 
 
+def run_postmortem() -> int:
+    """The swarmtrace smoke: kill a worker mid-rollout, then prove the
+    migrated request's whole story reconstructs from the journal alone
+    — complete, causally ordered, gap-free — with the failover visible
+    in the per-stage latency breakdown."""
+    from aclswarm_tpu.telemetry import postmortem
+
+    t0 = time.time()
+    roll = REQUESTS[0]["params"]
+    with tempfile.TemporaryDirectory(prefix="aclswarm_pm_smoke_") as d:
+        svc = SwarmService(ServiceConfig(
+            workers=2, max_batch=1, quantum_chunks=8, journal_dir=d,
+            supervise_poll_s=0.02, rejoin_base_s=0.05))
+        slot = place_slot(bucket_of("rollout", roll), [0, 1])
+        arm(CrashPlan(f"serve.w{slot}", 2, "raise"))
+        res = svc.submit("rollout", roll, tenant="a",
+                         request_id="pm-roll").result(timeout=300)
+        arm(None)
+        svc.close()
+        if not res.ok or res.failovers < 1:
+            print(f"FAIL: expected a migrated completion, got "
+                  f"{res.status} (failovers={res.failovers})")
+            return 1
+
+        # reconstruction from DISK alone — the service object above is
+        # deliberately not consulted
+        report = postmortem.reconstruct(d)
+        rep = report["requests"].get("pm-roll")
+        if rep is None:
+            print("FAIL: postmortem found no timeline for pm-roll")
+            return 1
+        problems = []
+        if not rep["complete"]:
+            problems.append("timeline incomplete")
+        if not rep["gap_free"]:
+            problems.append(f"timeline not gap-free: {rep['problems']}")
+        if rep["migrations"] < 1:
+            problems.append("no migrated event in the timeline")
+        if rep["trace_id"] != res.trace_id:
+            problems.append(
+                f"trace_id drift: result {res.trace_id!r} vs journal "
+                f"{rep['trace_id']!r}")
+        if rep["chunks"] != res.chunks:
+            problems.append(f"chunk coverage {rep['chunks']} != "
+                            f"result chunks {res.chunks}")
+        # the close() dump always writes the file — only a header whose
+        # reason names the worker death proves the SUPERVISOR flushed
+        # (the path a SIGKILLed worker depends on)
+        dumpf = Path(d) / "spans_dump.jsonl"
+        headers = []
+        if dumpf.is_file():
+            headers = [json.loads(ln)
+                       for ln in dumpf.read_text().splitlines()
+                       if '"span_dump"' in ln]
+        if not any("declared dead" in h.get("span_dump", "")
+                   for h in headers):
+            problems.append("supervisor did not flush the span ring on "
+                            "the worker death (no 'declared dead' dump "
+                            f"header; saw {[h.get('span_dump') for h in headers]})")
+        if problems:
+            print("FAIL: " + "; ".join(problems))
+            return 1
+        st = rep["stages"]
+    print("PASS: killed worker %s mid-rollout; postmortem reconstructed "
+          "a complete, gap-free timeline from the journal alone — "
+          "%d events, %d chunks, %d migration(s), trace %s, stages "
+          "queue=%.3fs device=%.3fs failover_gap=%.3fs (%.1fs)"
+          % (slot, rep["events"], rep["chunks"], rep["migrations"],
+             rep["trace_id"], st["queue_wait_s"], st["device_s"],
+             st["failover_gap_s"], time.time() - t0))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--child", action="store_true",
@@ -232,11 +316,17 @@ def main(argv=None) -> int:
     ap.add_argument("--multiworker", action="store_true",
                     help="worker-crash failover drill (2 workers, kill "
                          "one mid-batch, bit-identical migrated resume)")
+    ap.add_argument("--postmortem", action="store_true",
+                    help="swarmtrace drill: kill a worker, reconstruct "
+                         "the migrated request's timeline from the "
+                         "journal alone, assert gap-free")
     args = ap.parse_args(argv)
     if args.child:
         return child(args.dir)
     if args.multiworker:
         return run_multiworker()
+    if args.postmortem:
+        return run_postmortem()
     return run_smoke()
 
 

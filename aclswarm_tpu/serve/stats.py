@@ -53,6 +53,13 @@ class ServeStats:
     workers: int                 # configured worker slots (fleet size)
     workers_up: int              # slots currently up (worker_up gauges)
     per_worker: dict             # slot -> {up, chunks, occupancy_mean}
+    # swarmtrace stream census (journaled services; zeros otherwise):
+    # events appended to events.log, appends the filesystem refused
+    # (loudly logged), and wall seconds spent appending — the numerator
+    # of the trace_soak overhead measurement
+    trace_events: int = 0
+    trace_lost: int = 0
+    trace_spent_s: float = 0.0
 
     @classmethod
     def of(cls, service) -> "ServeStats":
@@ -100,7 +107,13 @@ class ServeStats:
             spans_recorded=int(reg.recorder.recorded),
             workers=int(reg.gauge("serve_workers_total").value),
             workers_up=sum(1 for w in per_worker.values() if w["up"]),
-            per_worker=per_worker)
+            per_worker=per_worker,
+            trace_events=(service._trace.emitted
+                          if service._trace is not None else 0),
+            trace_lost=(service._trace.lost
+                        if service._trace is not None else 0),
+            trace_spent_s=(round(service._trace.spent_s, 6)
+                           if service._trace is not None else 0.0))
 
     def compact(self) -> dict:
         """The bench-row summary: bucket occupancy, queue depth,

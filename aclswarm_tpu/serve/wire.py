@@ -63,6 +63,7 @@ from aclswarm_tpu.serve.api import (E_QUEUE_FULL, E_SHUTDOWN, FAILED,
                                     ChunkEvent, RejectedError, Result,
                                     ServeError, Ticket)
 from aclswarm_tpu.serve.api import _SENTINEL as _TICKET_SENTINEL
+from aclswarm_tpu.telemetry import mint_trace_id
 from aclswarm_tpu.utils import get_logger
 
 WIRE_VERSION = 1
@@ -286,10 +287,15 @@ class WireServer:
         if deadline_s is None:
             deadline_s = self.default_deadline_s
         try:
+            # the trace starts at the CLIENT: its minted id crosses the
+            # wire in the submit frame and the service adopts it, so
+            # one trace_id names the request from the external process
+            # through admission, chunks, failover, and the result frame
             ticket = self.svc.submit(
                 str(payload["kind"]), payload.get("params") or {},
                 tenant=str(payload.get("tenant", conn.cid)),
-                request_id=rid, deadline_s=deadline_s)
+                request_id=rid, deadline_s=deadline_s,
+                trace_id=str(payload.get("trace_id") or "") or None)
         except RejectedError as e:
             _send(conn.s2c, _frame(K_REJECT, {
                 "request_id": rid, "reason": str(e),
@@ -348,7 +354,8 @@ class WireServer:
                         "chunks": res.chunks,
                         "preemptions": res.preemptions,
                         "resumed": res.resumed,
-                        "failovers": res.failovers}),
+                        "failovers": res.failovers,
+                        "trace_id": res.trace_id}),
                         log=self.log, what="result")
                 del conn.pending[rid]
         return busy
@@ -456,16 +463,21 @@ class WireClient:
     def submit(self, kind: str, params: dict, *,
                request_id: Optional[str] = None,
                tenant: Optional[str] = None,
-               deadline_s: Optional[float] = None) -> Ticket:
+               deadline_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Ticket:
         rid = request_id or uuid.uuid4().hex[:12]
         with self._lock:
             if rid in self._tickets:
                 return self._tickets[rid]
             ticket = Ticket(rid)
             self._tickets[rid] = ticket
+        # swarmtrace: the trace is minted HERE, at the true origin —
+        # the server adopts it, so the off-process hop is inside the
+        # traced window instead of invisible before it
         ok = _send(self._c2s, _frame(K_SUBMIT, {
             "request_id": rid, "kind": kind, "params": params,
-            "tenant": tenant or self.tenant, "deadline_s": deadline_s}),
+            "tenant": tenant or self.tenant, "deadline_s": deadline_s,
+            "trace_id": trace_id or mint_trace_id()}),
             log=self.log, what=f"submit {rid}")
         if not ok:
             ticket._resolve(Result(
@@ -523,7 +535,8 @@ class WireClient:
                 chunks=int(payload.get("chunks", 0)),
                 preemptions=int(payload.get("preemptions", 0)),
                 resumed=bool(payload.get("resumed", False)),
-                failovers=int(payload.get("failovers", 0))))
+                failovers=int(payload.get("failovers", 0)),
+                trace_id=str(payload.get("trace_id", ""))))
         elif kind == K_REJECT and ticket is not None:
             ticket._resolve(Result(
                 request_id=rid, status=FAILED,

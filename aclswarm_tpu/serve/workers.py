@@ -395,6 +395,11 @@ class WorkerPool:
             else f"rejoins in {max(0.0, w.rejoin_at - time.monotonic()):.2f} s")
         svc._journal_event("failover", worker=uid, reason=reason,
                            orphans=len(orphans), retired=retire)
+        # the dead worker cannot flush its own span ring (a SIGKILLed
+        # or wedged thread leaves no atexit); the supervisor flushes on
+        # its behalf so the spans LEADING UP to the death survive to
+        # the journal (docs/OBSERVABILITY.md §swarmtrace)
+        svc._flush_spans(f"worker {uid} declared dead: {reason}")
         for job, epoch in orphans:
             # a SOLO orphan has nobody else to blame for the death —
             # only those kills count toward the poison bound

@@ -16,14 +16,23 @@ Three tiers, one substrate:
   zero-cost when off (the committed HLO baseline is unchanged).
 - **profiler hooks**: opt-in `jax.profiler` captures per chosen chunk
   (`harness.trials --set profile_dir=...`, `bench.py --profile-dir`).
+- **swarmtrace** (`telemetry.lifecycle` + `telemetry.postmortem`):
+  causal request tracing — a `TraceContext` minted at submit, the
+  schema'd journaled lifecycle-event stream, and postmortem timeline
+  reconstruction from disk alone (docs/OBSERVABILITY.md §swarmtrace).
 
 This package __init__ stays stdlib-only on purpose: `utils.log` and
 `utils.timing` import it at configure time and must not drag jax in.
 """
+from aclswarm_tpu.telemetry.lifecycle import (LifecycleLog, TraceContext,
+                                              mint_trace_id)
 from aclswarm_tpu.telemetry.registry import (Counter, Gauge, Histogram,
                                              MetricsRegistry, get_registry,
                                              reset_registry)
-from aclswarm_tpu.telemetry.spans import FlightRecorder, Span
+from aclswarm_tpu.telemetry.spans import (FlightRecorder, Span, SpanDump,
+                                          install_crash_dump)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "get_registry", "reset_registry", "FlightRecorder", "Span"]
+           "get_registry", "reset_registry", "FlightRecorder", "Span",
+           "SpanDump", "install_crash_dump", "LifecycleLog",
+           "TraceContext", "mint_trace_id"]

@@ -1,0 +1,144 @@
+"""bench_trend — make the BENCH_r*.json trajectory visible and guarded.
+
+Every driver round commits a `BENCH_r<NN>.json` capture at the repo
+root (the structured one-line `bench.py` row plus its exit status),
+but nothing ever compared them: the trajectory was invisible, and a
+silent throughput regression would ride along unnoticed. This tool:
+
+- parses every round's ``parsed`` row (the bench metric), skipping
+  rounds that recorded an ``error`` or a non-positive value (a wedged
+  tunnel is evidence of the environment, not of the code);
+- prints the per-metric trajectory (round, value, delta vs previous
+  comparable round);
+- exits NONZERO when the newest comparable round regresses more than
+  ``--threshold`` (default 10%) against the previous comparable round
+  of the same metric — direction-aware (``Hz`` is higher-better,
+  ``s``/``us``/``ms`` lower-better).
+
+Run:
+
+    python benchmarks/bench_trend.py [--dir .] [--threshold 0.10] [--soft]
+
+``--soft`` reports but always exits 0 (informational mode for gates
+that must not fail on a historical regression already being worked).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+# direction per unit: +1 = higher is better (rates), -1 = lower is
+# better (latencies); unknown units default to higher-better
+_DIRECTION = {"Hz": 1, "hz": 1, "s": -1, "ms": -1, "us": -1,
+              "ratio": -1}
+
+
+def load_rounds(directory: Path) -> list[tuple[int, dict]]:
+    """[(round, parsed-row)] for every BENCH_r*.json, round-ordered."""
+    out = []
+    for path in sorted(directory.glob("BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path.name)
+        if not m:
+            continue
+        try:
+            cap = json.loads(path.read_text())
+        except json.JSONDecodeError as e:
+            print(f"WARN: {path.name} unparseable ({e}) — skipped")
+            continue
+        parsed = cap.get("parsed")
+        if isinstance(parsed, dict):
+            out.append((int(m.group(1)), parsed))
+    # NUMERIC round order, not the glob's lexical filename order —
+    # BENCH_r100 sorts between r10 and r11 lexically, which would
+    # compare non-adjacent rounds and mis-pick the newest
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def _comparable(row: dict) -> bool:
+    v = row.get("value")
+    return (isinstance(v, (int, float)) and not isinstance(v, bool)
+            and v > 0 and "error" not in row)
+
+
+def series(rounds: list[tuple[int, dict]]) -> dict[str, list]:
+    """metric name -> [(round, row)] (legacy 'metric' key accepted)."""
+    by: dict[str, list] = {}
+    for rnd, row in rounds:
+        name = row.get("name", row.get("metric"))
+        if isinstance(name, str) and name:
+            by.setdefault(name, []).append((rnd, row))
+    return by
+
+
+def trend(directory: Path, threshold: float) -> tuple[list[str], int]:
+    """(report lines, regression count) over every metric series."""
+    rounds = load_rounds(directory)
+    lines, regressions = [], 0
+    if not rounds:
+        return ([f"no BENCH_r*.json captures under {directory}"], 0)
+    for name, pts in sorted(series(rounds).items()):
+        unit = next((r.get("unit") for _, r in pts
+                     if isinstance(r.get("unit"), str)), "")
+        sign = _DIRECTION.get(unit, 1)
+        lines.append(f"{name} [{unit or '?'}]:")
+        newest = next((rnd for rnd, row in reversed(pts)
+                       if _comparable(row)), None)
+        prev = None
+        for rnd, row in pts:
+            v = row.get("value")
+            if not _comparable(row):
+                why = row.get("error", f"value={v!r}")
+                lines.append(f"  r{rnd:02d}  --        "
+                             f"(incomparable: {str(why)[:60]})")
+                continue
+            mark = ""
+            if prev is not None:
+                change = (v - prev[1]) / prev[1]
+                arrow = "+" if change >= 0 else ""
+                mark = f"{arrow}{change * 100:.1f}% vs r{prev[0]:02d}"
+                if sign * change < -threshold:
+                    # only the transition INTO the newest comparable
+                    # round gates: a historical dip the trajectory has
+                    # since recovered from is visible but not fatal —
+                    # otherwise one bad round would redden the gate
+                    # forever
+                    if rnd == newest:
+                        mark += f"  << REGRESSION (> {threshold:.0%})"
+                        regressions += 1
+                    else:
+                        mark += "  (dip, since superseded)"
+            lines.append(f"  r{rnd:02d}  {v:<10g}{mark}")
+            prev = (rnd, v)
+    return lines, regressions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dir", default=str(ROOT),
+                    help="directory holding the BENCH_r*.json captures")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative regression bar (default 0.10)")
+    ap.add_argument("--soft", action="store_true",
+                    help="report only — exit 0 even on regression")
+    args = ap.parse_args(argv)
+    lines, regressions = trend(Path(args.dir), args.threshold)
+    for ln in lines:
+        print(ln)
+    if regressions:
+        print(f"\nBENCH TREND: {regressions} metric(s) regressed more "
+              f"than {args.threshold:.0%} in their newest comparable "
+              "round")
+        return 0 if args.soft else 1
+    print("\nBENCH TREND: no regression past the "
+          f"{args.threshold:.0%} bar")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,6 +84,35 @@ _THROUGHPUT_COUNTS = ("accepted", "completed", "rejected", "preempted",
 # minimum committed offered-load levels (the acceptance criterion)
 _THROUGHPUT_MIN_LEVELS = 3
 
+# the swarmtrace soak artifact (benchmarks/trace_soak.py;
+# docs/OBSERVABILITY.md §swarmtrace): summary-shaped, exact key set,
+# and the ISSUE-9 acceptance bars baked in AS schema — every accepted
+# request of the traced multi-worker kill soak must reconstruct from
+# the journal alone to a complete, gap-free timeline, and the
+# serve-path tracing overhead must stay under 2%. An artifact that
+# stops proving that is rejected, not quietly re-interpreted.
+TRACE_SOAK = "trace_soak.json"
+_TRACE_COUNTS = ("accepted", "completed", "timed_out", "failed",
+                 "worker_kills", "migrated", "poisoned", "reconstructed",
+                 "complete", "gap_free", "timeline_events",
+                 "duplicate_chunks", "workers", "tenants")
+_TRACE_KEYS = set(_TRACE_COUNTS) | {"name", "n", "backend",
+                                    "trace_overhead_frac", "wall_s",
+                                    "quick"}
+_TRACE_OVERHEAD_BAR = 0.02
+
+# the serve latency-breakdown artifact (benchmarks/
+# serve_latency_breakdown.py): JSON-lines, one row per serve.round
+# stage (round + pack/stack/dispatch/device_sync/unpack/resolve), exact
+# key set — the per-stage wall attribution the throughput attack
+# starts from, so a silently dropped stage is evidence rot
+SERVE_BREAKDOWN = "serve_latency_breakdown.json"
+_STAGE_KEYS = {"name", "stage", "n", "backend", "count", "value",
+               "unit", "p50_s", "p95_s", "p99_s", "sum_s", "frac_round",
+               "quick"}
+_STAGE_SET = {"round", "pack", "stack", "dispatch", "device_sync",
+              "unpack", "resolve"}
+
 # the telemetry overhead artifact (aclswarm_tpu.telemetry.overhead):
 # exact key set per named row, and the <5% acceptance bar is part of
 # the schema — an artifact showing a regression must not pass silently
@@ -193,6 +222,131 @@ def check_telemetry_overhead(rows: list, where: str) -> list[str]:
 
 def _is_count(v) -> bool:
     return isinstance(v, int) and not isinstance(v, bool) and v >= 0
+
+
+def check_trace_soak(obj, where: str) -> list[str]:
+    """Validate the trace_soak summary: exact key set, reconciling
+    counts, and the acceptance bars AS schema — 100% of accepted
+    requests reconstructed complete + gap-free, kills/migrations/poison
+    actually exercised, tracing overhead under the 2% bar."""
+    if not isinstance(obj, dict):
+        return [f"{where}: not a JSON object"]
+    probs = []
+    missing, unknown = _TRACE_KEYS - set(obj), set(obj) - _TRACE_KEYS
+    if missing:
+        probs.append(f"{where}: missing keys {sorted(missing)}")
+    if unknown:
+        probs.append(f"{where}: unknown keys {sorted(unknown)} "
+                     "(exact-key-set schema)")
+    if obj.get("name") != "trace_soak":
+        probs.append(f"{where}: 'name' must be 'trace_soak'")
+    for k in _TRACE_COUNTS:
+        if k in obj and not _is_count(obj[k]):
+            probs.append(f"{where}: '{k}' must be a non-negative int, "
+                         f"got {obj[k]!r}")
+    if all(_is_count(obj.get(k)) for k in
+           ("accepted", "completed", "timed_out", "failed")):
+        total = obj["completed"] + obj["timed_out"] + obj["failed"]
+        if total != obj["accepted"]:
+            probs.append(
+                f"{where}: accepted ({obj['accepted']}) != completed + "
+                f"timed_out + failed ({total}) — the terminal ledger "
+                "must reconcile")
+    acc = obj.get("accepted")
+    if _is_count(acc):
+        for k in ("reconstructed", "complete", "gap_free"):
+            if _is_count(obj.get(k)) and obj[k] != acc:
+                probs.append(
+                    f"{where}: {k} ({obj[k]}) != accepted ({acc}) — "
+                    "EVERY accepted request must reconstruct to a "
+                    "complete, gap-free timeline (the acceptance bar)")
+    ov = obj.get("trace_overhead_frac")
+    if not (_finite_num(ov) and ov >= 0):
+        probs.append(f"{where}: 'trace_overhead_frac' must be a finite "
+                     f"non-negative number, got {ov!r}")
+    elif ov >= _TRACE_OVERHEAD_BAR:
+        probs.append(
+            f"{where}: serve-path tracing overhead {ov} breaches the "
+            f"< {_TRACE_OVERHEAD_BAR} acceptance bar")
+    if "quick" in obj and not isinstance(obj["quick"], bool):
+        probs.append(f"{where}: 'quick' must be a bool")
+    if not obj.get("quick"):
+        # the committed (non-quick) artifact IS the acceptance evidence
+        if _is_count(obj.get("workers")) and obj["workers"] < 3:
+            probs.append(f"{where}: committed soak needs >= 3 workers, "
+                         f"got {obj['workers']}")
+        for k in ("worker_kills", "migrated", "poisoned"):
+            if _is_count(obj.get(k)) and obj[k] < 1:
+                probs.append(f"{where}: committed soak recorded no "
+                             f"{k} — the traced chaos never happened")
+    if "wall_s" in obj and not (_finite_num(obj["wall_s"])
+                                and obj["wall_s"] >= 0):
+        probs.append(f"{where}: 'wall_s' must be a finite non-negative "
+                     f"number, got {obj['wall_s']!r}")
+    if "n" in obj and not (_is_count(obj["n"]) and obj["n"] > 0):
+        probs.append(f"{where}: 'n' must be a positive int")
+    return probs
+
+
+def check_serve_latency_breakdown(rows: list, where: str) -> list[str]:
+    """Validate serve_latency_breakdown rows: exact key set, the FULL
+    stage set present, finite non-negative numbers, and the child
+    stages summing to no more than the round they nest in."""
+    probs = []
+    seen = {}
+    for i, row in enumerate(rows, 1):
+        at = f"{where}:{i}"
+        if not isinstance(row, dict):
+            probs.append(f"{at}: row is not a JSON object")
+            continue
+        missing = _STAGE_KEYS - set(row)
+        unknown = set(row) - _STAGE_KEYS
+        if missing:
+            probs.append(f"{at}: missing keys {sorted(missing)}")
+        if unknown:
+            probs.append(f"{at}: unknown keys {sorted(unknown)} "
+                         "(exact-key-set schema)")
+        if row.get("name") != "serve_stage":
+            probs.append(f"{at}: 'name' must be 'serve_stage'")
+        stage = row.get("stage")
+        if stage not in _STAGE_SET:
+            probs.append(f"{at}: unknown stage {stage!r} (expected "
+                         f"{sorted(_STAGE_SET)})")
+        elif stage in seen:
+            probs.append(f"{at}: duplicate stage {stage!r}")
+        else:
+            seen[stage] = row
+        if "count" in row and not (_is_count(row["count"])
+                                   and row["count"] > 0):
+            probs.append(f"{at}: 'count' must be a positive int — a "
+                         "stage that never ran proves nothing")
+        for k in ("value", "p50_s", "p95_s", "p99_s", "sum_s"):
+            if k in row and not (_finite_num(row[k]) and row[k] >= 0):
+                probs.append(f"{at}: '{k}' must be a finite non-negative"
+                             f" number, got {row[k]!r}")
+        if "frac_round" in row and not (
+                _finite_num(row["frac_round"])
+                and 0.0 <= row["frac_round"] <= 1.0001):
+            probs.append(f"{at}: 'frac_round' must be within [0, 1], "
+                         f"got {row['frac_round']!r}")
+        if row.get("unit") != "s":
+            probs.append(f"{at}: 'unit' must be 's'")
+        if "quick" in row and not isinstance(row["quick"], bool):
+            probs.append(f"{at}: 'quick' must be a bool")
+    missing_stages = _STAGE_SET - set(seen)
+    if missing_stages:
+        probs.append(f"{where}: missing stage row(s) "
+                     f"{sorted(missing_stages)} — the breakdown owes "
+                     "the full stage set")
+    rnd = seen.get("round")
+    if rnd is not None and _finite_num(rnd.get("sum_s")):
+        child = sum(r["sum_s"] for s, r in seen.items()
+                    if s != "round" and _finite_num(r.get("sum_s")))
+        if child > rnd["sum_s"] * 1.001:
+            probs.append(
+                f"{where}: child stages sum ({child:.6f}s) exceeds the "
+                f"round wall ({rnd['sum_s']:.6f}s) — mis-nested spans")
+    return probs
 
 
 def check_serve_soak(obj, where: str) -> list[str]:
@@ -459,16 +613,22 @@ def check_file(path: Path) -> list[str]:
         if whole is None:
             return [f"{path.name}: unparseable multiworker-soak artifact"]
         return check_serve_multiworker_soak(whole, path.name)
-    if path.name in (SERVE_THROUGHPUT, TELEMETRY_OVERHEAD):
+    if path.name == TRACE_SOAK:
+        if whole is None:
+            return [f"{path.name}: unparseable trace-soak artifact"]
+        return check_trace_soak(whole, path.name)
+    if path.name in (SERVE_THROUGHPUT, TELEMETRY_OVERHEAD,
+                     SERVE_BREAKDOWN):
         rows, probs = [], []
         for i, line in enumerate(lines, 1):
             try:
                 rows.append(json.loads(line))
             except json.JSONDecodeError as e:
                 probs.append(f"{path.name}:{i}: unparseable row ({e})")
-        checker = (check_serve_throughput
-                   if path.name == SERVE_THROUGHPUT
-                   else check_telemetry_overhead)
+        checker = {SERVE_THROUGHPUT: check_serve_throughput,
+                   TELEMETRY_OVERHEAD: check_telemetry_overhead,
+                   SERVE_BREAKDOWN: check_serve_latency_breakdown}[
+                       path.name]
         return probs + checker(rows, path.name)
     if isinstance(whole, dict) and (
             len(lines) > 1

@@ -21,6 +21,10 @@
 #                               worker-crash failover smoke: kill one of
 #                               two workers mid-batch, zero loss +
 #                               bit-identical migrated resume
+#   JAX_PLATFORMS=cpu python -m aclswarm_tpu.serve.smoke --postmortem
+#                               swarmtrace smoke: kill a worker, then
+#                               reconstruct the migrated request's
+#                               gap-free timeline from the journal alone
 #   pytest tests/test_analysis.py tests/test_invariants.py \
 #          tests/test_results_schema.py tests/test_resilience.py \
 #          tests/test_serve.py                      guard self-tests
@@ -50,7 +54,8 @@ sys.path.insert(0, "benchmarks")
 from check_results import RESULTS, check_file  # noqa: E402
 
 for name in ("serve_throughput.json", "telemetry_overhead.json",
-             "serve_multiworker_soak.json"):
+             "serve_multiworker_soak.json", "trace_soak.json",
+             "serve_latency_breakdown.json"):
     path = RESULTS / name
     if not path.exists():
         print(f"FAIL: missing owed artifact benchmarks/results/{name}")
@@ -75,6 +80,16 @@ echo "== multi-worker crash-failover smoke: kill one of two workers =="
 echo "== mid-batch — zero loss, bit-identical migrated resume, the =="
 echo "== service keeps serving (docs/SERVICE.md §multi-worker) =="
 JAX_PLATFORMS=cpu python -m aclswarm_tpu.serve.smoke --multiworker
+
+echo "== swarmtrace postmortem smoke: kill a worker mid-rollout, =="
+echo "== reconstruct the migrated request's timeline from the journal =="
+echo "== alone — complete, causally ordered, gap-free =="
+echo "== (docs/OBSERVABILITY.md §swarmtrace) =="
+JAX_PLATFORMS=cpu python -m aclswarm_tpu.serve.smoke --postmortem
+
+echo "== bench trajectory (informational: benchmarks/bench_trend.py =="
+echo "== exits nonzero standalone on a >10% regression) =="
+python benchmarks/bench_trend.py --soft
 
 # tier-1 duration guard: the verify command (ROADMAP.md) runs under a
 # hard 870 s timeout and tees its log to /tmp/_t1.log; fail loudly once
@@ -105,10 +120,10 @@ else
     echo "no tier-1 log at $T1_LOG — skipping (run tier-1 first)"
 fi
 
-echo "== guard self-tests (lint fixtures, audit grid, invariant contracts, resilience, serve, wire, telemetry) =="
+echo "== guard self-tests (lint fixtures, audit grid, invariant contracts, resilience, serve, wire, telemetry, trace) =="
 exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_analysis.py tests/test_invariants.py \
     tests/test_results_schema.py tests/test_resilience.py \
     tests/test_serve.py tests/test_serve_wire.py \
-    tests/test_telemetry.py \
+    tests/test_telemetry.py tests/test_trace.py \
     -q -m 'not slow' -p no:cacheprovider

@@ -251,6 +251,68 @@ class TestFrameLog:
                          start=False)
 
 
+# ------------------------------------------- swarmtrace manifest carriage
+
+
+class TestTraceManifests:
+    """The trace_id's survival vehicle is the checkpoint manifest
+    (docs/OBSERVABILITY.md §swarmtrace): it must round-trip the codec
+    bit-exactly, ride `write_checkpoint`/`load_checkpoint` retention,
+    and coexist with the manifest-validation contract (an expected
+    subset that does NOT name trace_id must still accept the frame —
+    a pre-trace resumer can read a traced checkpoint)."""
+
+    def test_trace_id_roundtrips_the_codec_and_files(self, tmp_path):
+        man = ckptlib.make_manifest("serve_rollout", "cfg", chunk=2,
+                                    request_id="r1",
+                                    trace_id="feedbeefcafe0001")
+        payload = {"state": [np.arange(3.0)], "crc": 7}
+        _, got = ckptlib.loads(ckptlib.dumps(payload, man))
+        assert got["trace_id"] == "feedbeefcafe0001"
+        ckptlib.write_checkpoint(tmp_path, "req_r1", payload, man)
+        path = ckptlib.latest_checkpoint(tmp_path, "req_r1")
+        _, man2 = ckptlib.load_checkpoint(
+            path, expected=ckptlib.expected_manifest(
+                "serve_rollout", "cfg", request_id="r1"))
+        assert man2["trace_id"] == "feedbeefcafe0001"
+        # a WRONG expected trace_id still rejects loudly
+        with pytest.raises(ckptlib.CheckpointMismatch, match="trace_id"):
+            ckptlib.load_checkpoint(path, expected={
+                "trace_id": "0000000000000000"})
+
+    def test_lifecycle_events_share_the_frame_log_contract(
+            self, tmp_path):
+        """The swarmtrace stream IS a frame log: a torn tail reads as
+        clean EOF (crash mid-append loses at most one record), and
+        mid-log corruption still raises — the same recovery semantics
+        the serve journal's worker ledger proved in PR 8."""
+        from aclswarm_tpu.telemetry import LifecycleLog
+
+        p = tmp_path / "events.log"
+        log = LifecycleLog(p)
+        log.emit("submitted", request_id="r1", trace_id="t1",
+                 kind="rollout", tenant="a")
+        log.emit("chunk", request_id="r1", trace_id="t1", k=0,
+                 digest=1, worker=0)
+        log.emit("resolved", request_id="r1", trace_id="t1",
+                 status="completed", chunks=1)
+        rows, torn = LifecycleLog.read(p)
+        assert not torn and len(rows) == 3
+        # torn tail
+        buf = p.read_bytes()
+        p.write_bytes(buf[:-5])
+        rows, torn = LifecycleLog.read(p)
+        assert torn and [r["event"] for r in rows] \
+            == ["submitted", "chunk"]
+        # mid-log corruption is NOT skippable
+        bad = bytearray(buf)
+        bad[30] ^= 0xFF
+        p.write_bytes(bytes(bad))
+        with pytest.raises(ckptlib.CheckpointCorrupt,
+                           match="non-trailing"):
+            LifecycleLog.read(p)
+
+
 # ------------------------------------------------ multi-plan crash arming
 
 class TestMultiPlanArming:

@@ -133,6 +133,23 @@ class TestLifecycle:
         with pytest.raises(TimeoutError, match="no chunk event"):
             next(Ticket("never-resolved").stream(timeout=0.05))
 
+    def test_stats_kind_scrapes_the_service(self, svc):
+        """The built-in `stats` kind: the swarmscope scrape surface as
+        an ordinary request — prometheus text and the snapshot dict
+        both resolve as codec-serializable values (the wire half lives
+        in tests/test_serve_wire.py)."""
+        assert svc.submit("assign", {"n": 6, "seed": 1}).result(240).ok
+        rp = svc.submit("stats", {"format": "prometheus"}).result(120)
+        assert rp.ok and "serve_accepted_total" in rp.value["text"]
+        assert "# TYPE" in rp.value["text"]
+        rs = svc.submit("stats", {"format": "snapshot"}).result(120)
+        assert rs.ok
+        assert rs.value["snapshot"]["metrics"][
+            "serve_accepted_total"]["value"] >= 2
+        assert rs.value["serve"]["accepted"] >= 2
+        rbad = svc.submit("stats", {"format": "nope"}).result(120)
+        assert not rbad.ok and rbad.error.code == "execution_failed"
+
     def test_terminal_requests_retire_to_bounded_cache(self):
         """An always-on service keeps NO per-request state after a
         request terminates: the job map empties and the idempotency
@@ -236,6 +253,86 @@ class TestPreemption:
             assert got.value["digest"] == want.value["digest"]
             assert got.value["chunk_digests"] == want.value["chunk_digests"]
             assert np.array_equal(got.value["q"], want.value["q"])
+
+
+# ----------------------------------------------------- swarmtrace continuity
+
+
+class TestTraceContinuity:
+    def test_trace_id_constant_across_preemption_resume(self, tmp_path):
+        """One trace_id names the request across checkpoint-backed
+        preemption: the id minted at submit survives every eviction +
+        codec restore, and the journal timeline shows the preempted →
+        resumed arc gap-free (ISSUE 9 satellite)."""
+        from aclswarm_tpu.telemetry import postmortem
+
+        svc = SwarmService(ServiceConfig(max_batch=1, quantum_chunks=1,
+                                         journal_dir=str(tmp_path)))
+        ta = svc.submit("rollout", ROLL_FAULTED, tenant="a",
+                        request_id="pa")
+        tb = svc.submit("rollout", dict(ROLL, seed=7), tenant="b",
+                        request_id="pb")
+        ra, rb = ta.result(timeout=240), tb.result(timeout=240)
+        svc.close()
+        assert ra.ok and rb.ok and ra.preemptions > 0
+        assert ra.trace_id and ra.trace_id != rb.trace_id
+        rep = postmortem.reconstruct(tmp_path)
+        assert rep["complete"] == 2 and rep["gap_free"] == 2
+        pa = rep["requests"]["pa"]
+        assert pa["trace_id"] == ra.trace_id
+        assert pa["preemptions"] >= 1 and pa["resumes"] >= 1
+        assert pa["stages"]["preempted_s"] > 0
+
+    def test_trace_id_constant_across_crash_recovery(self, tmp_path):
+        """Process-death continuity: the trace_id minted before the
+        worker died is the one the RECOVERED service resumes under —
+        and the reconstructed timeline is one causally-ordered story
+        spanning both incarnations (extends TestRecovery's drill with
+        the swarmtrace audit)."""
+        from aclswarm_tpu.telemetry import postmortem
+
+        svc = SwarmService(ServiceConfig(max_batch=1, quantum_chunks=1,
+                                         journal_dir=str(tmp_path),
+                                         max_worker_restarts=0,
+                                         supervise_poll_s=0.02))
+        crashlib.arm(CrashPlan("serve", 2, "raise"))
+        t0 = svc.submit("rollout", ROLL_FAULTED, tenant="a",
+                        request_id="roll")
+        deadline = time.monotonic() + 60
+        while svc.alive and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not svc.alive
+        crashlib.arm(None)
+        tid_before = svc._jobs["roll"].req.trace_id
+
+        svc2 = SwarmService(ServiceConfig(max_batch=1,
+                                          journal_dir=str(tmp_path)))
+        res = svc2.submit("rollout", ROLL_FAULTED,
+                          request_id="roll").result(timeout=240)
+        svc2.close()
+        assert res.ok and res.resumed
+        assert res.trace_id == tid_before     # survived the process
+        rep = postmortem.reconstruct(tmp_path)["requests"]["roll"]
+        assert rep["complete"] and rep["gap_free"], rep["problems"]
+        assert rep["trace_id"] == tid_before
+        # the crash gap is visible: queued(recovery) -> batched
+        assert rep["stages"]["failover_gap_s"] > 0
+        # events span BOTH pids (the killed worker's and recovery's)
+        pids = {r["pid"] for r in postmortem.load_journal(
+            tmp_path).events if "pid" in r}
+        assert len(pids) == 1        # in-process drill: one pid, but
+        #                              the recovery events follow the
+        #                              crash events in file order
+        assert rep["resumes"] >= 1
+
+    def test_result_trace_id_empty_without_explicit_and_minted(self):
+        svc = SwarmService(ServiceConfig(), start=False)
+        t = svc.submit("assign", {"n": 6}, trace_id="feedface00000001")
+        assert svc._jobs[t.request_id].req.trace_id \
+            == "feedface00000001"
+        t2 = svc.submit("assign", {"n": 6, "seed": 2})
+        assert len(svc._jobs[t2.request_id].req.trace_id) == 16
+        svc.close(drain=False)
 
 
 # ------------------------------------------------- crash + journal recovery
@@ -525,6 +622,35 @@ class TestMultiWorker:
             assert r.value["digest"] == w.value["digest"]
         assert any(r.failovers >= 1 for r in res)
         svc.close()
+
+    def test_trace_id_constant_across_worker_migration(self, tmp_path):
+        """swarmtrace across a cross-worker migration: the trace_id
+        minted at submit rides the checkpoint-codec migration to the
+        surviving worker, and the postmortem reconstructs one gap-free
+        timeline with the migrated/resumed arc and a non-zero failover
+        gap in the stage breakdown (ISSUE 9 satellite)."""
+        from aclswarm_tpu.telemetry import postmortem
+
+        svc = SwarmService(_mw_config(journal_dir=str(tmp_path)))
+        from aclswarm_tpu.serve import place_slot
+        slot = place_slot(_mw_bucket(), [0, 1])
+        crashlib.arm(CrashPlan(f"serve.w{slot}", 2, "raise"))
+        res = svc.submit("rollout", MW_ROLL, tenant="a",
+                         request_id="mig").result(timeout=240)
+        crashlib.arm(None)
+        svc.close()
+        assert res.ok and res.failovers >= 1
+        rep = postmortem.reconstruct(tmp_path)["requests"]["mig"]
+        assert rep["complete"] and rep["gap_free"], rep["problems"]
+        assert rep["trace_id"] == res.trace_id
+        assert rep["migrations"] >= 1 and rep["resumes"] >= 1
+        assert rep["stages"]["failover_gap_s"] >= 0
+        # two distinct workers appear in the chunk events — the trace
+        # genuinely crossed the migration
+        rows = [r for r in postmortem.load_journal(tmp_path).events
+                if r.get("request_id") == "mig"
+                and r.get("event") == "chunk"]
+        assert len({r["worker"] for r in rows}) == 2
 
     def test_retry_after_scales_with_surviving_capacity(self):
         """Graceful degradation: the EWMA backpressure hint re-derives

@@ -80,6 +80,46 @@ class TestWireRoundTrip:
         assert "chunks run whole" in rbad.error.message
         assert svc.stats["accepted"] == 1   # only the assign
 
+    def test_wire_trace_postmortem_and_stats_scrape(self, tmp_path):
+        """swarmtrace across the wire: the CLIENT mints the trace_id,
+        the service adopts it (journal acceptance frame + every
+        lifecycle event + the result frame), and the postmortem
+        reconstructs the whole story from the journal alone. Plus the
+        `stats` kind: an off-process client scrapes prometheus text
+        over the same rings — no package import needed on the scraper
+        side (ISSUE 9 satellites)."""
+        from aclswarm_tpu.serve.wire import WireClient, WireServer
+        from aclswarm_tpu.telemetry import postmortem
+
+        svc = SwarmService(ServiceConfig(max_batch=2,
+                                         journal_dir=str(tmp_path)))
+        base = _base()
+        srv = WireServer(svc, base, client_lease_s=30.0)
+        cli = WireClient(base, tenant="ext")
+        t = cli.submit("rollout", ROLL, request_id="w-traced",
+                       trace_id="beefbeefbeefbeef")
+        res = t.result(timeout=240)
+        assert res.ok and res.chunks == 3
+        # the client-minted id came back on the wire result frame
+        assert res.trace_id == "beefbeefbeefbeef"
+        # an auto-minted wire trace also round-trips
+        r2 = cli.submit("assign", {"n": 6, "seed": 1}).result(120)
+        assert r2.ok and len(r2.trace_id) == 16
+        # off-process scrape over the wire: prometheus text, no import
+        rs = cli.submit("stats", {"format": "prometheus"}).result(120)
+        assert rs.ok and "serve_accepted_total" in rs.value["text"]
+        cli.close()
+        srv.close()
+        svc.close()
+        # postmortem from the journal alone: the wire-submitted request
+        # reconstructs complete + gap-free under the CLIENT's trace_id
+        rep = postmortem.reconstruct(tmp_path)
+        wt = rep["requests"]["w-traced"]
+        assert wt["complete"] and wt["gap_free"], wt["problems"]
+        assert wt["trace_id"] == "beefbeefbeefbeef"
+        assert wt["chunks"] == 3 and wt["status"] == "completed"
+        assert rep["complete"] == rep["reconstructed"]
+
     def test_crc_rejection_is_loud_and_isolated(self, stack):
         svc, srv, cli = stack
         cli._c2s.send_bytes(b"\x00garbage that is not a frame")

@@ -1,0 +1,390 @@
+"""swarmtrace unit guarantees (aclswarm_tpu.telemetry.lifecycle /
+postmortem / spans crash dump, benchmarks/bench_trend.py;
+docs/OBSERVABILITY.md §swarmtrace).
+
+The end-to-end proofs (trace across preemption, cross-worker
+migration, the wire) live in tests/test_serve.py and
+tests/test_serve_wire.py; this file pins the building blocks: the
+event schema refuses malformed records at write time, the stream
+survives a torn tail, the postmortem analyzer detects exactly the
+violations it claims to (coverage holes, digest drift, trace drift,
+missing terminals), the span ring dumps and disarms cleanly, and the
+bench-trend gate fires on a >10% regression and only then.
+"""
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+from aclswarm_tpu.telemetry import (FlightRecorder, LifecycleLog, Span,
+                                    SpanDump, TraceContext,
+                                    mint_trace_id)
+from aclswarm_tpu.telemetry import postmortem
+from aclswarm_tpu.telemetry.lifecycle import make_event
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO / "benchmarks"))
+
+pytestmark = pytest.mark.telemetry
+
+
+# ------------------------------------------------------------ lifecycle
+
+class TestLifecycleSchema:
+    def test_mint_and_context(self):
+        a, b = mint_trace_id(), mint_trace_id()
+        assert a != b and len(a) == 16 and int(a, 16) >= 0
+        ctx = TraceContext.mint("client.submit")
+        assert ctx.parent_span == "client.submit" and len(ctx.trace_id) == 16
+
+    def test_unknown_event_and_missing_fields_refused_at_write(self):
+        with pytest.raises(ValueError, match="unknown lifecycle event"):
+            make_event("teleported", request_id="r", trace_id="t", seq=0)
+        with pytest.raises(ValueError, match="missing required"):
+            make_event("chunk", request_id="r", trace_id="t", seq=0, k=1)
+        with pytest.raises(ValueError, match="needs a request_id"):
+            make_event("chunk", request_id=None, trace_id="t", seq=0,
+                       k=1, digest=2, worker=0)
+
+    def test_event_envelope(self):
+        payload, man = make_event("resolved", request_id="r1",
+                                  trace_id="t1", seq=7,
+                                  status="completed", chunks=3)
+        assert payload["request_id"] == "r1"
+        assert payload["trace_id"] == "t1" and payload["seq"] == 7
+        assert payload["t_wall"] > 0 and payload["t_mono"] > 0
+        assert man["kind"] == "serve_event" and man["event"] == "resolved"
+
+    def test_log_roundtrip_and_torn_tail(self, tmp_path):
+        log = LifecycleLog(tmp_path / "events.log")
+        tid = mint_trace_id()
+        assert log.emit("submitted", request_id="r1", trace_id=tid,
+                        kind="rollout", tenant="a")
+        assert log.emit("chunk", request_id="r1", trace_id=tid,
+                        k=0, digest=0xAB, worker=0)
+        assert log.emit("failover", worker="0.1", reason="drill",
+                        orphans=1)
+        rows, torn = LifecycleLog.read(tmp_path / "events.log")
+        assert not torn and [r["event"] for r in rows] \
+            == ["submitted", "chunk", "failover"]
+        assert rows[0]["trace_id"] == tid and rows[1]["k"] == 0
+        assert rows[0]["seq"] == 0 and rows[1]["seq"] == 1
+        # torn tail: a crash mid-append loses at most the last record
+        raw = (tmp_path / "events.log").read_bytes()
+        (tmp_path / "events.log").write_bytes(raw[:-7])
+        rows2, torn2 = LifecycleLog.read(tmp_path / "events.log")
+        assert torn2 and [r["event"] for r in rows2] \
+            == ["submitted", "chunk"]
+
+
+# ------------------------------------------------------------ postmortem
+
+def _emit_clean_timeline(log: LifecycleLog, rid: str, tid: str,
+                         chunks: int = 3, t0: float = 1000.0):
+    dt = [t0]
+
+    def e(event, **f):
+        dt[0] += 0.1
+        log.emit(event, request_id=rid, trace_id=tid, t_wall=dt[0], **f)
+
+    e("submitted", kind="rollout", tenant="a")
+    e("admitted", queue_depth=1)
+    for k in range(chunks):
+        e("batched", worker=0, round=k + 1, batch=1, chunk=k)
+        e("chunk", k=k, digest=100 + k, worker=0)
+        if k < chunks - 1:
+            e("queued", reason="boundary")
+    e("resolved", status="completed", chunks=chunks, latency_s=1.0)
+
+
+class TestPostmortem:
+    def test_clean_timeline_reconstructs(self, tmp_path):
+        log = LifecycleLog(tmp_path / "events.log")
+        tid = mint_trace_id()
+        _emit_clean_timeline(log, "r1", tid)
+        rep = postmortem.reconstruct(tmp_path)["requests"]["r1"]
+        assert rep["complete"] and rep["gap_free"], rep["problems"]
+        assert rep["trace_id"] == tid and rep["chunks"] == 3
+        assert rep["status"] == "completed"
+        st = rep["stages"]
+        assert st["queue_wait_s"] == pytest.approx(0.1, abs=1e-6)
+        assert st["device_s"] == pytest.approx(0.3, abs=1e-6)
+        assert st["batch_wait_s"] == pytest.approx(0.2, abs=1e-6)
+        assert st["total_s"] > 0
+
+    def test_chunk_hole_detected(self, tmp_path):
+        log = LifecycleLog(tmp_path / "events.log")
+        tid = mint_trace_id()
+        log.emit("submitted", request_id="r1", trace_id=tid,
+                 kind="rollout", tenant="a")
+        for k in (0, 2):               # chunk 1 missing
+            log.emit("batched", request_id="r1", trace_id=tid,
+                     worker=0, round=k, batch=1)
+            log.emit("chunk", request_id="r1", trace_id=tid,
+                     k=k, digest=k, worker=0)
+        log.emit("resolved", request_id="r1", trace_id=tid,
+                 status="completed", chunks=2)
+        rep = postmortem.reconstruct(tmp_path)["requests"]["r1"]
+        assert rep["complete"] and not rep["gap_free"]
+        assert any("hole" in p for p in rep["problems"])
+
+    def test_nonidentical_reexecution_detected(self, tmp_path):
+        """At-least-once re-execution after a crash restore is legal —
+        but ONLY bit-identically. A duplicate chunk with a different
+        digest must fail the reconstruction."""
+        log = LifecycleLog(tmp_path / "events.log")
+        tid = mint_trace_id()
+        log.emit("submitted", request_id="r1", trace_id=tid,
+                 kind="rollout", tenant="a")
+        for dg in (111, 222):          # chunk 0 twice, digests differ
+            log.emit("batched", request_id="r1", trace_id=tid,
+                     worker=0, round=1, batch=1)
+            log.emit("chunk", request_id="r1", trace_id=tid,
+                     k=0, digest=dg, worker=0)
+        log.emit("resolved", request_id="r1", trace_id=tid,
+                 status="completed", chunks=1)
+        rep = postmortem.reconstruct(tmp_path)["requests"]["r1"]
+        assert rep["duplicate_chunks"] == 1 and not rep["gap_free"]
+        assert any("DIFFERENT digest" in p for p in rep["problems"])
+
+    def test_trace_drift_and_missing_terminal_detected(self, tmp_path):
+        log = LifecycleLog(tmp_path / "events.log")
+        log.emit("submitted", request_id="r1", trace_id="aaaa",
+                 kind="rollout", tenant="a")
+        log.emit("chunk", request_id="r1", trace_id="bbbb",
+                 k=0, digest=1, worker=0)
+        rep = postmortem.reconstruct(tmp_path)["requests"]["r1"]
+        assert not rep["complete"] and not rep["gap_free"]
+        assert any("drift" in p for p in rep["problems"])
+        assert any("terminal" in p for p in rep["problems"])
+
+    def test_crash_before_first_batch_is_failover_gap_not_queue(
+            self, tmp_path):
+        """A request that crashed/recovered before EVER being scheduled
+        must show the outage in failover_gap_s — charging it to queue
+        wait would hide exactly the incident the tool exists to
+        surface (review regression)."""
+        log = LifecycleLog(tmp_path / "events.log")
+        tid = mint_trace_id()
+
+        def e(event, t, **f):
+            log.emit(event, request_id="r1", trace_id=tid, t_wall=t, **f)
+
+        e("submitted", 100.0, kind="rollout", tenant="a")
+        e("admitted", 100.1)
+        e("queued", 100.2, reason="recovery")    # crash + restart
+        e("batched", 105.2, worker=0, round=1, batch=1)
+        e("chunk", 105.3, k=0, digest=1, worker=0)
+        e("resolved", 105.4, status="completed", chunks=1)
+        rep = postmortem.reconstruct(tmp_path)["requests"]["r1"]
+        assert rep["gap_free"], rep["problems"]
+        st = rep["stages"]
+        assert st["failover_gap_s"] == pytest.approx(5.0, abs=1e-6)
+        assert st["queue_wait_s"] == pytest.approx(0.1, abs=1e-6)
+
+    def test_accepted_but_traceless_is_loud(self, tmp_path):
+        """A req frame with no events is a reconstruction failure, not
+        an empty success — the soak counts on this."""
+        from aclswarm_tpu.resilience import checkpoint as ckptlib
+        (tmp_path / "req_ghost.req").write_bytes(ckptlib.dumps(
+            {"params": {}}, ckptlib.make_manifest(
+                "serve_req", "-", chunk=0, request_id="ghost",
+                tenant="a", req_kind="assign", deadline_s=None,
+                t_submit=0.0, trace_id="cafe")))
+        rep = postmortem.reconstruct(tmp_path)
+        assert rep["accepted"] == 1 and rep["complete"] == 0
+        assert any("traceless" in p
+                   for p in rep["requests"]["ghost"]["problems"])
+
+    def test_cli_exit_codes(self, tmp_path, capsys):
+        log = LifecycleLog(tmp_path / "events.log")
+        _emit_clean_timeline(log, "ok1", mint_trace_id())
+        assert postmortem.main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "1 complete, 1 gap-free" in out and "resolved" in out
+        log.emit("submitted", request_id="bad", trace_id="x",
+                 kind="rollout", tenant="a")     # never resolves
+        assert postmortem.main([str(tmp_path)]) == 1
+
+
+# ------------------------------------------------------- span crash dump
+
+class TestSpanCrashDump:
+    def test_dump_appends_header_and_rows(self, tmp_path):
+        rec = FlightRecorder(capacity=8)
+        for i in range(3):
+            rec.record(Span(name="serve.round", t_wall=1.0 + i,
+                            dur_s=0.5, attrs={"round": i}))
+        dump = SpanDump(rec, tmp_path / "spans_dump.jsonl")
+        assert dump.dump("test") == 3
+        assert dump.dump("again") == 3          # appends accumulate
+        lines = [json.loads(ln) for ln in
+                 (tmp_path / "spans_dump.jsonl").read_text().splitlines()]
+        headers = [ln for ln in lines if "span_dump" in ln]
+        assert [h["span_dump"] for h in headers] == ["test", "again"]
+        assert headers[0]["spans"] == 3 and headers[0]["recorded"] == 3
+        spans = [ln for ln in lines if "span" in ln and "seq" in ln]
+        assert len(spans) == 6
+        assert spans[0]["span"] == "serve.round"
+
+    def test_uninstalled_dump_is_noop(self, tmp_path):
+        rec = FlightRecorder(capacity=4)
+        rec.record(Span(name="x", t_wall=1.0, dur_s=0.1))
+        dump = SpanDump(rec, tmp_path / "d.jsonl")
+        dump.uninstall()
+        assert dump.dump("late") == 0
+        assert dump.recorder is None     # ring released, not retained
+        assert not (tmp_path / "d.jsonl").exists()
+
+    def test_sigterm_chain_restored_and_sigign_respected(self, tmp_path):
+        """install/uninstall must leave the SIGTERM disposition exactly
+        as found (no unbounded handler chains across service
+        lifetimes), and a host's explicit SIG_IGN choice must survive
+        the chained handler (review regression)."""
+        import signal
+
+        from aclswarm_tpu.telemetry import install_crash_dump
+
+        prev = signal.getsignal(signal.SIGTERM)
+        try:
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+            rec = FlightRecorder(capacity=4)
+            rec.record(Span(name="x", t_wall=1.0, dur_s=0.1))
+            handle = install_crash_dump(rec, tmp_path / "d.jsonl")
+            ours = signal.getsignal(signal.SIGTERM)
+            assert ours is not signal.SIG_IGN     # hook installed
+            # delivering through the hook dumps and then HONORS the
+            # host's ignore choice — the process survives
+            ours(signal.SIGTERM, None)
+            assert (tmp_path / "d.jsonl").exists()
+            assert signal.getsignal(signal.SIGTERM) is ours  # no reset
+            handle.uninstall()
+            assert signal.getsignal(signal.SIGTERM) is signal.SIG_IGN
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+    def test_service_flushes_ring_on_worker_death(self, tmp_path):
+        """The worker-death path: the supervisor dumps the span ring to
+        the journal when it declares a worker dead — the spans leading
+        up to the death survive even though the worker could not flush
+        itself (ISSUE 9 satellite)."""
+        from aclswarm_tpu.resilience import crash as crashlib
+        from aclswarm_tpu.resilience.crash import CrashPlan
+        from aclswarm_tpu.serve import (ServiceConfig, SwarmService,
+                                        bucket_of, place_slot)
+
+        roll = {"n": 5, "ticks": 60, "chunk_ticks": 20, "seed": 3}
+        svc = SwarmService(ServiceConfig(
+            workers=2, max_batch=1, quantum_chunks=8,
+            journal_dir=str(tmp_path), supervise_poll_s=0.02,
+            rejoin_base_s=0.02))
+        slot = place_slot(bucket_of("rollout", roll), [0, 1])
+        crashlib.arm(CrashPlan(f"serve.w{slot}", 2, "raise"))
+        res = svc.submit("rollout", roll).result(timeout=240)
+        crashlib.arm(None)
+        svc.close()
+        assert res.ok and res.failovers >= 1
+        dumpf = tmp_path / "spans_dump.jsonl"
+        assert dumpf.is_file()
+        lines = [json.loads(ln)
+                 for ln in dumpf.read_text().splitlines()]
+        headers = [ln for ln in lines if "span_dump" in ln]
+        assert any("declared dead" in h["span_dump"] for h in headers)
+        assert any(ln.get("span") == "serve.round" for ln in lines)
+
+
+# ------------------------------------------------------------ bench trend
+
+def _write_round(d: Path, n: int, parsed: dict):
+    (d / f"BENCH_r{n:02d}.json").write_text(json.dumps(
+        {"n": n, "cmd": "python bench.py", "rc": 0,
+         "tail": "", "parsed": parsed}))
+
+
+class TestBenchTrend:
+    def test_regression_gate_fires_and_only_then(self, tmp_path):
+        import bench_trend
+
+        _write_round(tmp_path, 1, {"metric": "roll_hz", "value": 100.0,
+                                   "unit": "Hz"})
+        _write_round(tmp_path, 2, {"metric": "roll_hz", "value": 95.0,
+                                   "unit": "Hz"})
+        lines, reg = bench_trend.trend(tmp_path, 0.10)
+        assert reg == 0                      # -5% is inside the bar
+        _write_round(tmp_path, 3, {"metric": "roll_hz", "value": 80.0,
+                                   "unit": "Hz"})
+        lines, reg = bench_trend.trend(tmp_path, 0.10)
+        assert reg == 1
+        assert any("REGRESSION" in ln for ln in lines)
+        assert bench_trend.main(["--dir", str(tmp_path)]) == 1
+        assert bench_trend.main(["--dir", str(tmp_path), "--soft"]) == 0
+
+    def test_error_rounds_incomparable_and_latency_direction(
+            self, tmp_path):
+        import bench_trend
+
+        # an errored round must not count as a 100% regression
+        _write_round(tmp_path, 1, {"metric": "roll_hz", "value": 100.0,
+                                   "unit": "Hz"})
+        _write_round(tmp_path, 2, {"metric": "roll_hz", "value": 0.0,
+                                   "unit": "Hz", "error": "wedged"})
+        lines, reg = bench_trend.trend(tmp_path, 0.10)
+        assert reg == 0 and any("incomparable" in ln for ln in lines)
+        # lower-better units: a latency DROP is an improvement, a rise
+        # past the bar is the regression
+        _write_round(tmp_path, 3, {"metric": "lat_s", "value": 2.0,
+                                   "unit": "s"})
+        _write_round(tmp_path, 4, {"metric": "lat_s", "value": 1.0,
+                                   "unit": "s"})
+        _, reg = bench_trend.trend(tmp_path, 0.10)
+        assert reg == 0
+        _write_round(tmp_path, 5, {"metric": "lat_s", "value": 1.5,
+                                   "unit": "s"})
+        _, reg = bench_trend.trend(tmp_path, 0.10)
+        assert reg == 1
+
+    def test_recovered_dip_does_not_gate(self, tmp_path):
+        """Only the transition INTO the newest comparable round gates:
+        a historical dip the trajectory has since recovered from is
+        reported (visible) but must not redden the gate forever
+        (review regression)."""
+        import bench_trend
+
+        _write_round(tmp_path, 1, {"metric": "roll_hz", "value": 100.0,
+                                   "unit": "Hz"})
+        _write_round(tmp_path, 2, {"metric": "roll_hz", "value": 80.0,
+                                   "unit": "Hz"})
+        _write_round(tmp_path, 3, {"metric": "roll_hz", "value": 120.0,
+                                   "unit": "Hz"})
+        lines, reg = bench_trend.trend(tmp_path, 0.10)
+        assert reg == 0, lines
+        assert any("since superseded" in ln for ln in lines)
+        assert bench_trend.main(["--dir", str(tmp_path)]) == 0
+
+    def test_rounds_ordered_numerically_not_lexically(self, tmp_path):
+        """BENCH_r100 sorts before BENCH_r11 lexically; the trend must
+        compare rounds in NUMERIC order and gate on the true newest
+        round (review regression)."""
+        import json as jsonlib
+
+        import bench_trend
+
+        for n, v in ((2, 100.0), (11, 100.0), (100, 80.0)):
+            (tmp_path / f"BENCH_r{n:02d}.json").write_text(jsonlib.dumps(
+                {"n": n, "cmd": "", "rc": 0, "tail": "",
+                 "parsed": {"metric": "roll_hz", "value": v,
+                            "unit": "Hz"}}))
+        rounds = bench_trend.load_rounds(tmp_path)
+        assert [r for r, _ in rounds] == [2, 11, 100]
+        _, reg = bench_trend.trend(tmp_path, 0.10)
+        assert reg == 1          # r100 IS the newest; its -20% gates
+
+    def test_real_repo_rounds_parse(self):
+        import bench_trend
+
+        lines, reg = bench_trend.trend(REPO, 0.10)
+        assert any("sinkhorn_assign_n1000_hz" in ln for ln in lines)
+        assert reg == 0
