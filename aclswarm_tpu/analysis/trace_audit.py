@@ -321,11 +321,93 @@ def _build_planner_tick(gp: GridPoint):
     return args, kwargs
 
 
+# ---- serve.staging builders (PR 11: the device-bound serve round) ----
+#
+# The staging ops are generic pytree shufflers; they are audited over
+# the exact tree the serving layer stages — a SimState row (always
+# carrying a no-fault schedule, serve's bucket convention) paired with
+# its Formation — at a fixed 4-row store capacity (the service uses
+# 2*pow2(max_batch); capacity only scales leading axes, it does not
+# change the traced program's character).
+
+_STAGING_CAP = 4
+
+
+def _serve_row(gp: GridPoint):
+    import jax.numpy as jnp
+
+    from aclswarm_tpu import sim
+    from aclswarm_tpu.faults import schedule as faultlib
+
+    state = sim.init_state(
+        _scatter(gp.n),
+        faults=faultlib.no_faults(gp.n, dtype=jnp.float32))
+    return state, _formation(gp.n)
+
+
+def _staging_store(gp: GridPoint):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(
+        lambda r: jnp.zeros((_STAGING_CAP,) + r.shape, r.dtype),
+        _serve_row(gp))
+
+
+def _build_staging_write(gp: GridPoint):
+    import jax.numpy as jnp
+
+    return (_staging_store(gp), _serve_row(gp),
+            jnp.asarray(1, jnp.int32)), {}
+
+
+def _build_staging_gather(gp: GridPoint):
+    import jax.numpy as jnp
+
+    return (_staging_store(gp),
+            jnp.asarray([0, 1, 2, 0], jnp.int32)), {}
+
+
+def _build_staging_scatter(gp: GridPoint):
+    import jax
+    import jax.numpy as jnp
+
+    state_store = _staging_store(gp)[0]
+    row = _serve_row(gp)[0]
+    rows = jax.tree.map(lambda r: jnp.stack([r, r]), row)
+    return (state_store, rows, jnp.asarray([0, 1], jnp.int32),
+            jnp.asarray([0, 1], jnp.int32)), {}
+
+
+def _build_staging_take(gp: GridPoint):
+    import jax.numpy as jnp
+
+    return (_staging_store(gp), jnp.asarray(2, jnp.int32)), {}
+
+
+def _build_staging_unpack(gp: GridPoint):
+    import jax.numpy as jnp
+
+    q_ticks = jnp.zeros((4, 2, gp.n, 3), jnp.float32)
+    q_final = jnp.zeros((2, gp.n, 3), jnp.float32)
+    return (q_ticks, q_final), {}
+
+
+def _build_staging_init(gp: GridPoint):
+    import jax.numpy as jnp
+
+    from aclswarm_tpu.faults import schedule as faultlib
+
+    return (jnp.asarray(_scatter(gp.n), jnp.float32),
+            faultlib.no_faults(gp.n, dtype=jnp.float32)), {}
+
+
 def _install_default_registry() -> None:
     """Every public jitted entry point of the compiled surface."""
     from aclswarm_tpu.assignment import auction, cbaa, sinkhorn
     from aclswarm_tpu.gains import admm
     from aclswarm_tpu.interop import planner
+    from aclswarm_tpu.serve import staging as serve_staging
     from aclswarm_tpu.sim import engine, summary
 
     register_entry("sim.engine.rollout", engine.rollout,
@@ -353,6 +435,29 @@ def _install_default_registry() -> None:
     register_entry("interop.planner.tick", planner._tick,
                    static_argnames=("cfg",), build=_build_planner_tick,
                    axes=("n", "solver", "localization"))
+    # serve.staging (PR 11): the donated staging-buffer ops + batched
+    # unpack behind the device-bound serve round — each must be
+    # transfer-free, cache-stable, and f64-clean like any other entry
+    # point (the donated ones are re-jitted WITHOUT donation here; the
+    # read-after-donate discipline is jaxcheck JC005's job)
+    register_entry("serve.staging.write_row",
+                   serve_staging.jitted_entry("write_row"),
+                   build=_build_staging_write)
+    register_entry("serve.staging.gather_rows",
+                   serve_staging.jitted_entry("gather_rows"),
+                   build=_build_staging_gather)
+    register_entry("serve.staging.scatter_rows",
+                   serve_staging.jitted_entry("scatter_rows"),
+                   build=_build_staging_scatter)
+    register_entry("serve.staging.take_row",
+                   serve_staging.jitted_entry("take_row"),
+                   build=_build_staging_take)
+    register_entry("serve.staging.unpack_round",
+                   serve_staging.jitted_entry("unpack_round"),
+                   build=_build_staging_unpack)
+    register_entry("serve.staging.init_row",
+                   serve_staging.jitted_entry("init_row"),
+                   build=_build_staging_init)
     # swarmcheck-ON variants: the sanitized programs themselves must be
     # transfer-free, cache-stable, and f64-clean — the "no host syncs in
     # the happy path" half of the sanitizer contract. Excluded from the

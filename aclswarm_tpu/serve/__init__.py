@@ -23,10 +23,17 @@ back per request. The robustness contract is the product:
   are evicted through the PR-5 checkpoint codec and resume
   bit-identically (eviction is free);
 - **degraded-mode operation** — transient device failures retry and
-  fall back to CPU with loud markers (`resilience.ChunkExecutor`).
+  fall back to CPU with loud markers (`resilience.ChunkExecutor`);
+- **device-bound rounds** — requests are prepped into batch-layout rows
+  at submit, rounds run off donated per-bucket staging buffers with
+  double-buffered chunk pipelining, and each round's host sync is one
+  `device_get` of a compacted result pytree (`serve.staging`).
 
-Host-side only: no compiled code is added (HLO baseline unchanged);
-the worker drives the same jitted entry points as the trial drivers.
+The engine entry points are the same jitted programs the trial drivers
+use (their HLO baseline is unchanged); `serve.staging` adds six small
+audited entry points of its own (write_row / gather_rows /
+scatter_rows / take_row / unpack_round / init_row — see
+`analysis.trace_audit`).
 """
 from aclswarm_tpu.serve.api import (COMPLETED, FAILED, PREEMPTED, QUEUED,
                                     RUNNING, TERMINAL, TIMED_OUT,

@@ -76,9 +76,11 @@ class AdmissionControl:
         of ALREADY-accepted work must never bounce. ``hold`` enqueues
         the job *invisibly to the picker*: the slot counts toward the
         caps (so racing submits cannot oversubscribe) but the worker
-        cannot start it until `release` — the journaled-service
-        ordering gate (caps checked BEFORE the durable frame is
-        written, frame durable before the worker can run the job)."""
+        cannot start it until `release` — the submit-side ordering
+        gate: caps are checked BEFORE the durable journal frame is
+        written, the frame is durable AND the request's batch-layout
+        row is prepped (`serve.staging` submit-time prep — since PR 11
+        every submit holds) before the worker can run the job."""
         with self._cv:
             q = self._queues.setdefault(job.req.tenant, [])
             if job.req.tenant not in self._order:
@@ -102,7 +104,9 @@ class AdmissionControl:
 
     def release(self, job) -> None:
         """Make a held job visible to the picker (its journal frame is
-        durable — the acceptance promise now exists on disk)."""
+        durable and its staging row is prepped — the acceptance
+        promise exists on disk, and round-time pack owes this request
+        only an index shuffle)."""
         with self._cv:
             job.held = False
             self._cv.notify_all()

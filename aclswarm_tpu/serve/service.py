@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import sys
 import threading
 import time
 import uuid
@@ -63,6 +64,9 @@ import numpy as np
 
 from aclswarm_tpu.resilience import ChunkExecutor, maybe_crash
 from aclswarm_tpu.resilience import checkpoint as ckptlib
+from aclswarm_tpu.serve import staging as stagelib  # noqa: F401 (submodule
+#                                import — staging has no back-import, so
+#                                this is cycle-safe during package init)
 from aclswarm_tpu.serve.admission import AdmissionControl
 from aclswarm_tpu.serve.api import (COMPLETED, E_CANCELLED, E_DEADLINE,
                                     E_EXECUTION, E_POISONED, E_QUEUE_FULL,
@@ -133,6 +137,19 @@ class ServiceConfig:
     # (`benchmarks/trace_soak.py`); production keeps it on (<2% of the
     # serve path, enforced by the committed artifact's schema).
     trace: bool = True
+    # ---- device-bound rounds (serve.staging; docs/SERVICE.md) ----
+    # staging=True: requests are prepped into batch-layout rows at
+    # submit, rounds run off persistent donated staging buffers, and
+    # the round's host sync is ONE device_get of a compacted result
+    # pytree. False = the PR-9 pack-at-round-time path (kept as the
+    # bit-parity reference; tests/test_serve.py::TestStagedParity).
+    staging: bool = True
+    # pipeline=True: double-buffered rounds — the worker packs and
+    # dispatches round k+1 while the device still runs round k, and
+    # blocks only at resolve (the single device_get). False = resolve
+    # each round before picking the next (staged but sequential).
+    # Requires staging; ignored when staging=False.
+    pipeline: bool = True
 
 
 @dataclasses.dataclass
@@ -157,6 +174,15 @@ class _Job:
     finished: bool = False        # _finish() ran (atomic once-guard)
     held: bool = False            # caps slot reserved, picker-invisible
     worker: Optional[int] = None  # slot currently holding the job
+    pick_batch: int = 1           # size of the batch this job was last
+    #                               PICKED into — the poison bound's
+    #                               solo-attribution unit (with the
+    #                               pipeline a dead worker usually has
+    #                               TWO rounds in flight, so "only
+    #                               orphan" would never be true and a
+    #                               poison request would ping-pong the
+    #                               fleet unbounded; "alone in its own
+    #                               batch" is the honest blame unit)
     epoch: int = 0                # bumped on failover: a fenced zombie
     #                               worker's stale writes are no-ops
     failovers: int = 0            # worker-death migrations survived
@@ -176,6 +202,60 @@ class _Job:
     #                                    client death; never mid-batch)
     _ckpt_bytes: Optional[bytes] = None   # journal-less preemption frame
     _problem: Any = None          # (formation, cgains, sparams, cfg)
+    staged: Any = None            # (BucketStaging, slot) while resident
+    #                               in a worker's staging store — the
+    #                               job's state IS that row (job.state
+    #                               stays None); cleared on preemption,
+    #                               failover, and every terminal path
+    _shadow: Any = None           # unjournaled failover source: a LAZY
+    #                               (output-batch, row) reference set at
+    #                               every resolved chunk — always
+    #                               state@chunks_done by construction;
+    #                               materialized (one take_row) only if
+    #                               a migration actually needs it
+
+
+class _Fenced(Exception):
+    """Raised inside a round when the executing worker discovers it has
+    been fenced (lease-lapse zombie): the thread must abandon the round
+    WITHOUT touching staging buffers or job state — its in-flight jobs
+    were (or are being) failed over by the supervisor."""
+
+
+@dataclasses.dataclass
+class _PendingRound:
+    """One dispatched-but-unresolved staged rollout round (the unit the
+    worker loop double-buffers). Everything `_round_finish` needs:
+    the async device handles, the job/row/slot maps, and the OPEN
+    parent span (entered at pack, exited at resolve — so the committed
+    breakdown's ``serve.round`` covers the whole pipelined window)."""
+
+    pairs: list                # the original (job, epoch) pick
+    jobs: list                 # gated-in jobs, batch-row order
+    epochs: dict               # id(job) -> epoch at pick
+    rows: dict                 # id(job) -> row index in the round batch
+    out: Any                   # output batch SimState (async device)
+    unpacked: Any              # {"q_chunks","q_final"} (async device)
+    staging: Any               # the BucketStaging this round ran from
+    chunk: int                 # ticks per chunk (bucket-pinned)
+    B: int                     # live batch size (pre-pow2-pad)
+    P: int                     # padded batch size actually dispatched
+    t0: float                  # monotonic at dispatch
+    grnd: int                  # global round number (span/journal attr)
+    wround: int                # worker-round AT DISPATCH (the chunk
+    #                            event must name the round that ran it,
+    #                            not whatever round started since)
+    span_attrs: dict           # serve.round span attributes
+    start_dur: float           # wall of the START phase: the round
+    #                            span is emitted at finish as
+    #                            start_dur + finish_dur — its two
+    #                            ACTIVE phases only, NOT the pipelined
+    #                            idle window in between (which belongs
+    #                            to the interleaved round). Keeps
+    #                            sum(serve.round) <= wall, so the
+    #                            stage fractions the committed
+    #                            breakdown/throughput gates consume
+    #                            are not diluted ~2x by overlap.
 
 
 # ---------------------------------------------------------------------------
@@ -261,32 +341,39 @@ def _rollout_problem(spec: _RolloutSpec):
     idiom): circle formation + complete graph unless the request shipped
     explicit arrays; initial cloud from the request seed. Deterministic
     from the spec alone — that is what makes crash re-execution and
-    resume proofs possible."""
+    resume proofs possible.
+
+    The default formation / safety params / no-fault schedule are
+    served from `serve.staging`'s per-shape caches (same inputs, same
+    ops — bit-identical values): submit-time prep runs this on the
+    client thread, so the shared pieces must not be rebuilt per
+    request."""
     import jax.numpy as jnp
 
     from aclswarm_tpu import sim
-    from aclswarm_tpu.core.types import (ControlGains, SafetyParams,
-                                         make_formation)
+    from aclswarm_tpu.core.types import ControlGains, make_formation
     from aclswarm_tpu.faults import schedule as faultlib
 
     n = spec.n
     dt = jnp.result_type(float)
-    if spec.points is not None:
-        pts = np.asarray(spec.points, float)
+    if (spec.points is None and spec.adjmat is None
+            and spec.gains is None):
+        form = stagelib.cached_default_formation(n, dt)
     else:
-        ang = np.linspace(0, 2 * np.pi, n, endpoint=False)
-        pts = np.stack([3 * np.cos(ang), 3 * np.sin(ang),
-                        np.full(n, 2.0)], 1)
-    adj = (np.asarray(spec.adjmat, float) if spec.adjmat is not None
-           else np.ones((n, n)) - np.eye(n))
-    gains = (np.asarray(spec.gains, float) if spec.gains is not None
-             else np.eye(n)[:, :, None, None] * np.eye(3)[None, None]
-             * 0.01)
-    form = make_formation(jnp.asarray(pts, dt), jnp.asarray(adj, dt),
-                          jnp.asarray(gains, dt))
-    sparams = SafetyParams(
-        bounds_min=jnp.asarray([-50.0, -50.0, 0.0], dt),
-        bounds_max=jnp.asarray([50.0, 50.0, 10.0], dt))
+        if spec.points is not None:
+            pts = np.asarray(spec.points, float)
+        else:
+            ang = np.linspace(0, 2 * np.pi, n, endpoint=False)
+            pts = np.stack([3 * np.cos(ang), 3 * np.sin(ang),
+                            np.full(n, 2.0)], 1)
+        adj = (np.asarray(spec.adjmat, float) if spec.adjmat is not None
+               else np.ones((n, n)) - np.eye(n))
+        gains = (np.asarray(spec.gains, float) if spec.gains is not None
+                 else np.eye(n)[:, :, None, None] * np.eye(3)[None, None]
+                 * 0.01)
+        form = make_formation(jnp.asarray(pts, dt), jnp.asarray(adj, dt),
+                              jnp.asarray(gains, dt))
+    sparams = stagelib.cached_sparams(dt)
     rng = np.random.default_rng(spec.seed)
     q0 = rng.normal(size=(n, 3)) * 2.0 + [0, 0, 2.0]
     # every serve rollout carries a FaultSchedule (no_faults when the
@@ -297,8 +384,11 @@ def _rollout_problem(spec: _RolloutSpec):
         fs = faultlib.sample_schedule(spec.seed, n, dtype=dt,
                                       **spec.faults_spec)
     else:
-        fs = faultlib.no_faults(n, dtype=dt)
-    state = sim.init_state(q0, faults=fs)
+        fs = stagelib.cached_no_faults(n, dt)
+    # ONE compiled call instead of ~20 eager dispatches: prep runs on
+    # client threads at submit, where eager-op GIL pressure was
+    # measurable against the worker loop at saturation
+    state = stagelib.init_row(jnp.asarray(q0, dt), fs)
     cfg = sim.SimConfig(assignment=spec.assignment,
                         assign_every=spec.assign_every)
     return state, form, ControlGains(), sparams, cfg
@@ -443,14 +533,17 @@ class SwarmService:
             # — the loser attaches to THIS ticket above
             self._jobs[rid] = job
         try:
-            # caps-then-durable-then-runnable: admission HOLDS a caps
-            # slot (picker-invisible) before the journal frame is
-            # written, so rejected work is never journaled — not even
-            # transiently (a crash between frame and rejection cannot
-            # resurrect refused work) — and the frame (the acceptance
-            # promise) is durable before a worker that might crash
-            # mid-chunk can run the job
-            self._adm.admit(job, hold=self._journal is not None)
+            # caps-then-durable-then-prepped-then-runnable: admission
+            # HOLDS a caps slot (picker-invisible) before the journal
+            # frame is written, so rejected work is never journaled —
+            # not even transiently (a crash between frame and rejection
+            # cannot resurrect refused work) — the frame (the
+            # acceptance promise) is durable before a worker that might
+            # crash mid-chunk can run the job, and (with staging) the
+            # request's batch-layout row is BUILT here at submit so
+            # round-time pack is an index shuffle, never problem
+            # construction (serve.staging; docs/SERVICE.md)
+            self._adm.admit(job, hold=True)
             if self._journal is not None:
                 _write_frame(
                     self._req_path(rid), {"params": params},
@@ -467,7 +560,21 @@ class SwarmService:
                                     t_submit=req.t_submit)
                 self._journal_event("admitted", job,
                                     queue_depth=self._adm.pending())
-                self._adm.release(job)
+            if self.cfg.staging and job.spec is not None:
+                # submit-time prep: the initial SimState row + problem
+                # pieces, cached per shape. A prep failure is NOT an
+                # admission failure — the worker-side build path
+                # (`_ensure_state`) keeps legacy failure semantics for
+                # pathological params, so fall back silently here.
+                try:
+                    state, form, cgains, sparams, cfg2 = \
+                        _rollout_problem(job.spec)
+                    job.state = state
+                    job._problem = (form, cgains, sparams, cfg2)
+                except Exception:       # noqa: BLE001 — worker rebuilds
+                    job.state = None
+                    job._problem = None
+            self._adm.release(job)
         except BaseException as e:
             rejected = isinstance(e, RejectedError)
             with self._lock:
@@ -618,10 +725,28 @@ class SwarmService:
     # (job, epoch-at-pick) pairs the pool hands in: a fenced zombie
     # worker whose jobs were failed over observes a bumped epoch and
     # touches nothing.
+    #
+    # A round is SPLIT into two phases so the worker loop can
+    # double-buffer (docs/SERVICE.md §scheduling): `_round_start` gates
+    # + packs + dispatches (async — the device starts immediately), and
+    # `_round_finish` syncs + unpacks + resolves. With
+    # ``cfg.pipeline=True`` the pool starts round k+1 before finishing
+    # round k, so the host's pack/resolve work overlaps the device's
+    # chunk compute; otherwise the phases run back to back (the PR-9
+    # schedule).
 
-    def _worker_round(self, pairs: list, worker) -> None:
-        """One scheduler round for one worker: crash hooks, span, then
-        the bucket-appropriate execution."""
+    def _round_start(self, pairs: list, worker,
+                     busy_ids: frozenset = frozenset()
+                     ) -> Optional["_PendingRound"]:
+        """Phase 1 of one scheduler round: crash hooks, then the
+        bucket-appropriate execution. Returns a `_PendingRound` when
+        the round's device work was dispatched asynchronously (staged
+        rollout buckets) — the caller owes a `_round_finish`. Returns
+        None when the round already completed (single-shot kinds, the
+        legacy pack-at-round-time path, an all-gated-out batch, or
+        ``pipeline=False``). ``busy_ids`` are ids of jobs mid-flight in
+        the caller's still-pending round: their staging rows are
+        neither consistent nor evictable until that round resolves."""
         jobs = [j for j, _ in pairs]
         with self._lock:
             self._round += 1
@@ -636,15 +761,23 @@ class SwarmService:
         maybe_crash(CRASH_SITE, grnd)
         from aclswarm_tpu.serve.workers import WORKER_SITE
         maybe_crash(WORKER_SITE.format(slot=worker.slot), worker.round)
-        with self.telemetry.span("serve.round", round=grnd,
-                                 worker=worker.slot,
-                                 bucket=str(jobs[0].bucket[0]),
-                                 batch=len(jobs)):
-            if jobs[0].bucket[0] == "rollout":
-                self._rollout_round(pairs, worker)
-            else:
-                for job, epoch in pairs:
-                    self._single(job, epoch, worker)
+        if jobs[0].bucket[0] != "rollout" or not self.cfg.staging:
+            with self.telemetry.span("serve.round", round=grnd,
+                                     worker=worker.slot,
+                                     bucket=str(jobs[0].bucket[0]),
+                                     batch=len(jobs)):
+                if jobs[0].bucket[0] == "rollout":
+                    self._rollout_round(pairs, worker)
+                else:
+                    for job, epoch in pairs:
+                        self._single(job, epoch, worker)
+            return None
+        pending = self._rollout_round_start(pairs, worker, grnd,
+                                            busy_ids)
+        if pending is not None and not self.cfg.pipeline:
+            self._round_finish(pending, worker)
+            return None
+        return pending
 
     def _fail_round(self, pairs: list, exc: BaseException) -> None:
         """A round-level bug must not wedge the service: every job of
@@ -676,8 +809,16 @@ class SwarmService:
         job._problem = (form, cgains, sparams, cfg)
         frame = None
         if job._ckpt_bytes is not None:
-            frame = ckptlib.loads(job._ckpt_bytes, f"<mem:{job.req.request_id}>")
-            job._ckpt_bytes = None
+            # NOT consumed: the frame stays until a newer checkpoint
+            # overwrites it (or the job terminates). A staged job that
+            # is failed over again BEFORE its next chunk resolves has
+            # no resident state to serialize — this frame is then still
+            # the authoritative state@chunks_done, and dropping it here
+            # would turn that second failover into a silent restart
+            # (caught once, the hard way: the exoneration drill's
+            # double-kill).
+            frame = ckptlib.loads(job._ckpt_bytes,
+                                  f"<mem:{job.req.request_id}>")
         elif self._ckpt_dir is not None:
             path = ckptlib.latest_checkpoint(self._ckpt_dir,
                                              self._stem(job))
@@ -705,6 +846,19 @@ class SwarmService:
                                     from_chunk=job.chunks_done,
                                     preemptions=job.preemptions)
         else:
+            if job.chunks_done > 0 and not job.finished:
+                # a mid-flight job with NO checkpoint anywhere must
+                # never silently restart from tick 0 under a stale
+                # chunk counter — that is digest corruption, not
+                # recovery. Fail the round loudly instead (the job
+                # terminates with structured evidence via _fail_round).
+                # A job that just RACED to terminal is exempt: its
+                # fresh state is never read (epoch/finished guards).
+                raise RuntimeError(
+                    f"request {job.req.request_id} is at chunk "
+                    f"{job.chunks_done}/{job.chunks_total} but no "
+                    "checkpoint frame exists (memory or disk) — "
+                    "refusing a silent restart-from-zero")
             job.state = state
 
     def _stem(self, job: _Job) -> str:
@@ -789,9 +943,7 @@ class SwarmService:
             form, cgains, sparams, cfg = live[0]._problem
             chunk = live[0].spec.chunk_ticks
             B = len(live)
-            P = 1
-            while P < B:
-                P *= 2
+            P = stagelib.pow2(B)
             idx = list(range(B)) + [0] * (P - B)   # pow-2 pad: bounded
             bstate = jax.tree.map(
                 lambda *xs: jnp.stack(xs), *[live[i].state for i in idx])
@@ -939,6 +1091,392 @@ class SwarmService:
                     "queued", job,
                     reason="preempt" if preempt else "boundary")
                 self._adm.requeue(job)
+
+    # ------------------------------------- staged rounds (serve.staging)
+    #
+    # The device-bound round (docs/SERVICE.md §scheduling): requests
+    # were prepped into rows at submit; pack scatters newcomers into
+    # the bucket's persistent staging store (donated writes), the
+    # batch is ONE compiled gather of the live slots, the rollout +
+    # batched unpack + scatter-back all dispatch asynchronously, and
+    # `_round_finish` blocks exactly once (`device_get` of the
+    # compacted result pytree). Staging-store mutations happen ONLY on
+    # the owning worker thread, under `_lock`, after re-checking the
+    # fence flag — the supervisor reads rows under the same lock after
+    # fencing, so donated buffers are never read (the JC005 contract,
+    # enforced at runtime by this protocol and statically by jaxcheck).
+
+    def _rollout_round_start(self, pairs: list, worker, grnd: int,
+                             busy_ids: frozenset = frozenset()
+                             ) -> Optional[_PendingRound]:
+        import jax
+
+        from aclswarm_tpu import sim
+
+        span = self.telemetry.span
+        wat = {"worker": worker.slot}
+        attrs = {"round": grnd, "worker": worker.slot,
+                 "bucket": "rollout", "batch": len(pairs)}
+        t_phase = time.perf_counter()
+        ok = False
+        try:
+            with span("serve.round.pack", **wat):
+                live, epochs = [], {}
+                for job, epoch in pairs:
+                    if self._stale(job, epoch):
+                        continue
+                    if self._expired(job):
+                        self._timeout(job)
+                    elif job.cancelled is not None:
+                        self._cancel_at_boundary(job)
+                    else:
+                        live.append(job)
+                        epochs[id(job)] = epoch
+                if not live:
+                    return None
+                st = worker.staging.get(live[0].bucket)
+                if st is None:
+                    st = worker.staging[live[0].bucket] = \
+                        stagelib.BucketStaging(device=worker.device)
+                for job in live:
+                    self._journal_event_owned(
+                        "batched", job, epochs[id(job)],
+                        worker=worker.slot, round=worker.round,
+                        batch=len(live), bucket=str(job.bucket[0]),
+                        chunk=job.chunks_done)
+                    sref = job.staged
+                    if sref is not None and sref[0] is not st:
+                        # stranded in a dead incarnation's staging
+                        # (boundary-queued at its death, so never
+                        # failed over): its row is consistent — read
+                        # it out under the lock, where no fenced owner
+                        # can concurrently donate the old store
+                        with self._lock:
+                            if worker.fenced:
+                                raise _Fenced()
+                            # re-read UNDER the lock: a failover or a
+                            # terminal sweep may have nulled job.staged
+                            # since the unlocked check above
+                            sref = job.staged
+                            if sref is not None and sref[0] is not st:
+                                old, slot = sref
+                                row_s, row_f = stagelib.take_row(
+                                    old.store, stagelib.i32(slot))
+                                job.state = row_s
+                                job._problem = \
+                                    (row_f,) + tuple(old.shared)
+                                # the materialized row REPLACES the
+                                # batch-shaped shadow (same chunk, one
+                                # row pinned instead of a whole round
+                                # output) — never cleared: the staging
+                                # join below nulls job.state, and an
+                                # unjournaled mid-flight failover
+                                # after that must still find
+                                # state@chunks_done somewhere
+                                job._shadow = (row_s, None)
+                                if old.slots[slot] is job:
+                                    old.slots[slot] = None
+                                job.staged = None
+                    if job.staged is None and job.state is None:
+                        self._ensure_state(job, epochs[id(job)])
+                    job.status = RUNNING
+                    if job.t_first_run is None:
+                        job.t_first_run = time.monotonic()
+                # staging admission: write every newcomer's row into
+                # the store — ONE donated compiled call each, under the
+                # lock + fence check (the staging concurrency contract).
+                # Capacity is FIXED (2x the padded batch: one round in
+                # flight + one being packed — see BucketStaging): a
+                # full store EVICTS a non-busy resident back to its
+                # per-job row instead of growing, so the staging ops'
+                # compiled shape set stays closed. live + busy <= cap
+                # by construction, so a slot always frees up.
+                with self._lock:
+                    if worker.fenced:
+                        raise _Fenced()
+                    newcomers = [j for j in live
+                                 if not (j.staged is not None
+                                         and j.staged[0] is st)]
+                    if newcomers and st.shared is None:
+                        st.shared = tuple(newcomers[0]._problem[1:])
+                    if newcomers:
+                        if st.store is None:
+                            st.create((newcomers[0].state,
+                                       newcomers[0]._problem[0]),
+                                      2 * stagelib.pow2(
+                                          self.cfg.max_batch))
+                        free = st.free_slots()
+                        if len(free) < len(newcomers):
+                            keep = {id(j) for j in live} | busy_ids
+                            for slot, owner in enumerate(st.slots):
+                                if len(free) >= len(newcomers):
+                                    break
+                                if owner is None or id(owner) in keep \
+                                        or owner.finished:
+                                    continue
+                                # LRU-evict: the resident leaves the
+                                # batch layout with its consistent row
+                                # (it is neither live nor mid-flight)
+                                # and re-stages on its next pick
+                                row_s, row_f = stagelib.take_row(
+                                    st.store, stagelib.i32(slot))
+                                owner.state = row_s
+                                owner._problem = \
+                                    (row_f,) + tuple(st.shared)
+                                # row-shadow, same reasoning as the
+                                # stranded branch: replaces the
+                                # batch-shaped shadow, never cleared
+                                owner._shadow = (row_s, None)
+                                owner.staged = None
+                                st.slots[slot] = None
+                                free.append(slot)
+                        for job in newcomers:
+                            slot = free.pop(0)
+                            row = (job.state, job._problem[0])
+                            if st.device is not None:
+                                row = jax.device_put(row, st.device)
+                            st.store = stagelib.write_row(
+                                st.store, row, stagelib.i32(slot))
+                            st.slots[slot] = job
+                            job.staged = (st, slot)
+                            job.state = None
+                            job._problem = None
+            with span("serve.round.stack", **wat):
+                # the index shuffle: the round batch is one gather of
+                # the live slots, padded to the same power-of-two
+                # shapes the pack-at-round-time path compiled. Slot
+                # reads happen UNDER the lock after a fence re-check:
+                # a lease-lapse failover nulls job.staged, and it can
+                # only have done so after fencing this worker — so an
+                # unfenced read is consistent, and a fenced one aborts
+                # instead of dereferencing a migrated job's None.
+                B = len(live)
+                P = stagelib.pow2(B)
+                rows = {id(j): i for i, j in enumerate(live)}
+                with self._lock:
+                    if worker.fenced:
+                        raise _Fenced()
+                    slot_list = [j.staged[1] for j in live]
+                    idx = slot_list + [slot_list[0]] * (P - B)
+                    batch_state, batch_form = stagelib.gather_rows(
+                        st.store, stagelib.i32(tuple(idx)))
+            chunk = live[0].spec.chunk_ticks
+            cgains, sparams, cfg = st.shared
+            t0 = time.monotonic()
+            with span("serve.round.dispatch", **wat):
+                out, metrics = self._execu.run(
+                    lambda: sim.batched_rollout(
+                        batch_state, batch_form, cgains, sparams, cfg,
+                        chunk, None, 0),
+                    stage=f"serve:w{worker.slot}:round{grnd}")
+                unpacked = stagelib.unpack_round(metrics.q, out.swarm.q)
+                # scatter the output rows back into the (donated)
+                # store: the staging buffer is reused in place, and the
+                # next round's gather reads the updated rows — all
+                # async, ordered by dataflow. The index vectors are
+                # padded to P like the batch itself (pad entries
+                # re-write row 0's slot with row 0's own values — a
+                # bit-identical no-op) so scatter compiles per P, not
+                # per live-count.
+                with self._lock:
+                    if worker.fenced:
+                        raise _Fenced()
+                    st.store = (stagelib.scatter_rows(
+                        st.store[0], out, stagelib.i32(tuple(idx)),
+                        stagelib.i32(tuple(range(B)) + (0,) * (P - B))),
+                        st.store[1])
+            ok = True
+            return _PendingRound(pairs=pairs, jobs=live, epochs=epochs,
+                                 rows=rows, out=out, unpacked=unpacked,
+                                 staging=st, chunk=chunk, B=B, P=P,
+                                 t0=t0, grnd=grnd, wround=worker.round,
+                                 span_attrs=attrs,
+                                 start_dur=time.perf_counter() - t_phase)
+        finally:
+            if not ok:
+                # aborted/empty round: the span is just this phase
+                self._emit_round_span(
+                    time.perf_counter() - t_phase, attrs,
+                    error=sys.exc_info()[0] is not None)
+
+    def _round_finish(self, pending: _PendingRound, worker,
+                      busy: int = 0) -> None:
+        """Phase 2 of a staged round: ONE blocking `device_get` (the
+        round's only host sync), per-job digest/stream bookkeeping,
+        then the request state machine. ``busy`` is the number of jobs
+        the worker already dispatched into the NEXT (overlapping)
+        round — they count as waiting work for the preemption trigger,
+        exactly as they would still have been queued at this point on
+        the unpipelined schedule."""
+        import jax
+
+        span = self.telemetry.span
+        wat = {"worker": worker.slot}
+        t_phase = time.perf_counter()
+        try:
+            with span("serve.round.device_sync", **wat):
+                host = jax.device_get(pending.unpacked)
+            q_chunks = host["q_chunks"]
+            with span("serve.round.unpack", **wat):
+                done_live = []
+                for job in pending.jobs:
+                    bi = pending.rows[id(job)]
+                    qb = q_chunks[bi]      # request-major: contiguous
+                    # stale-check AND mutations share one lock hold (the
+                    # same fenced-zombie reasoning as the legacy path)
+                    with self._lock:
+                        if job.finished \
+                                or job.epoch != pending.epochs[id(job)]:
+                            continue       # failed over mid-flight
+                        if self._ckpt_dir is None:
+                            # in-memory failover shadow: the staging
+                            # row advances at DISPATCH of the next
+                            # round, so an in-flight job's consistent
+                            # state@chunks_done must live somewhere a
+                            # migration can serialize. LAZY — just a
+                            # (batch, row) reference; `_failover_job`
+                            # materializes it with one take_row only
+                            # if a migration actually happens
+                            # (journaled services skip this: the
+                            # per-chunk disk frame is the source)
+                            job._shadow = (pending.out, bi)
+                        job.crc = zlib.crc32(qb.tobytes(),
+                                             job.crc) & 0xFFFFFFFF
+                        job.chunk_digests.append(job.crc)
+                        job.chunks_done += 1
+                        job.run_chunks += 1
+                        if job.suspect:
+                            # EXONERATED (see the legacy path)
+                            job.suspect = False
+                            job.solo_kills = 0
+                            job.excluded_workers.clear()
+                        done_live.append(job)
+                        ev = ChunkEvent(
+                            job.req.request_id, job.chunks_done - 1,
+                            {"chunk": job.chunks_done - 1,
+                             "tick_end": job.chunks_done * pending.chunk,
+                             "digest": job.crc,
+                             "batch": pending.B,
+                             "worker": worker.slot,
+                             "trace_id": job.req.trace_id})
+                        self._journal_event(
+                            "chunk", job, k=job.chunks_done - 1,
+                            digest=int(job.crc), worker=worker.slot,
+                            round=pending.wround,
+                            tick_end=job.chunks_done * pending.chunk)
+                    job.ticket._push(ev)
+                with self._lock:
+                    self.stats["chunks"] += len(done_live)
+                self._adm.note_service(
+                    (time.monotonic() - pending.t0) / max(1, pending.B))
+                self._sample_boundary(len(done_live), worker)
+            with span("serve.round.resolve", **wat):
+                self._resolve_round_staged(pending, done_live,
+                                           host["q_final"], busy)
+        finally:
+            self._emit_round_span(
+                pending.start_dur + (time.perf_counter() - t_phase),
+                pending.span_attrs,
+                error=sys.exc_info()[0] is not None)
+
+    def _emit_round_span(self, dur_s: float, attrs: dict,
+                         error: bool = False) -> None:
+        """Record one ``serve.round`` span of the given duration (the
+        two active phases of a pipelined round — see `_PendingRound.
+        start_dur`), feeding the same recorder + histogram the span
+        context manager would."""
+        from aclswarm_tpu.telemetry.spans import Span
+
+        self.telemetry.recorder.record(Span(
+            name="serve.round", t_wall=time.time(), dur_s=dur_s,
+            attrs=dict(attrs, error=True) if error else dict(attrs)))
+        self.telemetry.histogram("span_serve.round_s").observe(dur_s)
+
+    def _resolve_round_staged(self, pending: _PendingRound,
+                              done_live: list, q_final, busy: int
+                              ) -> None:
+        """Post-chunk request state machine for a staged round:
+        complete / deadline / cancel / preempt / checkpoint / requeue.
+        Durability checkpoints read from ONE batched `device_get` of
+        the round's output (numpy row views), not per-leaf per-job
+        device slices."""
+        import jax
+
+        chunk = pending.chunk
+        host_state = None
+
+        def host_row(bi):
+            # lazy: only rounds that actually checkpoint pay the
+            # transfer, and they pay it once for the whole batch
+            nonlocal host_state
+            if host_state is None:
+                host_state = jax.device_get(pending.out)
+            return jax.tree.map(lambda x: x[bi], host_state)
+
+        for job in done_live:
+            bi = pending.rows[id(job)]
+            with self._lock:
+                if job.finished or job.epoch != pending.epochs[id(job)]:
+                    continue
+            if job.chunks_done >= job.chunks_total:
+                self._finish(job, COMPLETED, value={
+                    "q": np.ascontiguousarray(q_final[bi]),
+                    "ticks": job.chunks_done * chunk,
+                    "digest": int(job.crc),
+                    "chunk_digests": [int(d) for d in job.chunk_digests]})
+                if self._ckpt_dir is not None:
+                    ckptlib.clear_checkpoints(self._ckpt_dir,
+                                              self._stem(job))
+                continue
+            if self._expired(job):
+                self._timeout(job)
+                continue
+            if job.cancelled is not None:
+                self._cancel_at_boundary(job)
+                continue
+            preempt = (job.run_chunks >= self.cfg.quantum_chunks
+                       and (busy > 0
+                            or self._adm.pending_excluding(job) > 0))
+            if preempt:
+                job.preemptions += 1
+                with self._lock:
+                    self.stats["preempted"] += 1
+                self.telemetry.counter("serve_preempted_total").inc()
+                self._journal_event("preempted", job,
+                                    chunk=job.chunks_done,
+                                    run_chunks=job.run_chunks)
+            if self._ckpt_dir is not None:
+                self._checkpoint(job, to_disk=True, state=host_row(bi))
+            elif preempt:
+                self._checkpoint(job, to_disk=False, state=host_row(bi))
+            with self._lock:
+                if job.finished or job.epoch != pending.epochs[id(job)]:
+                    continue           # failed over while checkpointing
+                if preempt:
+                    self._free_slot(job)
+                    job.state = None
+                    job._problem = None
+                    job._shadow = None   # the checkpoint frame just
+                    #                      written supersedes it
+                    job.status = PREEMPTED
+                    job.run_chunks = 0
+                else:
+                    job.status = QUEUED
+                job.worker = None
+                self._journal_event(
+                    "queued", job,
+                    reason="preempt" if preempt else "boundary")
+                self._adm.requeue(job)
+
+    def _free_slot(self, job: _Job) -> None:
+        """Release the job's staging-store row (caller holds ``_lock``).
+        Idempotent; a no-op for never-staged jobs."""
+        sref = job.staged
+        if sref is not None:
+            st, slot = sref
+            if 0 <= slot < len(st.slots) and st.slots[slot] is job:
+                st.slots[slot] = None
+            job.staged = None
 
     # ---------------------------------------------------- single-shot work
 
@@ -1157,11 +1695,34 @@ class SwarmService:
         # checkpoint-backed migration: serialize the orphaned resident
         # state through the codec so the next residency — on a DIFFERENT
         # worker — restores it template-validated and bit-identically
-        # (the disk frame doubles as the crash-durability checkpoint)
-        if job.bucket[0] == "rollout" and job.state is not None:
-            self._checkpoint(job, to_disk=self._ckpt_dir is not None)
+        # (the disk frame doubles as the crash-durability checkpoint).
+        # Staged jobs (serve.staging): an in-flight job's staging row
+        # may already hold the NEXT chunk's state (scatter-back lands
+        # at dispatch, logical progress at finish), so migration never
+        # reads the store — journaled services restore from the
+        # per-chunk disk frame written at every resolve, unjournaled
+        # ones from the consistent per-job shadow `_round_finish`
+        # maintains (both proven bit-identical by the failover drills).
+        if job.bucket[0] == "rollout":
+            with self._lock:
+                if job.state is None and job._shadow is not None:
+                    # materialize the lazy shadow: state@chunks_done
+                    # from the round output that resolved its last
+                    # chunk (never the staging store — an in-flight
+                    # job's store row may already hold the NEXT
+                    # chunk's state). ``bi is None`` means the shadow
+                    # is already a single materialized row (the
+                    # eviction / stranded-readout form).
+                    src, bi = job._shadow
+                    job.state = (src if bi is None else
+                                 stagelib.take_row(src, stagelib.i32(bi)))
+            if job.state is not None:
+                self._checkpoint(job, to_disk=self._ckpt_dir is not None)
+            with self._lock:
+                self._free_slot(job)
             job.state = None
             job._problem = None
+            job._shadow = None
         with self._lock:
             if job.finished:
                 return                 # raced a terminal path mid-ckpt
@@ -1287,13 +1848,16 @@ class SwarmService:
             self.stats[key] += 1
             # retire the request record: an always-on service must not
             # retain per-request device state (SimState pytree, problem
-            # arrays, checkpoint bytes) or unbounded job maps forever.
-            # The client's ticket keeps the Result alive; the service
-            # keeps only a bounded terminal cache for idempotent
-            # duplicate submits (journal done-frames persist on disk).
+            # arrays, checkpoint bytes, staging rows) or unbounded job
+            # maps forever. The client's ticket keeps the Result alive;
+            # the service keeps only a bounded terminal cache for
+            # idempotent duplicate submits (journal done-frames persist
+            # on disk).
+            self._free_slot(job)
             job.state = None
             job._problem = None
             job._ckpt_bytes = None
+            job._shadow = None
             self._jobs.pop(job.req.request_id, None)
             self._done_prior[job.req.request_id] = res
             while len(self._done_prior) > max(0, self.cfg.done_retention):

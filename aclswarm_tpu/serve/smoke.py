@@ -58,7 +58,13 @@ from aclswarm_tpu.serve import (ServiceConfig, SwarmService, bucket_of,
                                 place_slot)
 from aclswarm_tpu.serve.service import _read_frame
 
-KILL_ROUND = 2
+# On the pipelined schedule (PR 11: `_round_start` dispatches round
+# k+1 before round k resolves), round 1 dispatches the rollout's chunk
+# 1, round 2 runs a single-shot while chunk 1 resolves + checkpoints,
+# and round 3 re-picks the rollout — killing at 3 lands with exactly
+# one chunk durable and the next mid-flight, the same shape the old
+# round-2 kill produced on the sequential schedule.
+KILL_ROUND = 3
 
 REQUESTS = [
     {"kind": "rollout", "tenant": "a", "request_id": "smoke-roll",
@@ -74,8 +80,8 @@ REQUESTS = [
 
 def _service(journal: str) -> SwarmService:
     # max_batch=1 serializes the rounds so the kill boundary is
-    # deterministic: round 1 runs the rollout's first chunk, round 2
-    # (the kill) arrives with the batch picked and work un-journaled
+    # deterministic: round 1 runs the rollout's first chunk, and the
+    # KILL_ROUND kill arrives with a batch picked and work un-journaled
     return SwarmService(ServiceConfig(max_batch=1, quantum_chunks=1,
                                       journal_dir=journal))
 

@@ -1,0 +1,378 @@
+"""Device-bound serve rounds: donated staging buffers, submit-time row
+prep, and a batched on-device unpack (docs/SERVICE.md §scheduling;
+ROADMAP open item 2).
+
+swarmtrace's latency breakdown (PR 9) showed the serve round was 93%
+host work: per-leaf `jnp.stack` across the batch every round ("stack"),
+per-job per-leaf slicing of the output batch ("unpack"), and problem
+construction at round time ("pack"). This module collapses all three:
+
+- **staging buffers**: each (worker, shape-bucket) keeps ONE resident
+  stacked pytree (`BucketStaging.store`: SimState rows + Formation
+  rows). A request joins the batch layout with a single compiled
+  `write_row` call — donated, so the buffer is updated in place — and
+  the round's output rows return to the store through one donated
+  `scatter_rows`. Round-time "pack" is an index shuffle (`gather_rows`
+  with the live slots), not a per-leaf restack.
+- **submit-time prep**: admission builds the request's initial row
+  (SimState + Formation) when the request is accepted, with the
+  formation/safety/no-fault pieces cached per shape — the expensive
+  problem construction leaves the round path entirely.
+- **batched unpack**: `unpack_round` transposes the chunk positions to
+  request-major and pairs them with the final batch positions in ONE
+  compiled call, so the round's host sync is a single `device_get` of
+  a result pytree instead of per-request slices.
+
+All four jitted helpers are audited entry points
+(`analysis.trace_audit`: transfer-free, cache-stable, f64-clean), and
+the donated ones are registered in the jaxcheck JC005 donation
+registry — a staging buffer read after donation is a lint error, not a
+runtime surprise.
+
+Concurrency contract (serve.service owns the locking): staging buffers
+are mutated ONLY by the owning worker thread, with every donating call
+made under the service lock after re-checking the worker's fence flag.
+The failover supervisor reads rows (`take_row`) under the same lock
+after fencing the worker — so a donated-away buffer can never be read,
+and a fenced zombie can never donate a buffer the supervisor is
+reading.
+"""
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Any, List, Optional, Tuple
+
+__all__ = ["BucketStaging", "write_row", "gather_rows", "scatter_rows",
+           "take_row", "unpack_round", "cached_default_formation",
+           "cached_sparams", "cached_no_faults", "pow2"]
+
+
+def pow2(k: int) -> int:
+    """Smallest power of two >= max(1, k) (the batch-shape rule every
+    serve round has used since PR 6 — staging keeps the compiled batch
+    shapes identical to the pack-at-round-time path's)."""
+    p = 1
+    while p < max(1, k):
+        p *= 2
+    return p
+
+
+# small-index device constants, cached: every `jnp.asarray(i)` on the
+# round path is a host->device transfer (~0.1 ms on this host) and the
+# slot/index vocabulary is tiny (bounded by store capacity and padded
+# batch size), so the same handful of constants recurs every round
+_IDX_LOCK = threading.Lock()
+_IDX_CACHE: dict = {}
+_IDX_CACHE_MAX = 4096
+
+
+def i32(value) -> Any:
+    """A cached device-committed int32 scalar (int) or vector (tuple/
+    list of ints) — the staging ops' index operands."""
+    import jax.numpy as jnp
+
+    key = tuple(value) if isinstance(value, (list, tuple)) else int(value)
+    with _IDX_LOCK:
+        arr = _IDX_CACHE.get(key)
+    if arr is not None:
+        return arr
+    arr = jnp.asarray(list(key) if isinstance(key, tuple) else key,
+                      jnp.int32)
+    with _IDX_LOCK:
+        if len(_IDX_CACHE) >= _IDX_CACHE_MAX:
+            _IDX_CACHE.clear()      # tiny constants: rebuild is cheap
+        return _IDX_CACHE.setdefault(key, arr)
+
+
+# ---------------------------------------------------------------------------
+# compiled staging ops (audited entry points; see analysis.trace_audit)
+#
+# Lazy jit: the module must import without jax (telemetry/bench paths
+# import serve transitively), so the jitted callables are built on
+# first use and cached at module scope.
+
+_JIT_LOCK = threading.Lock()
+_JITTED: dict = {}
+
+
+def _jitted(name: str, build):
+    fn = _JITTED.get(name)
+    if fn is None:
+        with _JIT_LOCK:
+            fn = _JITTED.get(name)
+            if fn is None:
+                fn = _JITTED[name] = build()
+    return fn
+
+
+def _build_write_row():
+    import jax
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def write_row(store, row, slot):
+        """Scatter one request's prepared row into the (donated)
+        staging batch at ``slot`` — the admission-side half of the
+        index shuffle."""
+        return jax.tree.map(lambda b, r: b.at[slot].set(r), store, row)
+
+    return write_row
+
+
+def _build_gather_rows():
+    import jax
+
+    @jax.jit
+    def gather_rows(store, idx):
+        """Index-shuffle the round's batch out of the staging store
+        (also the capacity-growth path). Read-only: the store survives
+        for the rows that are not in this round."""
+        return jax.tree.map(lambda b: b[idx], store)
+
+    return gather_rows
+
+
+def _build_scatter_rows():
+    import jax
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def scatter_rows(store, rows, slot_idx, row_idx):
+        """Write the round's output rows back into the (donated)
+        staging store: ``store[slot_idx[i]] = rows[row_idx[i]]``. The
+        donation is what makes the staging buffer persistent — one
+        allocation reused round after round."""
+        return jax.tree.map(
+            lambda b, r: b.at[slot_idx].set(r[row_idx]), store, rows)
+
+    return scatter_rows
+
+
+def _build_take_row():
+    import jax
+
+    @jax.jit
+    def take_row(store, slot):
+        """Materialize one resident row (failover migration and
+        cross-incarnation re-staging read their state out with this)."""
+        return jax.tree.map(lambda b: b[slot], store)
+
+    return take_row
+
+
+def _build_init_row():
+    import jax
+
+    from aclswarm_tpu import sim
+
+    @jax.jit
+    def init_row(q0, faults):
+        """The serve request's initial SimState row as ONE compiled
+        call: submit-time prep runs on client threads, and ~20 eager
+        op dispatches per accepted request was measurable GIL pressure
+        against the worker loop at saturation (~2 ms -> ~0.4 ms)."""
+        return sim.init_state(q0, faults=faults)
+
+    return init_row
+
+
+def _build_unpack_round():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def unpack_round(q_ticks, q_final):
+        """Batched on-device unpack: chunk positions transposed to
+        request-major (each row lands host-contiguous, so the per-job
+        digest bytes match the legacy per-slice copies bit for bit)
+        plus the final batch positions — one result pytree, ONE
+        `device_get` per round."""
+        return {"q_chunks": jnp.swapaxes(q_ticks, 0, 1),
+                "q_final": q_final}
+
+    return unpack_round
+
+
+def write_row(store, row, slot):
+    return _jitted("write_row", _build_write_row)(store, row, slot)
+
+
+def gather_rows(store, idx):
+    return _jitted("gather_rows", _build_gather_rows)(store, idx)
+
+
+def scatter_rows(store, rows, slot_idx, row_idx):
+    return _jitted("scatter_rows", _build_scatter_rows)(
+        store, rows, slot_idx, row_idx)
+
+
+def take_row(store, slot):
+    return _jitted("take_row", _build_take_row)(store, slot)
+
+
+def unpack_round(q_ticks, q_final):
+    return _jitted("unpack_round", _build_unpack_round)(q_ticks, q_final)
+
+
+def init_row(q0, faults):
+    return _jitted("init_row", _build_init_row)(q0, faults)
+
+
+# the raw (un-jitted via __wrapped__) functions for the trace audit:
+# accessor names the audit registry binds to
+def jitted_entry(name: str):
+    """The jitted staging callable by name (trace_audit registration)."""
+    builders = {"write_row": _build_write_row,
+                "gather_rows": _build_gather_rows,
+                "scatter_rows": _build_scatter_rows,
+                "take_row": _build_take_row,
+                "unpack_round": _build_unpack_round,
+                "init_row": _build_init_row}
+    return _jitted(name, builders[name])
+
+
+# ---------------------------------------------------------------------------
+# submit-time problem caches (the "pack leaves the round path" half)
+#
+# The default serve problem pieces are pure functions of (n, dtype):
+# caching them moves the expensive construction off BOTH the round path
+# and the per-request submit path. Values are bit-identical to fresh
+# construction (same inputs, same ops), so staged results match the
+# legacy path exactly.
+
+_CACHE_LOCK = threading.Lock()
+_FORM_CACHE: dict = {}
+_SPARAMS_CACHE: dict = {}
+_FAULTS_CACHE: dict = {}
+
+
+def _dt_key(dt) -> str:
+    import numpy as np
+    return np.dtype(dt).name
+
+
+def cached_default_formation(n: int, dt):
+    """The serve default formation (circle + complete graph + identity
+    gains) for fleet size ``n`` — shared read-only across requests."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from aclswarm_tpu.core.types import make_formation
+
+    key = (int(n), _dt_key(dt))
+    with _CACHE_LOCK:
+        form = _FORM_CACHE.get(key)
+    if form is not None:
+        return form
+    ang = np.linspace(0, 2 * np.pi, n, endpoint=False)
+    pts = np.stack([3 * np.cos(ang), 3 * np.sin(ang),
+                    np.full(n, 2.0)], 1)
+    adj = np.ones((n, n)) - np.eye(n)
+    gains = (np.eye(n)[:, :, None, None] * np.eye(3)[None, None]
+             * 0.01)
+    form = make_formation(jnp.asarray(pts, dt), jnp.asarray(adj, dt),
+                          jnp.asarray(gains, dt))
+    with _CACHE_LOCK:
+        return _FORM_CACHE.setdefault(key, form)
+
+
+def cached_sparams(dt):
+    import jax.numpy as jnp
+
+    from aclswarm_tpu.core.types import SafetyParams
+
+    key = _dt_key(dt)
+    with _CACHE_LOCK:
+        sp = _SPARAMS_CACHE.get(key)
+    if sp is not None:
+        return sp
+    sp = SafetyParams(
+        bounds_min=jnp.asarray([-50.0, -50.0, 0.0], dt),
+        bounds_max=jnp.asarray([50.0, 50.0, 10.0], dt))
+    with _CACHE_LOCK:
+        return _SPARAMS_CACHE.setdefault(key, sp)
+
+
+def cached_no_faults(n: int, dt):
+    from aclswarm_tpu.faults import schedule as faultlib
+
+    key = (int(n), _dt_key(dt))
+    with _CACHE_LOCK:
+        fs = _FAULTS_CACHE.get(key)
+    if fs is not None:
+        return fs
+    fs = faultlib.no_faults(n, dtype=dt)
+    with _CACHE_LOCK:
+        return _FAULTS_CACHE.setdefault(key, fs)
+
+
+def clear_caches() -> None:
+    """Drop the problem + index caches (tests that flip the x64 flag
+    or tear down jax backends)."""
+    with _CACHE_LOCK:
+        _FORM_CACHE.clear()
+        _SPARAMS_CACHE.clear()
+        _FAULTS_CACHE.clear()
+    with _IDX_LOCK:
+        _IDX_CACHE.clear()
+
+
+# ---------------------------------------------------------------------------
+# per-(worker, bucket) staging state
+
+class BucketStaging:
+    """One worker incarnation's resident batch for one shape bucket.
+
+    ``store`` is a ``(state_rows, form_rows)`` tuple pytree with a
+    leading capacity axis; ``slots[i]`` names the `_Job` resident in
+    row ``i`` (None = free). ``shared`` is the bucket's
+    ``(ControlGains, SafetyParams, SimConfig)`` — identical for every
+    request in the bucket by construction of the bucket key.
+
+    The service mutates instances only from the owning worker thread
+    under its lock (see the module docstring's concurrency contract);
+    this class is deliberately just data + slot arithmetic.
+
+    Capacity is FIXED at creation (the service uses
+    ``2 * pow2(max_batch)`` — the double-buffered working set: one
+    round in flight plus one being packed). A bounded capacity keeps
+    the compiled shape set of the staging ops closed — every
+    (capacity, batch) combination is warmable once — where an
+    unbounded store re-compiled gather/scatter at every growth step
+    (measured as a compile storm inside the throughput window).
+    Residency is an LRU cache: when the store is full, the service
+    evicts a non-busy resident back to a per-job row (`take_row`) and
+    reuses its slot.
+    """
+
+    __slots__ = ("store", "slots", "shared", "device")
+
+    def __init__(self, device=None, shared=None):
+        self.store: Optional[Tuple[Any, Any]] = None
+        self.slots: List[Any] = []
+        self.shared = shared
+        self.device = device
+
+    @property
+    def capacity(self) -> int:
+        return len(self.slots)
+
+    def occupied(self) -> int:
+        return sum(1 for j in self.slots if j is not None)
+
+    def free_slots(self) -> List[int]:
+        return [i for i, j in enumerate(self.slots) if j is None]
+
+    # ---------------------------------------------------- store plumbing
+
+    def create(self, row: Tuple[Any, Any], cap: int) -> None:
+        """Allocate the store: zeros shaped like ``row`` with a leading
+        ``cap`` axis, committed to this staging's device."""
+        import jax
+        import jax.numpy as jnp
+
+        store = jax.tree.map(
+            lambda r: jnp.zeros((cap,) + r.shape, r.dtype), row)
+        if self.device is not None:
+            store = jax.device_put(store, self.device)
+        self.store = store
+        self.slots = [None] * cap

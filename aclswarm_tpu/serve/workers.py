@@ -83,7 +83,15 @@ class Worker:
     fenced: bool = False        # lease-lapsed zombie: must not touch jobs
     device: object = None       # this slot's mesh-slice lead device
     inflight: List[Tuple[object, int]] = dataclasses.field(
-        default_factory=list)   # [(job, epoch-at-pick)]
+        default_factory=list)   # [(job, epoch-at-pick)] — with the
+    #                             pipeline, BOTH the dispatched-pending
+    #                             round's pairs and the newly picked ones
+    staging: dict = dataclasses.field(default_factory=dict)
+    #                           # bucket -> serve.staging.BucketStaging:
+    #                             this INCARNATION's resident batches
+    #                             (reset on respawn; a dead worker's
+    #                             stranded rows are read out by the new
+    #                             owner under the service lock)
 
     @property
     def uid(self) -> str:
@@ -177,6 +185,7 @@ class WorkerPool:
             w.fenced = False
             w.last_beat = time.monotonic()
             w.inflight = []
+            w.staging = {}
             t = threading.Thread(target=self._run_worker, args=(w,),
                                  daemon=True,
                                  name=f"swarmserve-w{w.slot}.{w.gen}")
@@ -248,13 +257,49 @@ class WorkerPool:
                 self._rebuild_alive_view()
         self._publish_capacity()
 
+    def _drop_inflight(self, w: Worker, my_gen: int, pairs: list) -> None:
+        """Unregister one round's pairs (identity-matched: `_Job` is a
+        dataclass whose field-wise __eq__ must never run on pytrees)."""
+        with self._lock:
+            if w.gen == my_gen:
+                done = {id(j) for j, _ in pairs}
+                w.inflight = [p for p in w.inflight
+                              if id(p[0]) not in done]
+
     def _run_worker(self, w: Worker) -> None:
+        """The double-buffered worker loop (docs/SERVICE.md
+        §scheduling): each iteration PICKS and STARTS round k+1 (pack +
+        async dispatch — the device begins immediately), THEN FINISHES
+        round k (the one blocking device_get + resolve). The host's
+        pack/unpack/resolve work for one round overlaps the device's
+        compute for the next; a round whose bucket or config cannot
+        pipeline (single-shot kinds, ``staging=False``) completes
+        inside `_round_start` and leaves no pending half."""
+        from aclswarm_tpu.serve.service import _Fenced
+
         svc = self.svc
         my_gen = w.gen
+        pending = None              # the dispatched-unresolved round
+
+        def _abandon(pend):
+            """A round dropped between start and finish (scripted
+            kill, fence, zombie exit) still owes its parent
+            `serve.round` span — its child pack/stack/dispatch spans
+            already recorded, and a missing parent would make child
+            sums exceed the round sum (read as mis-nesting by the
+            breakdown validator) for a cause that is span loss."""
+            if pend is not None:
+                svc._emit_round_span(pend.start_dur, pend.span_attrs,
+                                     error=True)
+
         while not svc._stop.is_set():
             w.last_beat = time.monotonic()
             if w.fenced or w.gen != my_gen:
+                _abandon(pending)
                 return              # zombie: the supervisor replaced us
+                #                     (pending work was failed over at
+                #                     declare-dead with the in-flight
+                #                     set — nothing to hand back)
 
             taken: dict = {}
 
@@ -264,57 +309,121 @@ class WorkerPool:
                 # atomic step. The picked batch is returned through
                 # `taken`, never re-read from the shared slot record —
                 # a replacement incarnation's in-flight list must be
-                # invisible to this thread.
+                # invisible to this thread. APPEND, don't replace: the
+                # pending round's pairs are still in flight.
                 with self._lock:
                     pairs = [(j, j.epoch) for j in jobs]
                     taken["pairs"] = pairs
                     if w.gen == my_gen and not w.fenced:
-                        w.inflight = pairs
+                        w.inflight = w.inflight + pairs
                         for j in jobs:
                             j.worker = w.slot
+                            j.pick_batch = len(jobs)
                     else:
                         taken["stale"] = True
 
+            # with a round pending, poll instead of parking: the next
+            # pick either overlaps the device or we go finish the round
             jobs = svc._adm.pick(self.cfg.max_batch,
-                                 timeout=self.cfg.idle_poll_s,
+                                 timeout=(0.0 if pending is not None
+                                          else self.cfg.idle_poll_s),
                                  eligible=lambda j: self.eligible(j, w),
                                  on_take=_take)
-            if not jobs:
+            if not jobs and pending is None:
                 if (svc._draining.is_set() and svc._adm.empty()
                         and self.inflight_total() == 0):
                     self._mark_exited(w, my_gen)
                     return          # all tenants idle: clean exit
                 continue
-            pairs = taken["pairs"]
+            pairs = taken.get("pairs", [])
             if taken.get("stale"):
                 # the slot was replaced between the loop-top gen check
                 # and the pick: this thread is a zombie, but it just
                 # dequeued real jobs that are registered NOWHERE — hand
                 # them straight back so the live fleet runs them
                 svc._requeue_unowned(pairs)
+                _abandon(pending)
                 return
-            w.round += 1
-            try:
-                svc._worker_round(pairs, w)
-            except InjectedCrash as e:
-                # the scripted worker kill: die ABRUPTLY, in-flight work
-                # still registered — exactly what a SIGKILLed worker
-                # process leaves behind. The supervisor detects the dead
-                # thread and fails the work over to a surviving worker.
-                self.log.warning("serve worker %s dying as scripted: %s",
-                                 w.uid, e)
-                return
-            except Exception as e:      # noqa: BLE001 — recorded
-                svc._fail_round(pairs, e)
-            with self._lock:
-                if w.gen == my_gen:
-                    w.inflight = []
+            def _finish_now(pend, busy, w=w, my_gen=my_gen):
+                """Resolve one pending round; True = this thread must
+                die (scripted kill / fenced)."""
+                try:
+                    svc._round_finish(pend, w, busy=busy)
+                except InjectedCrash as e:
+                    self.log.warning(
+                        "serve worker %s dying as scripted: %s",
+                        w.uid, e)
+                    svc._emit_round_span(pend.start_dur,
+                                         pend.span_attrs, error=True)
+                    return True
+                except _Fenced:
+                    svc._emit_round_span(pend.start_dur,
+                                         pend.span_attrs, error=True)
+                    return True
+                except Exception as e:      # noqa: BLE001 — recorded
+                    svc._fail_round(pend.pairs, e)
+                self._drop_inflight(w, my_gen, pend.pairs)
+                return False
+
+            # quarantine isolation: a SUSPECT's solo round must never
+            # overlap another round — a kill during its residency has
+            # to implicate exactly that batch (the poison bound's
+            # blame unit). With overlap allowed, every death would
+            # leave two rounds' orphans and a max_batch=1 fleet under
+            # load could never attribute a solo kill unambiguously —
+            # the poison request would ping-pong workers into the
+            # circuit breaker instead of terminating `poisoned`.
+            if jobs and pending is not None and (
+                    any(getattr(j, "suspect", False) for j in jobs)
+                    or any(getattr(j, "suspect", False)
+                           for j in pending.jobs)):
+                if _finish_now(pending, 0):
+                    return
+                pending = None
+            new_pending = None
+            if jobs:
+                w.round += 1
+                try:
+                    new_pending = svc._round_start(
+                        pairs, w,
+                        busy_ids=(frozenset(id(j) for j in pending.jobs)
+                                  if pending is not None
+                                  else frozenset()))
+                except InjectedCrash as e:
+                    # the scripted worker kill: die ABRUPTLY, in-flight
+                    # work still registered — exactly what a SIGKILLed
+                    # worker process leaves behind. The supervisor
+                    # detects the dead thread and fails the work (BOTH
+                    # rounds' — pending included) over to a survivor.
+                    self.log.warning(
+                        "serve worker %s dying as scripted: %s", w.uid, e)
+                    _abandon(pending)
+                    return
+                except _Fenced:
+                    _abandon(pending)
+                    return          # fenced mid-round: jobs failed over
+                except Exception as e:      # noqa: BLE001 — recorded
+                    svc._fail_round(pairs, e)
+                    self._drop_inflight(w, my_gen, pairs)
+                if new_pending is None:
+                    # round completed inside _round_start (single-shot,
+                    # legacy path, pipeline off, or fully gated out)
+                    self._drop_inflight(w, my_gen, pairs)
+            if pending is not None:
+                if _finish_now(pending,
+                               len(new_pending.jobs) if new_pending
+                               else 0):
+                    return
+            pending = new_pending
             # a COMPLETED round closes the breaker window: `fails`
             # counts consecutive deaths, not lifetime deaths — an
             # always-on fleet absorbing an isolated death every few
             # hours must never creep toward permanent retirement
             if w.gen == my_gen and not w.fenced:
                 w.fails = 0
+        _abandon(pending)                   # stop flag: close() sweep
+        #                                     resolves the jobs; the
+        #                                     round still logs its span
         self._mark_exited(w, my_gen)        # stop flag: clean exit
 
     # ---------------------------------------------------------- failover
@@ -400,8 +509,18 @@ class WorkerPool:
         # its behalf so the spans LEADING UP to the death survive to
         # the journal (docs/OBSERVABILITY.md §swarmtrace)
         svc._flush_spans(f"worker {uid} declared dead: {reason}")
+        # solo attribution for the poison bound, pipeline-aware: a kill
+        # implicates a job only if it was ALONE in its own picked batch
+        # AND it is the only such solo orphan (with the pipeline a dead
+        # worker usually leaves TWO rounds' orphans — an orphan-set
+        # "len == 1" test would let a poison request hide behind the
+        # overlapping round's jobs forever, while blaming EVERY solo
+        # orphan would let a poison kill implicate an innocent suspect
+        # running its quarantine round in the overlapping slot; two
+        # solos at once is ambiguous, and ambiguity quarantines but
+        # never counts — the next unambiguous kill does).
+        solos = [job for job, _ in orphans if job.pick_batch == 1]
         for job, epoch in orphans:
-            # a SOLO orphan has nobody else to blame for the death —
-            # only those kills count toward the poison bound
             svc._failover_job(job, epoch, uid,
-                              solo=len(orphans) == 1)
+                              solo=(job.pick_batch == 1
+                                    and len(solos) == 1))

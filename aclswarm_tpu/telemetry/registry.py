@@ -48,6 +48,24 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
 _PCTS = (50.0, 95.0, 99.0)
 
 
+def _quantile(data: list, p: float) -> float:
+    """Linear interpolation between order statistics (numpy's default
+    'linear' method) over an already-sorted sample.
+
+    Nearest-rank (the pre-PR-11 rule) aliases the tail at small
+    counts: at n=15 both p95 and p99 land on the same order statistic,
+    so every committed small-count artifact reported p95_s == p99_s —
+    a made-up equality. Interpolating keeps p99 strictly between p95
+    and the observed max whenever the top samples differ, and still
+    converges to nearest-rank as n grows."""
+    if len(data) == 1:
+        return data[0]
+    pos = p / 100.0 * (len(data) - 1)
+    lo = int(math.floor(pos))
+    hi = min(lo + 1, len(data) - 1)
+    return data[lo] + (data[hi] - data[lo]) * (pos - lo)
+
+
 def _label_key(labels: Optional[dict]) -> tuple:
     return tuple(sorted((str(k), str(v))
                         for k, v in (labels or {}).items()))
@@ -185,13 +203,7 @@ class Histogram(_Metric):
             data = sorted(self._ring)
         if not data:
             return {}
-        out = {}
-        for p in pcts:
-            # nearest-rank on the sorted reservoir
-            idx = min(len(data) - 1,
-                      max(0, math.ceil(p / 100.0 * len(data)) - 1))
-            out[f"p{p:g}"] = data[idx]
-        return out
+        return {f"p{p:g}": _quantile(data, p) for p in pcts}
 
     def to_row(self) -> dict:
         with self._lock:
@@ -204,9 +216,7 @@ class Histogram(_Metric):
             row["max"] = mx
             row["mean"] = total / count
             for p in _PCTS:
-                idx = min(len(data) - 1,
-                          max(0, math.ceil(p / 100.0 * len(data)) - 1))
-                row[f"p{p:g}"] = data[idx]
+                row[f"p{p:g}"] = _quantile(data, p)
         return row
 
 

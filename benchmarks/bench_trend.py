@@ -66,13 +66,34 @@ def _comparable(row: dict) -> bool:
             and v > 0 and "error" not in row)
 
 
+# discriminator fields folded into the series key when present: rows
+# like serve_stage carry one (name, unit) per STAGE per shape per
+# backend, and matching by name alone would compare pack against
+# unpack across rounds — a meaningless delta that can both mask a real
+# regression and invent a fake one
+_SERIES_KEYS = ("stage", "n", "backend")
+
+
+def series_key(row: dict) -> str | None:
+    """The comparability key a row trends under: its name plus any
+    discriminator fields it carries (stage/n/backend). Rows without
+    discriminators keep their bare name, so existing BENCH_r* series
+    are unbroken."""
+    name = row.get("name", row.get("metric"))
+    if not (isinstance(name, str) and name):
+        return None
+    disc = [f"{k}={row[k]}" for k in _SERIES_KEYS if k in row]
+    return name + (" [" + ", ".join(disc) + "]" if disc else "")
+
+
 def series(rounds: list[tuple[int, dict]]) -> dict[str, list]:
-    """metric name -> [(round, row)] (legacy 'metric' key accepted)."""
+    """comparability key -> [(round, row)] (legacy 'metric' key
+    accepted; see `series_key`)."""
     by: dict[str, list] = {}
     for rnd, row in rounds:
-        name = row.get("name", row.get("metric"))
-        if isinstance(name, str) and name:
-            by.setdefault(name, []).append((rnd, row))
+        key = series_key(row)
+        if key is not None:
+            by.setdefault(key, []).append((rnd, row))
     return by
 
 

@@ -75,7 +75,8 @@ _MW_KEYS = set(_MW_COUNTS) | {"name", "n", "backend",
 # evidence, so a silently dropped occupancy column is evidence rot
 SERVE_THROUGHPUT = "serve_throughput.json"
 _THROUGHPUT_KEYS = {"name", "n", "backend", "offered_hz", "value",
-                    "unit", "occupancy_mean", "occupancy_p95",
+                    "unit", "speedup", "stage_fracs", "host_frac",
+                    "occupancy_mean", "occupancy_p95",
                     "queue_depth_mean", "queue_depth_p95", "accepted",
                     "completed", "rejected", "preempted",
                     "deadline_miss", "wall_s", "quick"}
@@ -83,6 +84,16 @@ _THROUGHPUT_COUNTS = ("accepted", "completed", "rejected", "preempted",
                       "deadline_miss")
 # minimum committed offered-load levels (the acceptance criterion)
 _THROUGHPUT_MIN_LEVELS = 3
+# the PR-11 acceptance bar AS schema: at least one committed
+# offered-load level must show the >= 3x single-worker req/s jump over
+# the PR-7 capture on the same host (serve_throughput.py::R7_BASELINE_HZ
+# — rows with no baseline for their level carry speedup 0.0)
+_THROUGHPUT_SPEEDUP_BAR = 3.0
+# per-round stage attribution carried alongside req/s (PR-11: the
+# throughput jump must be attributable to the host-stage collapse in
+# ONE artifact); fractions of span_serve.round_s, breakdown convention
+_THROUGHPUT_STAGES = {"pack", "stack", "dispatch", "device_sync",
+                      "unpack", "resolve"}
 
 # the swarmtrace soak artifact (benchmarks/trace_soak.py;
 # docs/OBSERVABILITY.md §swarmtrace): summary-shaped, exact key set,
@@ -112,6 +123,13 @@ _STAGE_KEYS = {"name", "stage", "n", "backend", "count", "value",
                "quick"}
 _STAGE_SET = {"round", "pack", "stack", "dispatch", "device_sync",
               "unpack", "resolve"}
+# the PR-11 acceptance bar AS schema: the host-side stages of the
+# committed breakdown must stay BELOW half the round — the staged
+# device-bound path collapsed pack 36% / stack 24% / unpack 30% (the
+# PR-9 capture) and an artifact that drifts back to host-bound rounds
+# is a regression, not a new baseline
+_HOST_STAGES = ("pack", "stack", "unpack")
+_HOST_FRAC_BAR = 0.5
 
 # the telemetry overhead artifact (aclswarm_tpu.telemetry.overhead):
 # exact key set per named row, and the <5% acceptance bar is part of
@@ -160,6 +178,32 @@ def check_serve_throughput(rows: list, where: str) -> list[str]:
                                  and 0.0 <= row[k] <= 1.0):
                 probs.append(f"{at}: '{k}' must be within [0, 1], got "
                              f"{row[k]!r}")
+        if "speedup" in row and not (_finite_num(row["speedup"])
+                                     and row["speedup"] >= 0):
+            probs.append(f"{at}: 'speedup' must be a finite "
+                         f"non-negative number, got {row['speedup']!r}")
+        if "host_frac" in row and not (_finite_num(row["host_frac"])
+                                       and 0.0 <= row["host_frac"]
+                                       <= 1.0001):
+            probs.append(f"{at}: 'host_frac' must be within [0, 1], "
+                         f"got {row['host_frac']!r}")
+        fr = row.get("stage_fracs")
+        if "stage_fracs" in row:
+            if not isinstance(fr, dict):
+                probs.append(f"{at}: 'stage_fracs' must be an object")
+            else:
+                miss = _THROUGHPUT_STAGES - set(fr)
+                unk = set(fr) - _THROUGHPUT_STAGES
+                if miss:
+                    probs.append(f"{at}: stage_fracs missing "
+                                 f"{sorted(miss)}")
+                if unk:
+                    probs.append(f"{at}: stage_fracs unknown keys "
+                                 f"{sorted(unk)}")
+                for k, v in fr.items():
+                    if not (_finite_num(v) and 0.0 <= v <= 1.0001):
+                        probs.append(f"{at}: stage_fracs.{k} must be "
+                                     f"within [0, 1], got {v!r}")
         for k in _THROUGHPUT_COUNTS:
             if k in row and not _is_count(row[k]):
                 probs.append(f"{at}: '{k}' must be a non-negative int, "
@@ -179,6 +223,17 @@ def check_serve_throughput(rows: list, where: str) -> list[str]:
             f"level(s); the committed artifact owes >= "
             f"{_THROUGHPUT_MIN_LEVELS} (request Hz vs occupancy vs "
             "offered load)")
+    non_quick = [r for r in rows if isinstance(r, dict)
+                 and not r.get("quick")]
+    if non_quick and not any(
+            _finite_num(r.get("speedup"))
+            and r["speedup"] >= _THROUGHPUT_SPEEDUP_BAR
+            for r in non_quick):
+        probs.append(
+            f"{where}: no committed level shows the >= "
+            f"{_THROUGHPUT_SPEEDUP_BAR:g}x single-worker req/s jump "
+            "over the PR-7 capture (the PR-11 acceptance bar; "
+            "'speedup' vs serve_throughput.py::R7_BASELINE_HZ)")
     return probs
 
 
@@ -346,6 +401,16 @@ def check_serve_latency_breakdown(rows: list, where: str) -> list[str]:
             probs.append(
                 f"{where}: child stages sum ({child:.6f}s) exceeds the "
                 f"round wall ({rnd['sum_s']:.6f}s) — mis-nested spans")
+    if not any(r.get("quick") for r in seen.values()):
+        host = sum(seen[s]["frac_round"] for s in _HOST_STAGES
+                   if s in seen and _finite_num(
+                       seen[s].get("frac_round")))
+        if host >= _HOST_FRAC_BAR:
+            probs.append(
+                f"{where}: host stages (pack+stack+unpack) at "
+                f"{host:.1%} of the round — the committed breakdown "
+                f"must stay below {_HOST_FRAC_BAR:.0%} (the PR-11 "
+                "device-bound-round acceptance bar)")
     return probs
 
 

@@ -58,13 +58,16 @@ def _drive(requests: int, start: bool = True):
 
 def run_load(requests: int) -> object:
     # warm pass on THROWAWAY services: the jit cache is process-wide,
-    # so compile every power-of-two batch shape the measured load can
-    # reach BEFORE its histograms start recording — the committed
-    # breakdown is the steady state, not the compile storm. Queueing B
-    # requests before start() guarantees the first round packs exactly
+    # so compile every shape the measured load can reach BEFORE its
+    # histograms start recording — the committed breakdown is the
+    # steady state, not the compile storm. Queueing B requests before
+    # start() guarantees the first round packs exactly
     # min(B, max_batch) (a started service drains too fast to reach
-    # the bigger shapes deterministically).
-    for b in (1, 2, 4):
+    # the bigger shapes deterministically). b=1,2,4 cover the
+    # power-of-two batch shapes; b=12 overflows the fixed-capacity
+    # staging store so the LRU-eviction path (serve.staging take_row
+    # at store capacity) is compiled too.
+    for b in (1, 2, 4, 12):
         _drive(b, start=False)
     return _drive(requests)
 

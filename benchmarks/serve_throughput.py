@@ -47,17 +47,18 @@ TICKS = 60                # 3-chunk requests: jobs stay resident across
 # bracket the measured single-stream capacity of this host (~100
 # requests/s at ~8-10 ms per solo request): light (buckets stay at one
 # slot — latency-optimal), at-capacity (the rate a no-batching service
-# would cap at), and saturating (buckets fill to ~1.0 occupancy, the
-# achieved rate EXCEEDS single-stream capacity because the per-round
-# cost amortizes across max_batch slots, and admission sheds the rest).
-OFFERED_HZ = (16.0, 100.0, 400.0)
+# would cap at), the PR-7 saturation point (107 req/s then — the
+# staged round now absorbs this whole level, >= 3x), and a 1000 Hz
+# level that saturates even the staged path (buckets at ~1.0
+# occupancy, admission shedding the rest — the backpressure evidence).
+OFFERED_HZ = (16.0, 100.0, 400.0, 1000.0)
 OFFERED_HZ_QUICK = (8.0, 64.0)
 DURATION_S = 6.0
 DURATION_S_QUICK = 2.5
 TENANTS = ("alpha", "beta", "gamma")
 
 
-def _service():
+def _service(start: bool = True):
     from aclswarm_tpu.serve import ServiceConfig, SwarmService
 
     # modest caps so the saturating level provably exercises admission
@@ -65,51 +66,86 @@ def _service():
     # a durability drill (serve_soak.py owns that)
     return SwarmService(ServiceConfig(
         max_batch=4, quantum_chunks=4, max_queue_per_tenant=8,
-        max_queue_total=24, idle_poll_s=0.01))
+        max_queue_total=24, idle_poll_s=0.01), start=start)
 
 
 def _warmup() -> str:
-    """Compile the rollout bucket once, outside every measured level."""
+    """Compile every shape the measured levels can reach, outside the
+    measurement. Queueing exactly ``b`` requests on a NOT-yet-started
+    service guarantees the first round packs min(b, max_batch) — so
+    every power-of-two batch shape (rollout + the serve.staging
+    write/gather/scatter/unpack ops) lands in the process-wide jit
+    cache deterministically, and the 24-burst additionally exercises
+    the staging store at full occupancy with admission engaged. A
+    level's fresh service must find every shape pre-compiled, or its
+    6 s window measures the compiler instead of the scheduler."""
     import jax
 
-    svc = _service()
-    t = svc.submit("rollout", {"n": N, "ticks": TICKS,
-                               "chunk_ticks": TICKS, "seed": 0})
-    res = t.result(timeout=600)
-    assert res.ok, f"warmup failed: {res}"
-    svc.close()
+    for b in (1, 2, 4, 24):
+        svc = _service(start=False)
+        tickets = []
+        for i in range(b):
+            tickets.append(svc.submit(
+                "rollout", {"n": N, "ticks": TICKS,
+                            "chunk_ticks": TICKS, "seed": 1000 * b + i},
+                tenant=TENANTS[i % len(TENANTS)]))
+        svc.start()
+        for t in tickets:
+            res = t.result(timeout=600)
+            assert res.ok, f"warmup (b={b}) failed: {res}"
+        svc.close()
     return jax.default_backend()
 
 
 def run_level(offered_hz: float, duration_s: float) -> dict:
     """One offered-load level: paced submissions for ``duration_s``,
-    then drain every ticket to a terminal result and read the stats."""
+    then drain every ticket to a terminal result and read the stats.
+
+    One paced client thread PER TENANT (offered_hz split evenly):
+    since PR 11 moved request prep to submit time, a single client
+    thread saturates at its own submit rate (~1 ms per accepted
+    request) long before the staged service does — the level must
+    measure the SERVICE's capacity, not one client's."""
     from aclswarm_tpu.serve import RejectedError
 
     svc = _service()
-    tickets = []
+    tickets: list = []
+    tlock = threading.Lock()
+    per_hz = offered_hz / len(TENANTS)
+
+    def client(k: int, tenant: str, t0: float) -> None:
+        i = 0
+        # paced open-loop submission: request i is due at
+        # t0 + i/per_hz regardless of how the service is keeping up
+        # (closed-loop pacing would hide saturation — the point is to
+        # offer MORE than it drains)
+        while True:
+            due = t0 + i / per_hz
+            now = time.perf_counter()
+            if due > t0 + duration_s:
+                return
+            if due > now:
+                time.sleep(due - now)
+            try:
+                t = svc.submit(
+                    "rollout",
+                    {"n": N, "ticks": TICKS, "chunk_ticks": TICKS,
+                     "seed": 1_000_000 * k + i},
+                    tenant=tenant,
+                    request_id=f"lvl{offered_hz:g}-{tenant}-{i}")
+                with tlock:
+                    tickets.append(t)
+            except RejectedError:
+                pass     # backpressure; counted by the service registry
+            i += 1
+
     t0 = time.perf_counter()
-    i = 0
-    # paced open-loop submission: request i is due at t0 + i/offered_hz
-    # regardless of how the service is keeping up (closed-loop pacing
-    # would hide saturation — the point is to offer MORE than it drains)
-    while True:
-        due = t0 + i / offered_hz
-        now = time.perf_counter()
-        if due > t0 + duration_s:
-            break
-        if due > now:
-            time.sleep(due - now)
-        try:
-            tickets.append(svc.submit(
-                "rollout",
-                {"n": N, "ticks": TICKS, "chunk_ticks": TICKS,
-                 "seed": i},
-                tenant=TENANTS[i % len(TENANTS)],
-                request_id=f"lvl{offered_hz:g}-{i}"))
-        except RejectedError:
-            pass     # backpressure; counted by the service registry
-        i += 1
+    clients = [threading.Thread(target=client, args=(k, tenant, t0))
+               for k, tenant in enumerate(TENANTS)]
+    for c in clients:
+        c.start()
+    for c in clients:
+        c.join()
     # drain every accepted ticket to a terminal result; a ticket still
     # unresolved after its bounded wait is a broken serve promise and
     # counts as failed (surfaced as the FAIL exit in main, not a hang)
@@ -126,7 +162,37 @@ def run_level(offered_hz: float, duration_s: float) -> dict:
     return {
         "completed": completed, "wall_s": wall, "stats": st,
         "failed": sum(1 for r in results if not r.ok) + non_terminal,
+        "stage_fracs": _stage_fracs(svc),
     }
+
+
+STAGES = ("pack", "stack", "dispatch", "device_sync", "unpack",
+          "resolve")
+# host-side stages of the round (the 90%+ the PR-9 breakdown exposed;
+# the staged path owes their collapse — docs/SERVICE.md §scheduling)
+HOST_STAGES = ("pack", "stack", "unpack")
+
+
+def _stage_fracs(svc) -> dict:
+    """Per-round stage fractions from this level's own span histograms:
+    the attribution that makes the req/s jump explainable in ONE
+    artifact (stage sum / serve.round sum, the latency-breakdown
+    convention)."""
+    def _sum(name):
+        return float(svc.telemetry.histogram(name).to_row()
+                     .get("sum", 0.0))
+
+    rs = _sum("span_serve.round_s")
+    return {s: (round(_sum(f"span_serve.round.{s}_s") / rs, 4)
+                if rs else 0.0)
+            for s in STAGES}
+
+
+# the PR-7 committed rows on this host (benchmarks/results/
+# serve_throughput.json before PR 11; see git history) — the ``speedup``
+# column is the single-worker req/s jump the staged round owes vs that
+# capture, offered-load level by level
+R7_BASELINE_HZ = {16.0: 16.134, 100.0: 55.273, 400.0: 107.267}
 
 
 def main(argv=None) -> int:
@@ -148,13 +214,20 @@ def main(argv=None) -> int:
         r = run_level(hz, dur)
         st = r["stats"]
         broken += r["failed"]
+        hz_achieved = round(r["completed"] / r["wall_s"], 3)
+        base = R7_BASELINE_HZ.get(hz)
+        fr = r["stage_fracs"]
         row = {
             "name": "serve_throughput",
             "n": N,
             "backend": backend,
             "offered_hz": round(hz, 3),
-            "value": round(r["completed"] / r["wall_s"], 3),
+            "value": hz_achieved,
             "unit": "Hz",
+            "speedup": (round(hz_achieved / base, 3)
+                        if base else 0.0),
+            "stage_fracs": fr,
+            "host_frac": round(sum(fr[s] for s in HOST_STAGES), 4),
             "occupancy_mean": round(st.occupancy_mean, 4),
             "occupancy_p95": round(st.occupancy_p95, 4),
             "queue_depth_mean": round(st.queue_depth_mean, 3),

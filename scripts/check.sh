@@ -44,9 +44,12 @@ python benchmarks/check_results.py
 # HLO == committed baseline) is enforced by the trace_audit step above —
 # verify_zero_cost_off covers check_mode AND telemetry through the one
 # shared baseline, so no second lowering sweep is run here.
-echo "== swarmscope owed artifacts: serve_throughput + =="
-echo "== telemetry_overhead committed and on schema =="
-echo "== (docs/OBSERVABILITY.md) =="
+echo "== swarmscope owed artifacts committed and on schema =="
+echo "== (docs/OBSERVABILITY.md). Since PR 11 the schema IS the =="
+echo "== acceptance bar: serve_throughput must show the >=3x =="
+echo "== staged-round speedup, and serve_latency_breakdown must =="
+echo "== keep host stages (pack+stack+unpack) under 50% of the =="
+echo "== round — a stale pre-staging artifact fails here. =="
 python - <<'EOF'
 import sys
 

@@ -24,3 +24,22 @@ def good_chunked(state, deltas):
     for d in deltas:
         state = consume(state, d)               # ok: rebound every pass
     return state
+
+
+# --- the serve.staging shape (PR 11): a donated staging store -------------
+
+@partial(jax.jit, donate_argnums=(0,))
+def write_row(store, row, slot):
+    return jax.tree.map(lambda b, r: b.at[slot].set(r), store, row)
+
+
+def bad_staging_pack(store, row):
+    write_row(store, row, 0)
+    return jax.tree.map(lambda b: b[0], store)  # JC005 (store donated above)
+
+
+def good_staging_pack(store, rows):
+    for i, row in enumerate(rows):
+        store = write_row(store, row, i)        # ok: the staging idiom —
+        #                                         donate, rebind, reuse
+    return store

@@ -45,7 +45,7 @@ class TestLintFixtures:
         ("bad_jc002.py", "JC002", 3),
         ("bad_jc003.py", "JC003", 4),
         ("bad_jc004.py", "JC004", 3),
-        ("bad_jc005.py", "JC005", 1),
+        ("bad_jc005.py", "JC005", 2),
         ("bad_jc006.py", "JC006", 3),
     ])
     def test_rule_fires(self, fired, fixture, rule, count):

@@ -318,6 +318,11 @@ def test_serve_throughput_schema_flags_drift(tmp_path):
     def row(**kw):
         base = {"name": "serve_throughput", "n": 5, "backend": "cpu",
                 "offered_hz": 8.0, "value": 7.9, "unit": "Hz",
+                "speedup": 1.0,
+                "stage_fracs": {"pack": 0.05, "stack": 0.05,
+                                "dispatch": 0.4, "device_sync": 0.3,
+                                "unpack": 0.05, "resolve": 0.02},
+                "host_frac": 0.15,
                 "occupancy_mean": 0.25, "occupancy_p95": 0.25,
                 "queue_depth_mean": 0.0, "queue_depth_p95": 0.0,
                 "accepted": 20, "completed": 20, "rejected": 0,
@@ -326,8 +331,20 @@ def test_serve_throughput_schema_flags_drift(tmp_path):
         base.update(kw)
         return base
 
-    good = [row(offered_hz=h) for h in (2.0, 8.0, 32.0)]
+    # at least one level must carry the >= 3x PR-11 speedup bar
+    good = [row(offered_hz=h) for h in (2.0, 8.0)] \
+        + [row(offered_hz=32.0, speedup=3.2)]
     assert check_serve_throughput(good, "x") == []
+    # the speedup bar is schema: a committed artifact with no >= 3x
+    # level is rejected
+    flat = [row(offered_hz=h, speedup=1.1) for h in (2.0, 8.0, 32.0)]
+    assert any("3x" in p or "jump" in p
+               for p in check_serve_throughput(flat, "x"))
+    # stage_fracs is exact-key-set like everything else
+    bad_fr = good[:2] + [row(offered_hz=32.0, speedup=3.2,
+                             stage_fracs={"pack": 0.1})]
+    assert any("stage_fracs missing" in p
+               for p in check_serve_throughput(bad_fr, "x"))
     # exact key set: unknown and missing keys both flagged
     extra = [dict(row(), bogus=1)] + good
     assert any("unknown keys" in p

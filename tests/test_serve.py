@@ -209,17 +209,26 @@ class TestDeadlines:
         the next chunk boundary with a structured error — partial work
         is cancelled, the service moves on, other requests are
         unaffected."""
+        # warm the bucket first: the first batched_rollout compile
+        # (~2 s on this host) would otherwise eat the whole deadline
+        # before the long job's first chunk — the test is about a
+        # deadline lapsing DURING chunks, not during a cold compile
+        assert svc.submit("rollout", dict(ROLL, ticks=20, seed=1),
+                          tenant="warm").result(timeout=240).ok
         # long job with a deadline it cannot meet, short job without
         tshort = svc.submit("rollout", dict(ROLL, seed=9), tenant="b")
+        # 5000 chunks: unfinishable inside the deadline even on the
+        # staged path (PR 11 made 20-tick rounds sub-millisecond — a
+        # 500-chunk job started COMPLETING inside the old 2 s window)
         tlong = svc.submit(
-            "rollout", {"n": 5, "ticks": 10_000, "chunk_ticks": 20,
+            "rollout", {"n": 5, "ticks": 100_000, "chunk_ticks": 20,
                         "seed": 8},
             tenant="a", deadline_s=2.0)
         rlong = tlong.result(timeout=240)
         assert rlong.status == TIMED_OUT and not rlong.ok
         assert rlong.error.code == "deadline_exceeded"
         assert "chunk boundary" in rlong.error.message
-        assert 0 < rlong.chunks < 500      # it ran, then was cancelled
+        assert 0 < rlong.chunks < 5000     # it ran, then was cancelled
         assert tshort.result(timeout=240).ok
 
     def test_expired_on_arrival(self, svc):
@@ -253,6 +262,77 @@ class TestPreemption:
             assert got.value["digest"] == want.value["digest"]
             assert got.value["chunk_digests"] == want.value["chunk_digests"]
             assert np.array_equal(got.value["q"], want.value["q"])
+
+
+# ------------------------------------------- staged-round parity (PR 11)
+
+class TestStagedParity:
+    """The staged device-bound round (serve.staging: submit-time prep,
+    donated staging buffers, double-buffered pipelining, batched
+    unpack) must be BIT-IDENTICAL to the PR-9 pack-at-round-time path,
+    which is kept behind ``ServiceConfig(staging=False)`` exactly as
+    this reference."""
+
+    def _legacy(self, specs):
+        svc = SwarmService(ServiceConfig(max_batch=2, staging=False))
+        out = [svc.submit("rollout", s).result(timeout=240)
+               for s in specs]
+        svc.close()
+        assert all(r.ok for r in out)
+        return out
+
+    @pytest.mark.parametrize("pipeline", [True, False])
+    def test_staged_rounds_bit_identical_to_legacy(self, pipeline):
+        specs = [ROLL, ROLL_FAULTED]
+        want = self._legacy(specs)
+        svc = SwarmService(ServiceConfig(max_batch=2,
+                                         pipeline=pipeline))
+        ts = [svc.submit("rollout", s) for s in specs]
+        got = [t.result(timeout=240) for t in ts]
+        svc.close()
+        for g, w in zip(got, want):
+            assert g.ok
+            assert g.value["digest"] == w.value["digest"]
+            assert g.value["chunk_digests"] == w.value["chunk_digests"]
+            assert np.array_equal(g.value["q"], w.value["q"])
+
+    def test_staged_parity_across_preemption_resume(self):
+        """Contended staged rounds (1-slot batch, 1-chunk quantum —
+        both jobs evicted through the codec repeatedly) still match
+        the legacy path bit for bit."""
+        specs = [ROLL_FAULTED, dict(ROLL, seed=7)]
+        want = self._legacy(specs)
+        svc = SwarmService(ServiceConfig(max_batch=1, quantum_chunks=1))
+        ts = [svc.submit("rollout", s, tenant=f"t{i}")
+              for i, s in enumerate(specs)]
+        got = [t.result(timeout=240) for t in ts]
+        svc.close()
+        assert any(r.preemptions > 0 for r in got)
+        for g, w in zip(got, want):
+            assert g.ok
+            assert g.value["digest"] == w.value["digest"]
+            assert g.value["chunk_digests"] == w.value["chunk_digests"]
+
+    def test_staged_parity_across_worker_kill_migration(self, tmp_path):
+        """A staged rollout migrated off a killed worker (checkpoint
+        codec, quarantine, re-staging on the survivor) matches the
+        legacy path bit for bit — the PR-8 chaos bar holds over the
+        pipelined path."""
+        from aclswarm_tpu.serve import bucket_of, place_slot
+
+        want = self._legacy([ROLL_FAULTED])[0]
+        svc = SwarmService(ServiceConfig(
+            workers=2, max_batch=1, quantum_chunks=8,
+            journal_dir=str(tmp_path), supervise_poll_s=0.02,
+            rejoin_base_s=0.05))
+        slot = place_slot(bucket_of("rollout", ROLL_FAULTED), [0, 1])
+        crashlib.arm(CrashPlan(f"serve.w{slot}", 2, "raise"))
+        got = svc.submit("rollout", ROLL_FAULTED).result(timeout=240)
+        crashlib.arm(None)
+        svc.close()
+        assert got.ok and got.failovers >= 1
+        assert got.value["digest"] == want.value["digest"]
+        assert got.value["chunk_digests"] == want.value["chunk_digests"]
 
 
 # ----------------------------------------------------- swarmtrace continuity
@@ -351,7 +431,13 @@ class TestRecovery:
                                          journal_dir=str(tmp_path),
                                          max_worker_restarts=0,
                                          supervise_poll_s=0.02))
-        crashlib.arm(CrashPlan("serve", 2, "raise"))
+        # round 3 on the PIPELINED schedule: round 1 dispatches the
+        # rollout's chunk 1 (pending), round 2 runs the assign while
+        # chunk 1 resolves + checkpoints, round 3 re-picks the rollout
+        # — the kill lands with chunk 1 durable and chunk 2 in flight
+        # (the same "one chunk survives" shape the old round-2 kill
+        # produced on the sequential schedule)
+        crashlib.arm(CrashPlan("serve", 3, "raise"))
         svc.submit("rollout", ROLL_FAULTED, tenant="a",
                    request_id="roll")
         svc.submit("assign", {"n": 10, "seed": 4}, tenant="b",
@@ -582,6 +668,30 @@ class TestMultiWorker:
         # bystander work still completes on the (respawned) fleet
         assert svc.submit("assign", {"n": 8, "seed": 2},
                           tenant="good").result(timeout=120).ok
+        assert svc.alive
+        svc.close()
+
+    def test_poison_bound_holds_under_pipelined_load(self):
+        """The pipelined poison corner (PR-11 review finding): at
+        max_batch=1 with other work always in flight every pick is
+        solo, and a dead worker leaves TWO rounds' orphans — without
+        quarantine isolation no solo kill could ever be attributed
+        unambiguously and the poison request would ping-pong workers
+        into the circuit breaker. Suspect rounds never overlap another
+        round, so the bound still trips and the bystanders complete."""
+        from aclswarm_tpu.resilience import InjectedCrash
+
+        svc = SwarmService(_mw_config(max_worker_exclusions=2,
+                                      max_worker_restarts=12))
+        svc.register("poison", lambda p: (_ for _ in ()).throw(
+            InjectedCrash("poison")))
+        rolls = [svc.submit("rollout", dict(MW_ROLL, seed=80 + i),
+                            tenant=f"t{i % 2}") for i in range(3)]
+        rp = svc.submit("poison", {}, tenant="evil").result(timeout=240)
+        assert rp.status == FAILED and rp.error.code == "poisoned"
+        for t in rolls:
+            assert t.result(timeout=240).ok
+        assert svc.stats["poisoned"] == 1
         assert svc.alive
         svc.close()
 

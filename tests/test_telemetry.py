@@ -50,7 +50,38 @@ class TestRegistry:
         row = h.to_row()
         assert row["count"] == 4 and row["sum"] == 10.0
         assert row["min"] == 1.0 and row["max"] == 4.0
-        assert row["p50"] == 2.0 and row["p99"] == 4.0
+        # interpolated order statistics (numpy 'linear'): the even-count
+        # median is the midpoint, and p99 sits just under the max
+        assert row["p50"] == 2.5
+        assert 3.9 < row["p99"] < 4.0
+
+    def test_small_count_tail_quantiles_not_aliased(self):
+        """PR-11 satellite: nearest-rank collapsed p95 and p99 onto the
+        same order statistic at small counts — the committed latency
+        breakdown reported p95_s == p99_s for EVERY stage at count=15.
+        Interpolation keeps the tail ordered and distinct whenever the
+        top samples differ, and agrees with numpy's default method."""
+        import numpy as np
+
+        reg = MetricsRegistry()
+        h = reg.histogram("stage_s")
+        vals = [float(v) for v in range(1, 16)]      # n=15, distinct
+        for v in vals:
+            h.observe(v)
+        pct = h.percentiles()
+        assert pct["p50"] == np.percentile(vals, 50)
+        assert pct["p95"] == pytest.approx(np.percentile(vals, 95))
+        assert pct["p99"] == pytest.approx(np.percentile(vals, 99))
+        # the tail is ordered and NOT aliased
+        assert pct["p50"] < pct["p95"] < pct["p99"] <= max(vals)
+        # degenerate cases stay sane: one sample, identical samples
+        h1 = reg.histogram("one_s")
+        h1.observe(7.0)
+        assert h1.percentiles() == {"p50": 7.0, "p95": 7.0, "p99": 7.0}
+        hsame = reg.histogram("same_s")
+        for _ in range(15):
+            hsame.observe(3.0)
+        assert set(hsame.percentiles().values()) == {3.0}
 
     def test_get_or_create_is_keyed_by_name_kind_labels(self):
         reg = MetricsRegistry()

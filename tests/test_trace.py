@@ -322,6 +322,37 @@ class TestBenchTrend:
         assert bench_trend.main(["--dir", str(tmp_path)]) == 1
         assert bench_trend.main(["--dir", str(tmp_path), "--soft"]) == 0
 
+    def test_serve_stage_rows_matched_by_stage_n_backend(self, tmp_path):
+        """PR-11 satellite: serve_stage rows must trend per
+        (name, stage, n, backend), never name-alone — a regenerated
+        breakdown writing pack after unpack would otherwise compare
+        the two stages across rounds (fake deltas both ways)."""
+        import bench_trend
+
+        def stage_row(stage, value):
+            return {"name": "serve_stage", "stage": stage, "n": 5,
+                    "backend": "cpu", "value": value, "unit": "s"}
+
+        # same-stage improvement + cross-stage magnitude gap: keyed by
+        # name alone, round 2's pack (0.001) vs round 1's unpack (0.9)
+        # would read as a 99.9% swing
+        _write_round(tmp_path, 1, stage_row("unpack", 0.9))
+        _write_round(tmp_path, 2, stage_row("pack", 0.001))
+        lines, reg = bench_trend.trend(tmp_path, 0.10)
+        assert reg == 0                    # distinct series: no delta
+        assert any("stage=pack" in ln for ln in lines)
+        assert any("stage=unpack" in ln for ln in lines)
+        # a REAL same-stage regression still gates
+        _write_round(tmp_path, 3, stage_row("pack", 0.5))
+        lines, reg = bench_trend.trend(tmp_path, 0.10)
+        assert reg == 1
+        # discriminator-free rows keep their bare-name series
+        assert bench_trend.series_key(
+            {"metric": "roll_hz", "value": 1.0}) == "roll_hz"
+        assert bench_trend.series_key(
+            stage_row("pack", 1.0)) == "serve_stage [stage=pack, " \
+                                       "n=5, backend=cpu]"
+
     def test_error_rounds_incomparable_and_latency_direction(
             self, tmp_path):
         import bench_trend
