@@ -64,7 +64,7 @@ __all__ = [
     "SINKHORN_MARGINAL_TOL", "BOUNDS_MARGIN",
     "perm_violated", "adjacency_asymmetric", "alive_mask_stale",
     "dead_rows_active", "dead_rows_moved", "nonfinite_state",
-    "out_of_bounds", "sinkhorn_marginals_violated",
+    "nonfinite_points", "out_of_bounds", "sinkhorn_marginals_violated",
     "admm_residual_violated",
 ]
 
@@ -132,6 +132,13 @@ CONTRACTS: tuple[Contract, ...] = (
              "ADMM gain iteration drove its residual down (converged "
              "by threshold, or net decrease over the budget)",
              "gains.admm solve"),
+    # recorded between mask_consistency and the assignment contracts in
+    # `engine.step` (the scenario-effective formation is computed before
+    # the auction consumes it)
+    Contract("scen_points", 10,
+             "scenario-effective formation points (sequence tables + "
+             "goal drift) are finite",
+             "engine.step scenario timeline"),
 )
 
 CODES = {c.id: c.code for c in CONTRACTS}
@@ -236,6 +243,13 @@ def dead_rows_moved(q_new: jnp.ndarray, q_prev: jnp.ndarray,
     contract; a rejoined vehicle is alive and exempt by definition)."""
     moved = jnp.any(q_new != q_prev, axis=-1)
     return jnp.any(~alive & moved)
+
+
+def nonfinite_points(pts: jnp.ndarray) -> jnp.ndarray:
+    """Any non-finite scenario-effective formation point — a corrupted
+    sequence table or a drift that overflowed would otherwise poison
+    alignment, assignment, and control in one step."""
+    return jnp.any(~jnp.isfinite(pts))
 
 
 def nonfinite_state(swarm, goal) -> jnp.ndarray:

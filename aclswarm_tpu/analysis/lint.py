@@ -117,10 +117,12 @@ _NONDET_PREFIXES = ("numpy.random.", "random.", "secrets.", "uuid.")
 
 _ARRAY_CTORS = {"jax.numpy.asarray", "jax.numpy.array"}
 
-# JC006: the modules where fault masking is load-bearing. Fixture /
-# out-of-tree files opt in with a `# jaxcheck: fault-aware-file` comment.
+# JC006: the modules where fault/scenario masking is load-bearing.
+# Fixture / out-of-tree files opt in with a `# jaxcheck:
+# fault-aware-file` comment.
 _JC006_MODULE_PREFIXES = ("aclswarm_tpu.sim", "aclswarm_tpu.assignment",
-                          "aclswarm_tpu.control", "aclswarm_tpu.faults")
+                          "aclswarm_tpu.control", "aclswarm_tpu.faults",
+                          "aclswarm_tpu.scenarios")
 # reductions that silently fold dead/masked rows into their result
 _JC006_REDUCTIONS = {
     "jax.numpy." + r for r in ("sum", "mean", "min", "max",

@@ -189,23 +189,33 @@ def _faults(gp: GridPoint, seed: int = 0):
         link_loss=0.1)
 
 
+def _scenario(gp: GridPoint, seed: int = 0):
+    """A kitchen-sink registry draw: every axis scripted, so the audited
+    scenario program is the fully-general one (any other scenario —
+    including `no_scenario` — has the same pytree structure and
+    therefore the same lowered HLO; scenarios are data)."""
+    from aclswarm_tpu.scenarios import sample
+    return sample("kitchen_sink", seed, gp.n, horizon=_TICKS)
+
+
 def _sim_state(gp: GridPoint, seed: int = 0, checks: bool = False,
-               telemetry: bool = False):
+               telemetry: bool = False, scen: bool = False):
     from aclswarm_tpu import sim
     return sim.init_state(_scatter(gp.n, seed),
                           localization=(gp.localization == "flooded"),
                           faults=_faults(gp, seed), checks=checks,
-                          telemetry=telemetry)
+                          telemetry=telemetry,
+                          scenario=_scenario(gp, seed) if scen else None)
 
 
 _TICKS = 4
 
 
 def _build_rollout(gp: GridPoint, check: bool = False,
-                   tel: bool = False):
+                   tel: bool = False, scen: bool = False):
     from aclswarm_tpu.core.types import ControlGains
-    args = (_sim_state(gp, checks=check, telemetry=tel), _formation(gp.n),
-            ControlGains(), _sparams())
+    args = (_sim_state(gp, checks=check, telemetry=tel, scen=scen),
+            _formation(gp.n), ControlGains(), _sparams())
     cfg = _sim_cfg(gp)
     if check:
         cfg = cfg.replace(check_mode="on")
@@ -215,12 +225,13 @@ def _build_rollout(gp: GridPoint, check: bool = False,
 
 
 def _build_batched_rollout(gp: GridPoint, check: bool = False,
-                           tel: bool = False):
+                           tel: bool = False, scen: bool = False):
     import jax
     import jax.numpy as jnp
 
     from aclswarm_tpu.core.types import ControlGains
-    states = [_sim_state(gp, seed=b, checks=check, telemetry=tel)
+    states = [_sim_state(gp, seed=b, checks=check, telemetry=tel,
+                         scen=scen)
               for b in range(gp.B)]
     forms = [_formation(gp.n) for _ in range(gp.B)]
     stack = lambda *xs: jnp.stack(xs)                      # noqa: E731
@@ -236,11 +247,12 @@ def _build_batched_rollout(gp: GridPoint, check: bool = False,
 
 
 def _build_rollout_summary(gp: GridPoint, check: bool = False,
-                           tel: bool = False):
+                           tel: bool = False, scen: bool = False):
     import jax.numpy as jnp
 
     from aclswarm_tpu.sim import summary
-    args, statics = _build_batched_rollout(gp, check=check, tel=tel)
+    args, statics = _build_batched_rollout(gp, check=check, tel=tel,
+                                           scen=scen)
     carry = summary.init_carry(gp.n, window=3, dtype=jnp.float32,
                                batch=gp.B)
     statics.update(window=3, pose_every=0)
@@ -333,7 +345,7 @@ def _build_planner_tick(gp: GridPoint):
 _STAGING_CAP = 4
 
 
-def _serve_row(gp: GridPoint):
+def _serve_row(gp: GridPoint, scen: bool = False):
     import jax.numpy as jnp
 
     from aclswarm_tpu import sim
@@ -341,23 +353,24 @@ def _serve_row(gp: GridPoint):
 
     state = sim.init_state(
         _scatter(gp.n),
-        faults=faultlib.no_faults(gp.n, dtype=jnp.float32))
+        faults=faultlib.no_faults(gp.n, dtype=jnp.float32),
+        scenario=_scenario(gp) if scen else None)
     return state, _formation(gp.n)
 
 
-def _staging_store(gp: GridPoint):
+def _staging_store(gp: GridPoint, scen: bool = False):
     import jax
     import jax.numpy as jnp
 
     return jax.tree.map(
         lambda r: jnp.zeros((_STAGING_CAP,) + r.shape, r.dtype),
-        _serve_row(gp))
+        _serve_row(gp, scen=scen))
 
 
-def _build_staging_write(gp: GridPoint):
+def _build_staging_write(gp: GridPoint, scen: bool = False):
     import jax.numpy as jnp
 
-    return (_staging_store(gp), _serve_row(gp),
+    return (_staging_store(gp, scen=scen), _serve_row(gp, scen=scen),
             jnp.asarray(1, jnp.int32)), {}
 
 
@@ -393,13 +406,16 @@ def _build_staging_unpack(gp: GridPoint):
     return (q_ticks, q_final), {}
 
 
-def _build_staging_init(gp: GridPoint):
+def _build_staging_init(gp: GridPoint, scen: bool = False):
     import jax.numpy as jnp
 
     from aclswarm_tpu.faults import schedule as faultlib
 
-    return (jnp.asarray(_scatter(gp.n), jnp.float32),
-            faultlib.no_faults(gp.n, dtype=jnp.float32)), {}
+    args = (jnp.asarray(_scatter(gp.n), jnp.float32),
+            faultlib.no_faults(gp.n, dtype=jnp.float32))
+    if scen:
+        args = args + (_scenario(gp),)
+    return args, {}
 
 
 def _install_default_registry() -> None:
@@ -458,6 +474,35 @@ def _install_default_registry() -> None:
     register_entry("serve.staging.init_row",
                    serve_staging.jitted_entry("init_row"),
                    build=_build_staging_init)
+    # scenario-carrying variants (docs/SCENARIOS.md): the scenario-ful
+    # programs — rollouts whose SimState rides a Scenario timeline, and
+    # the staging ops over scenario-carrying serve rows — must be
+    # transfer-free, cache-stable, and f64-clean like every other entry
+    # point. Baseline-participating: these are ADDITIONS to the
+    # committed zero-cost capture (the pre-scenario digests are
+    # unchanged — scenario=None lowers to the identical program, the
+    # zero-cost-off claim).
+    register_entry("sim.engine.rollout[scenario]", engine.rollout,
+                   static_argnames=("n_ticks", "cfg"),
+                   build=partial(_build_rollout, scen=True),
+                   axes=("n", "solver", "faults", "localization"))
+    register_entry("sim.engine.batched_rollout[scenario]",
+                   engine.batched_rollout,
+                   static_argnames=("n_ticks", "cfg"),
+                   build=partial(_build_batched_rollout, scen=True),
+                   axes=("n", "B", "solver", "faults", "localization"))
+    register_entry("sim.summary.batched_rollout_summary[scenario]",
+                   summary.batched_rollout_summary,
+                   static_argnames=("cfg", "n_ticks", "window",
+                                    "pose_every"),
+                   build=partial(_build_rollout_summary, scen=True),
+                   axes=("n", "B", "solver", "faults", "localization"))
+    register_entry("serve.staging.write_row[scenario]",
+                   serve_staging.jitted_entry("write_row"),
+                   build=partial(_build_staging_write, scen=True))
+    register_entry("serve.staging.init_row[scenario]",
+                   serve_staging.jitted_entry("init_row"),
+                   build=partial(_build_staging_init, scen=True))
     # swarmcheck-ON variants: the sanitized programs themselves must be
     # transfer-free, cache-stable, and f64-clean — the "no host syncs in
     # the happy path" half of the sanitizer contract. Excluded from the
@@ -473,6 +518,14 @@ def _install_default_registry() -> None:
                                     "pose_every"),
                    build=partial(_build_rollout_summary, check=True),
                    axes=("n", "B", "solver", "faults", "localization"),
+                   baseline=False)
+    # the scenario fuzzer's happy path: scenario program + sanitizer ON
+    # must itself stay transfer-free/cache-stable/f64-clean (excluded
+    # from the zero-cost baseline like every [checked] variant)
+    register_entry("sim.engine.rollout[scenario,checked]", engine.rollout,
+                   static_argnames=("n_ticks", "cfg"),
+                   build=partial(_build_rollout, check=True, scen=True),
+                   axes=("n", "solver", "faults", "localization"),
                    baseline=False)
     # swarmscope-ON variants (docs/OBSERVABILITY.md): the instrumented
     # programs must also be transfer-free, cache-stable, and f64-clean —

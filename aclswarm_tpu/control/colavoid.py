@@ -59,23 +59,30 @@ def wrap_to_pi(a: jnp.ndarray) -> jnp.ndarray:
 
 
 def _one_agent(qij_xy: jnp.ndarray, active: jnp.ndarray, vel: jnp.ndarray,
-               params: SafetyParams) -> tuple[jnp.ndarray, jnp.ndarray]:
+               params: SafetyParams, r_keep=None
+               ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Avoidance for one agent against up-to-(n-1) active neighbors.
 
     Args:
-      qij_xy: (n, 2) planar relative positions of the other vehicles.
+      qij_xy: (n, 2) planar relative positions of the other vehicles
+        (with scenario obstacles, obstacle columns are appended — the
+        kernel is column-agnostic: a column is just a sector caster).
       active: (n,) bool, neighbor-within-threshold mask (self excluded).
       vel: (3,) desired velocity goal.
+      r_keep: optional (n,) per-column keep-out radii (scenario
+        obstacles carry their own); None = the uniform
+        ``params.r_keep_out`` — the historical trace, bit for bit.
 
     Returns:
       (safe velocity (3,), modified flag) — `modified` mirrors
       `VelocityGoal::modified` feeding `SafetyStatus.collision_avoidance_active`
       (`safety.cpp:277-279,503`), the gridlock signal.
     """
+    rk = params.r_keep_out if r_keep is None else r_keep
     d = jnp.linalg.norm(qij_xy, axis=-1)
     theta = jnp.arctan2(qij_xy[:, 1], qij_xy[:, 0])
-    # half-angle; d <= r_keep_out => full half-plane sector (asin(1) = pi/2)
-    ratio = jnp.minimum(1.0, params.r_keep_out / jnp.maximum(d, 1e-12))
+    # half-angle; d <= keep-out => full half-plane sector (asin(1) = pi/2)
+    ratio = jnp.minimum(1.0, rk / jnp.maximum(d, 1e-12))
     alpha = jnp.abs(jnp.arcsin(ratio))
 
     psi = jnp.arctan2(vel[1], vel[0])
@@ -140,7 +147,7 @@ def _one_agent(qij_xy: jnp.ndarray, active: jnp.ndarray, vel: jnp.ndarray,
     # opt-in keep-out escape (`SafetyParams.keepout_repulse_vel`): inside
     # a violation, separate radially from the deepest violator instead of
     # running the degenerate half-plane VO (see the field's docstring)
-    viol = active & (d < params.r_keep_out)
+    viol = active & (d < rk)
     any_viol = jnp.any(viol) & (params.keepout_repulse_vel > 0.0)
     j = jnp.argmin(jnp.where(viol, d, jnp.inf))
     away = -qij_xy[j] / jnp.maximum(d[j], 1e-9)
@@ -158,7 +165,8 @@ def _one_agent(qij_xy: jnp.ndarray, active: jnp.ndarray, vel: jnp.ndarray,
 def collision_avoidance(q: jnp.ndarray, vel_des: jnp.ndarray,
                         params: SafetyParams,
                         max_neighbors: int | None = None,
-                        neighbor_mask: jnp.ndarray | None = None
+                        neighbor_mask: jnp.ndarray | None = None,
+                        obstacles: tuple | None = None
                         ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Batched velocity-obstacle shim for the whole swarm.
 
@@ -182,6 +190,14 @@ def collision_avoidance(q: jnp.ndarray, vel_des: jnp.ndarray,
         no sector for anyone (the fault model's dead/frozen vehicles,
         `aclswarm_tpu.faults`; their own row's output is discarded by the
         engine's freeze). An all-true mask is bit-identical to None.
+      obstacles: optional ``((K, 3) positions, (K,) radii, (K,) active)``
+        — scenario cylinder obstacles (`aclswarm_tpu.scenarios`). Each
+        active obstacle casts a sector with ITS radius as the keep-out,
+        activating inside the same warning shell the vehicle pairs use
+        (``radius + (d_avoid_thresh - r_keep_out)``); obstacle columns
+        are never pruned by ``max_neighbors``. An all-inactive mask is
+        bit-identical to None (every obstacle column is masked out of
+        sectors, edges, and violations alike).
 
     Returns:
       ((n, 3) safe velocities, (n,) bool modified/avoidance-active flags).
@@ -211,10 +227,28 @@ def collision_avoidance(q: jnp.ndarray, vel_des: jnp.ndarray,
         # activation itself was a monotone function of dxy
         d_masked = jnp.where(active, dxy, jnp.inf)
         idx = _smallest_k_indices(d_masked, k)                # (n, k)
-        qij_k = jnp.take_along_axis(qij[..., :2], idx[:, :, None], axis=1)
-        active_k = jnp.take_along_axis(active, idx, axis=1)   # (n, k)
-        return jax.vmap(_one_agent, in_axes=(0, 0, 0, None))(
-            qij_k, active_k, vel_des, params)
+        cols_xy = jnp.take_along_axis(qij[..., :2], idx[:, :, None],
+                                      axis=1)
+        cols_act = jnp.take_along_axis(active, idx, axis=1)   # (n, k)
+    else:
+        cols_xy, cols_act = qij[..., :2], active
 
-    return jax.vmap(_one_agent, in_axes=(0, 0, 0, None))(
-        qij[..., :2], active, vel_des, params)
+    if obstacles is None:
+        return jax.vmap(_one_agent, in_axes=(0, 0, 0, None))(
+            cols_xy, cols_act, vel_des, params)
+
+    # scenario obstacle columns appended after the (possibly pruned)
+    # vehicle columns: same sector kernel, per-column keep-out radii
+    obs_pos, obs_r, obs_mask = obstacles
+    obs_r = obs_r.astype(q.dtype)
+    oij = obs_pos[None, :, :2].astype(q.dtype) - q[:, None, :2]  # (n,K,2)
+    odxy = jnp.linalg.norm(oij, axis=-1)
+    shell = params.d_avoid_thresh - params.r_keep_out
+    oact = (odxy <= obs_r[None, :] + shell) & obs_mask[None, :]
+    m = cols_xy.shape[1]
+    rk = jnp.concatenate(
+        [jnp.full((m,), params.r_keep_out, q.dtype), obs_r])
+    all_xy = jnp.concatenate([cols_xy, oij], axis=1)
+    all_act = jnp.concatenate([cols_act, oact], axis=1)
+    return jax.vmap(_one_agent, in_axes=(0, 0, 0, None, None))(
+        all_xy, all_act, vel_des, params, rk)

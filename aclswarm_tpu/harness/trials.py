@@ -107,6 +107,24 @@ class TrialConfig:
     profile_dir: Optional[str] = None
     profile_chunk: int = 1
     colavoid_neighbors: Optional[int] = None
+    # scenario timeline (`aclswarm_tpu.scenarios`, docs/SCENARIOS.md):
+    # a registry family name attaches a per-trial seeded scenario —
+    # obstacles, wind/noise, formation sequences, byzantine bidders,
+    # goal drift — to every trial (trial t draws seed
+    # `scenario_seed + t`, default the trial's own seed). The timeline
+    # is keyed on the engine tick, which this driver RE-PHASES at each
+    # formation dispatch — scenario clocks restart with the formation,
+    # so the event horizon must fit a PER-FORMATION convergence window,
+    # not the whole trial budget: `scenario_horizon` (ticks) defaults
+    # to min(trial budget, 2400) = 24 s, inside which every registry
+    # family's event fractions land during a typical formation phase
+    # (a horizon scaled to a 600 s trial would schedule every event
+    # tens of thousands of ticks past any phase — scenario-free
+    # results sold as scenario runs). None = the scenario-free engine
+    # (bit-identical program).
+    scenario: Optional[str] = None
+    scenario_seed: Optional[int] = None
+    scenario_horizon: Optional[int] = None
     chunk_ticks: int = 50           # FSM action latency bound (0.5 s)
     # initial-condition sampling (trial.sh:7-9: 20 x 20 area, r=0.75)
     init_area_w: float = 20.0
@@ -292,6 +310,32 @@ def _trial_cgains(cfg: TrialConfig) -> ControlGains:
         cfg, "e_xy_thr", "e_z_thr", "kd", "K1_xy", "K2_xy", "K1_z", "K2_z"))
 
 
+# default per-formation scenario horizon in ticks (24 s at the 100 Hz
+# tick): the driver re-phases the engine tick at every dispatch, so
+# family event fractions must land inside a formation phase — see the
+# `TrialConfig.scenario` comment
+_SCENARIO_HORIZON = 2400
+
+
+def _trial_scenario(cfg: TrialConfig, trial_seed: int, trial_idx: int,
+                    n: int, trial_timeout: float):
+    """Per-trial scenario draw (None = the scenario-free engine): the
+    registry family named by ``cfg.scenario``, seeded per trial, with
+    the event horizon sized to a per-formation convergence window
+    (the engine tick re-phases at each dispatch)."""
+    if cfg.scenario is None:
+        return None
+    from aclswarm_tpu.scenarios import registry as scenreg
+    seed = (trial_seed if cfg.scenario_seed is None
+            else cfg.scenario_seed + trial_idx)
+    if cfg.scenario_horizon is not None:
+        horizon = int(cfg.scenario_horizon)
+    else:
+        budget = max(1, int(trial_timeout / cfg.control_dt))
+        horizon = min(budget, _SCENARIO_HORIZON)
+    return scenreg.sample(cfg.scenario, seed, n, horizon=horizon)
+
+
 def _engine_kw(cfg: TrialConfig) -> dict:
     """The TrialConfig -> SimConfig mirror (minus `assignment`)."""
     return dict(control_dt=cfg.control_dt, assign_every=cfg.assign_every,
@@ -368,7 +412,9 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
     state = sim.init_state(q0, flying=False,
                            localization=cfg.localization == "flooded",
                            checks=cfg.check_mode == "on",
-                           telemetry=tel_on)
+                           telemetry=tel_on,
+                           scenario=_trial_scenario(cfg, seed, trial_idx,
+                                                    n, trial_timeout))
     fsm = TrialFSM(n, len(specs), takeoff_alt=sparams.takeoff_alt,
                    dt=cfg.control_dt, trial_timeout=trial_timeout)
     cgains = _trial_cgains(cfg)
@@ -648,8 +694,10 @@ def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
     checks = cfg.check_mode == "on"
     tel_on = cfg.telemetry == "on"
     states = [sim.init_state(q0, flying=False, localization=flooded,
-                             checks=checks, telemetry=tel_on)
-              for q0 in q0s]
+                             checks=checks, telemetry=tel_on,
+                             scenario=_trial_scenario(
+                                 cfg, cfg.seed + t, t, n, trial_timeout))
+              for t, q0 in zip(trial_indices, q0s)]
     bstate = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
     # pre-dispatch: auctions off per trial (the batch shares ONE compiled
     # config, so the serial driver's assignment='none' hover config

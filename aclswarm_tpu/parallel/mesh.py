@@ -85,7 +85,8 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def sim_state_sharding(mesh: Mesh, localization: bool = False,
                        faults: bool = False,
                        checks: bool = False,
-                       telemetry: bool = False) -> sim.SimState:
+                       telemetry: bool = False,
+                       scenario: bool = False) -> sim.SimState:
     """Sharding pytree for `sim.SimState`: per-agent leaves row-sharded.
 
     ``localization=True`` matches states built with
@@ -109,9 +110,18 @@ def sim_state_sharding(mesh: Mesh, localization: bool = False,
     ``init_state(..., telemetry=True)``: the swarmscope counter carry
     (`telemetry.device.ChunkTelemetry`) is a handful of scalars,
     replicated exactly like the swarmcheck carry (every shard
-    accumulates the identical counters)."""
+    accumulates the identical counters).
+
+    ``scenario=True`` matches states carrying a `Scenario`
+    (`aclswarm_tpu.scenarios`): the per-vehicle byzantine mask shards
+    on the vehicle axis like the fault timelines; everything else —
+    obstacle tracks (K slots), disturbance scalars, sequence point
+    tables (every agent's alignment consumes all points, exactly why
+    `Formation.points` replicates), drift/cadence scalars, and the
+    per-trial key — replicates."""
     from aclswarm_tpu.analysis.invariants import InvariantState
     from aclswarm_tpu.faults import FaultSchedule
+    from aclswarm_tpu.scenarios.timeline import Scenario
     from aclswarm_tpu.telemetry.device import ChunkTelemetry
 
     row = row_sharding(mesh)
@@ -119,6 +129,12 @@ def sim_state_sharding(mesh: Mesh, localization: bool = False,
     loc = sim.EstimateTable(est=row, age=row) if localization else None
     fsched = FaultSchedule(drop_tick=row, rejoin_tick=row,
                            link_loss=row, key=rep) if faults else None
+    scen = Scenario(
+        obs_center=rep, obs_vel=rep, obs_radius=rep, obs_appear=rep,
+        obs_vanish=rep, wind_vel=rep, gust_std=rep, wind_tick=rep,
+        noise_std=rep, noise_tick=rep, seq_points=rep, seq_tick=rep,
+        byz_mask=row, byz_std=rep, byz_tick=rep, drift_vel=rep,
+        drift_tick=rep, rematch_every=rep, key=rep) if scenario else None
     return sim.SimState(
         swarm=SwarmState(q=row, vel=row),
         goal=control.TrajGoal(pos=row, vel=row, yaw=row, dyaw=row),
@@ -126,6 +142,7 @@ def sim_state_sharding(mesh: Mesh, localization: bool = False,
         flight=sim.FlightState(mode=row, ticks_in_mode=row,
                                initial_alt=row, takeoff_alt=row),
         loc=loc, first_auction=rep, assign_enabled=rep, faults=fsched,
+        scenario=scen,
         inv=InvariantState(code=rep, tick=rep) if checks else None,
         tel=ChunkTelemetry(auctions=rep, assign_rounds=rep, reassigns=rep,
                            ca_ticks=rep, flood_stale_max=rep,
@@ -148,7 +165,8 @@ def shard_problem(state: sim.SimState, formation, mesh: Mesh):
     st_sh = sim_state_sharding(mesh, localization=state.loc is not None,
                                faults=state.faults is not None,
                                checks=state.inv is not None,
-                               telemetry=state.tel is not None)
+                               telemetry=state.tel is not None,
+                               scenario=state.scenario is not None)
     f_sh = formation_sharding(mesh)
     return (jax.device_put(state, st_sh), jax.device_put(formation, f_sh),
             st_sh, f_sh)

@@ -1,0 +1,31 @@
+"""swarmscenario — composable scenario compiler (ROADMAP item 5).
+
+FaultSchedule generalized: scripted worlds are pytree DATA riding in
+`SimState`, heterogeneous per trial inside one compiled vmapped scan.
+Five independent timeline axes (pop-up/moving obstacles, wind + sensor
+noise, tick-scheduled formation sequences, byzantine bidders, goal
+drift with a re-matching cadence) compose freely, normalize to
+`no_scenario` (bit-identical to ``scenario=None``), and draw from a
+declarative family registry that the fuzzer sweeps with `swarmcheck`
+invariants as the oracle and the serve layer admits as a first-class
+rollout axis. See docs/SCENARIOS.md.
+"""
+from aclswarm_tpu.scenarios.registry import (AXES, FAMILIES,
+                                             ScenarioFamily, compose,
+                                             sample, validate)
+from aclswarm_tpu.scenarios.timeline import (DEFAULT_MAX_OBSTACLES,
+                                             DEFAULT_MAX_STAGES, NEVER,
+                                             Scenario, est_noise_at,
+                                             formation_points_at,
+                                             no_scenario, obstacles_at,
+                                             rematch_ok_at,
+                                             reported_positions,
+                                             scenario_event_at, stage_at,
+                                             wind_at)
+
+__all__ = ["Scenario", "no_scenario", "NEVER", "DEFAULT_MAX_OBSTACLES",
+           "DEFAULT_MAX_STAGES", "obstacles_at", "stage_at",
+           "formation_points_at", "reported_positions", "wind_at",
+           "est_noise_at", "rematch_ok_at", "scenario_event_at",
+           "AXES", "FAMILIES", "ScenarioFamily", "compose", "sample",
+           "validate"]

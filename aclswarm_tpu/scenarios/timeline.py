@@ -1,0 +1,281 @@
+"""Scenario timelines as data: the FaultSchedule pattern, generalized.
+
+`aclswarm_tpu.faults` proved the design rule this package is built on: a
+scripted world is a *pytree of arrays*, never Python control flow. Every
+axis below is evaluated as a pure `where`-mask function of the per-trial
+``state.tick``, so a `batched_rollout` batch in which every trial flies
+a DIFFERENT scenario still compiles to one program and runs under `vmap`
+with the shared-tick decimation intact — exactly how heterogeneous fault
+scripts already ride the scan (`faults/schedule.py`).
+
+The composable axes (each independent; compose by filling the fields):
+
+- **(a) pop-up / moving obstacles** — time-parameterized cylinder
+  tracks. An active obstacle casts a planar velocity-obstacle sector
+  with its own keep-out radius, fed into the same avoidance kernel the
+  vehicles use (`control.colavoid` grew per-column radii); tracks are
+  ``center + vel * t`` with appear/vanish tick windows.
+- **(b) wind + sensor noise** — a steady wind field plus per-tick,
+  per-vehicle gusts displace the integrated positions (applied after
+  the dynamics, BEFORE the fault freeze, so a dead vehicle stays
+  frozen even in wind); sensor noise perturbs the flooded estimate
+  tables AS CONSUMED (`localization.noised_view` — a measurement-noise
+  model: the carried table stays clean, so every consumed estimate
+  carries ~one draw of error regardless of trial length, and a
+  never-refreshed stale entry cannot random-walk).
+- **(c) formation sequences** — tick-indexed formation point tables
+  (morph / split / merge as successive stages). While a stage is
+  active the engine's *effective* formation replaces points and the
+  derived desired-distance matrices; assignment and control both
+  follow (the time-varying generalization of a formation dispatch).
+- **(d) byzantine bidders** — masked agents lie about their position
+  to every assignment solver (per-tick seeded offsets): the
+  centralized auction/Sinkhorn see corrupted cost rows, CBAA agents
+  bid on corrupted self-positions. Honest consensus extraction is
+  preserved — the solvers still emit permutations, which `swarmcheck`'s
+  ``assign_perm`` contract oracles.
+- **(e) goal drift + re-matching cadence** — formation points translate
+  at ``drift_vel`` from ``drift_tick`` (streaming assignment under
+  drift, arXiv:1904.04318) while ``rematch_every`` throttles how often
+  a scheduled auction's result is *accepted* — the drifting-goals
+  re-matching cadence knob.
+
+Zero-cost contracts (both pinned in tests/test_scenarios.py):
+
+- ``scenario=None`` keeps the engine structurally unchanged — every
+  scenario site in `sim.engine.step` is Python-gated on it, so the
+  lowered HLO of the historical entry points is bit-identical
+  (`analysis.trace_audit.verify_zero_cost_off` — the committed
+  baseline's pre-scenario digests are unchanged; the `[scenario]`
+  variants are additions).
+- ``no_scenario(n)`` (all axes inert) is BIT-IDENTICAL to
+  ``scenario=None`` in every output — serial, batched, and resumed
+  from a checkpoint — because every axis application is a `where`
+  against the baseline value. That is what lets scenario-free and
+  scenario-ful serve requests share one compiled program
+  (`serve.service`, the `no_faults` normalization extended).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+# np scalar, not jnp: a jax array at import time would initialize the
+# XLA backend (same rationale as `faults.schedule.NEVER`)
+NEVER = np.int32(2**31 - 1)
+
+# default axis capacities: the STATIC shape caps every Scenario of a
+# given n shares (fixed caps keep the pytree structure uniform, so any
+# two scenarios — or a scenario and `no_scenario` — stack into one
+# batch and one serve bucket; unused slots are inert data)
+DEFAULT_MAX_OBSTACLES = 4
+DEFAULT_MAX_STAGES = 2
+
+# per-tick key salts: each axis draws from its own fold of the
+# per-trial key so composing axes never correlates their randomness
+_SALT_BYZ = 1
+_SALT_GUST = 2
+_SALT_NOISE = 3
+
+
+@struct.dataclass
+class Scenario:
+    """One trial's scenario script (all leaves are data; batch by
+    stacking). Inert encodings: tick fields hold `NEVER`, masks are
+    all-False, magnitudes are zero — see `no_scenario`."""
+
+    # (a) obstacles: cylinder tracks pos(t) = center + vel * (t * dt)
+    obs_center: jnp.ndarray   # (K, 3) track origin at tick 0
+    obs_vel: jnp.ndarray      # (K, 3) track velocity (m/s)
+    obs_radius: jnp.ndarray   # (K,) keep-out radius (m)
+    obs_appear: jnp.ndarray   # (K,) int32 pop-up tick; NEVER = inert slot
+    obs_vanish: jnp.ndarray   # (K,) int32 disappear tick; NEVER = stays
+    # (b) disturbances
+    wind_vel: jnp.ndarray     # (3,) steady wind (m/s)
+    gust_std: jnp.ndarray     # () per-tick per-vehicle gust std (m/s)
+    wind_tick: jnp.ndarray    # () int32 wind onset; NEVER = off
+    noise_std: jnp.ndarray    # () flood-estimate noise std (m)
+    noise_tick: jnp.ndarray   # () int32 noise onset; NEVER = off
+    # (c) formation sequence: stage s becomes active at seq_tick[s]
+    seq_points: jnp.ndarray   # (S, n, 3) stage formation point tables
+    seq_tick: jnp.ndarray     # (S,) int32 ascending; NEVER = unused slot
+    # (d) byzantine bidders
+    byz_mask: jnp.ndarray     # (n,) bool dishonest agents
+    byz_std: jnp.ndarray      # () reported-position corruption std (m)
+    byz_tick: jnp.ndarray     # () int32 corruption onset; NEVER = off
+    # (e) goal drift + re-matching cadence
+    drift_vel: jnp.ndarray    # (3,) formation drift velocity (m/s)
+    drift_tick: jnp.ndarray   # () int32 drift onset; NEVER = off
+    rematch_every: jnp.ndarray  # () int32 accepted-auction cadence in
+    #                             ticks (0 = every scheduled auction)
+    key: jnp.ndarray          # (2,) uint32 per-trial seed (raw key data)
+
+    @property
+    def n(self) -> int:
+        return self.byz_mask.shape[0]
+
+    @property
+    def max_obstacles(self) -> int:
+        return self.obs_radius.shape[0]
+
+    @property
+    def max_stages(self) -> int:
+        return self.seq_tick.shape[0]
+
+
+def no_scenario(n: int, max_obstacles: int = DEFAULT_MAX_OBSTACLES,
+                max_stages: int = DEFAULT_MAX_STAGES,
+                dtype=jnp.float32) -> Scenario:
+    """The identity scenario: every axis inert. Bit-identical to
+    ``scenario=None`` through the whole engine (the parity contract)."""
+    K, S = int(max_obstacles), int(max_stages)
+    return Scenario(
+        obs_center=jnp.zeros((K, 3), dtype),
+        obs_vel=jnp.zeros((K, 3), dtype),
+        obs_radius=jnp.zeros((K,), dtype),
+        obs_appear=jnp.full((K,), NEVER, jnp.int32),
+        obs_vanish=jnp.full((K,), NEVER, jnp.int32),
+        wind_vel=jnp.zeros((3,), dtype),
+        gust_std=jnp.zeros((), dtype),
+        wind_tick=jnp.asarray(NEVER, jnp.int32),
+        noise_std=jnp.zeros((), dtype),
+        noise_tick=jnp.asarray(NEVER, jnp.int32),
+        seq_points=jnp.zeros((S, n, 3), dtype),
+        seq_tick=jnp.full((S,), NEVER, jnp.int32),
+        byz_mask=jnp.zeros((n,), bool),
+        byz_std=jnp.zeros((), dtype),
+        byz_tick=jnp.asarray(NEVER, jnp.int32),
+        drift_vel=jnp.zeros((3,), dtype),
+        drift_tick=jnp.asarray(NEVER, jnp.int32),
+        rematch_every=jnp.zeros((), jnp.int32),
+        key=jnp.zeros((2,), jnp.uint32))
+
+
+def key_leaves(seed: int) -> np.ndarray:
+    """Raw threefry key data for ``seed`` — raw uint32 leaves keep the
+    scenario a plain stackable pytree (the `faults.schedule` idiom)."""
+    return np.array([(seed >> 32) & 0xFFFFFFFF, seed & 0xFFFFFFFF],
+                    np.uint32)
+
+
+def _folded(scen: Scenario, tick, salt: int):
+    k = jax.random.fold_in(jax.random.wrap_key_data(scen.key),
+                           jnp.asarray(tick, jnp.int32))
+    return jax.random.fold_in(k, salt)
+
+
+# ---------------------------------------------------------------------------
+# per-tick evaluators (pure functions of data: vmap over batched
+# scenarios AND batched per-trial ticks, like `faults.schedule.alive_at`)
+
+def obstacles_at(scen: Scenario, tick, dt: float
+                 ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """((K, 3) obstacle positions, (K,) active mask) at ``tick``.
+    Positions advance along the track regardless of activity (a crossing
+    obstacle pops up mid-transit); inert slots are masked out."""
+    t = jnp.asarray(tick, jnp.int32)
+    active = (t >= scen.obs_appear) & (t < scen.obs_vanish)
+    dtc = scen.obs_center.dtype
+    pos = scen.obs_center + scen.obs_vel * (t.astype(dtc)
+                                            * jnp.asarray(dt, dtc))
+    return pos, active
+
+
+def stage_at(scen: Scenario, tick) -> jnp.ndarray:
+    """() int32 active formation-sequence stage at ``tick`` (-1 = the
+    dispatched base formation; `NEVER` slots never activate)."""
+    t = jnp.asarray(tick, jnp.int32)
+    # jaxcheck: disable=JC006 — counts scheduled stages, not agents
+    return jnp.sum((scen.seq_tick <= t).astype(jnp.int32)) - 1
+
+
+def formation_points_at(scen: Scenario, base_points: jnp.ndarray, tick,
+                        dt: float) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """((n, 3) effective formation points, () bool changed) at ``tick``:
+    the active sequence stage's table (else the base points) translated
+    by the goal drift. ``changed`` False passes ``base_points`` through
+    bitwise — the parity rule."""
+    t = jnp.asarray(tick, jnp.int32)
+    dtc = base_points.dtype
+    stage = stage_at(scen, t)
+    staged = scen.seq_points[jnp.clip(stage, 0, scen.max_stages - 1)]
+    pts = jnp.where(stage >= 0, staged.astype(dtc), base_points)
+    drift_on = t >= scen.drift_tick
+    # drift time measured from onset, clamped so pre-onset math is benign
+    tf = jnp.maximum(t - scen.drift_tick, 0).astype(dtc) \
+        * jnp.asarray(dt, dtc)
+    pts = jnp.where(drift_on,
+                    pts + scen.drift_vel.astype(dtc)[None, :] * tf, pts)
+    return pts, (stage >= 0) | drift_on
+
+
+def reported_positions(scen: Scenario, q: jnp.ndarray, tick
+                       ) -> jnp.ndarray:
+    """(n, 3) positions as REPORTED to the assignment layer: byzantine
+    agents add a per-tick seeded lie of scale ``byz_std``; honest rows
+    pass through bitwise (the masked bid corruption — every solver's
+    bids derive from these positions)."""
+    t = jnp.asarray(tick, jnp.int32)
+    on = t >= scen.byz_tick
+    lie = scen.byz_std.astype(q.dtype) * jax.random.normal(
+        _folded(scen, t, _SALT_BYZ), q.shape, q.dtype)
+    return jnp.where(on & scen.byz_mask[:, None], q + lie, q)
+
+
+def wind_at(scen: Scenario, tick, dt: float, n: int, dtype
+            ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """((n, 3) per-tick position displacement, () bool active): steady
+    wind plus per-vehicle gusts, integrated over one control tick."""
+    t = jnp.asarray(tick, jnp.int32)
+    on = t >= scen.wind_tick
+    gust = scen.gust_std.astype(dtype) * jax.random.normal(
+        _folded(scen, t, _SALT_GUST), (n, 3), dtype)
+    dq = (scen.wind_vel.astype(dtype)[None, :] + gust) \
+        * jnp.asarray(dt, dtype)
+    return dq, on
+
+
+def est_noise_at(scen: Scenario, tick, n: int, dtype
+                 ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """((n, n, 3) additive estimate noise, () bool active) for the
+    flooded localization tables at ``tick``
+    (`localization.noised_view`'s operand — applied to the consumed
+    view, never the carry). Per-tick seeded: re-running a tick redraws
+    the same noise, so checkpoint resume stays bit-identical."""
+    t = jnp.asarray(tick, jnp.int32)
+    on = (t >= scen.noise_tick) & (scen.noise_std > 0)
+    draw = scen.noise_std.astype(dtype) * jax.random.normal(
+        _folded(scen, t, _SALT_NOISE), (n, n, 3), dtype)
+    return draw, on
+
+
+def rematch_ok_at(scen: Scenario, tick) -> jnp.ndarray:
+    """() bool: may a scheduled auction's result be ACCEPTED this tick?
+    ``rematch_every <= 0`` keeps the engine's own cadence; otherwise
+    acceptance is throttled to ticks on the scenario's re-matching
+    period (the drifting-goals cadence knob — candidates off-cadence
+    are discarded exactly like the engine's other gates)."""
+    t = jnp.asarray(tick, jnp.int32)
+    every = scen.rematch_every
+    return (every <= 0) | (t % jnp.maximum(every, 1) == 0)
+
+
+def scenario_event_at(scen: Scenario, tick) -> jnp.ndarray:
+    """() bool: any scenario axis flips state at ``tick`` — an obstacle
+    appears/vanishes, a sequence stage lands, or the wind / noise /
+    byzantine / drift onset fires. The event that (re)starts the
+    recovery clock in `sim.summary` (the scenario analogue of
+    `faults.schedule.fault_event_at`)."""
+    t = jnp.asarray(tick, jnp.int32)
+
+    def obs_active(tt):
+        return (tt >= scen.obs_appear) & (tt < scen.obs_vanish)
+
+    ev = jnp.any(obs_active(t) != obs_active(t - 1))
+    ev = ev | (stage_at(scen, t) != stage_at(scen, t - 1))
+    for onset in (scen.wind_tick, scen.noise_tick, scen.byz_tick,
+                  scen.drift_tick):
+        ev = ev | (t == onset)
+    return ev

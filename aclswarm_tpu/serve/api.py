@@ -91,7 +91,8 @@ class Request:
     restart, so no monotonic clocks here)."""
 
     kind: str                 # 'rollout' | 'assign' | 'gains' | 'stats'
-    #                           | registered
+    #                           | 'scenario' (a registry-drawn rollout —
+    #                           batches WITH plain rollouts) | registered
     params: dict
     tenant: str = "default"
     request_id: str = ""

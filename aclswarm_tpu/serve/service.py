@@ -80,7 +80,7 @@ from aclswarm_tpu.telemetry import (LifecycleLog, MetricsRegistry,
 from aclswarm_tpu.utils import get_logger
 from aclswarm_tpu.utils.retry import RetryPolicy
 
-BUILTIN_KINDS = ("rollout", "assign", "gains", "stats")
+BUILTIN_KINDS = ("rollout", "assign", "gains", "stats", "scenario")
 CRASH_SITE = "serve"        # maybe_crash site: one boundary per round
 
 # lifecycle events journaled even with cfg.trace=False: the PR-8
@@ -270,6 +270,7 @@ class _RolloutSpec:
     assign_every: int
     seed: int
     faults_spec: Optional[dict]
+    scenario_spec: Optional[dict]
     points: Optional[np.ndarray]
     adjmat: Optional[np.ndarray]
     gains: Optional[np.ndarray]
@@ -306,6 +307,32 @@ def _parse_rollout(params: dict) -> _RolloutSpec:
                               or not set(fspec) <= _FKEYS):
         raise ValueError("rollout 'faults' must be a spec dict with keys "
                          f"from {sorted(_FKEYS)}, got {fspec!r}")
+    sspec = params.get("scenario")
+    if sspec is not None:
+        # scenario requests validate against the registry AT ADMISSION
+        # — an unknown family or out-of-space override is refused at
+        # the door like any other malformed rollout (docs/SCENARIOS.md)
+        _SKEYS = {"family", "seed", "params", "horizon"}
+        if not isinstance(sspec, dict) or "family" not in sspec \
+                or not set(sspec) <= _SKEYS:
+            raise ValueError(
+                "rollout 'scenario' must be a spec dict {'family': "
+                f"<registry name>, 'seed'?, 'params'?, 'horizon'?}}, "
+                f"got {sspec!r}")
+        from aclswarm_tpu.scenarios import registry as scenreg
+        fam = scenreg.validate(str(sspec["family"]), sspec.get("params"))
+        if fam.localization != "truth":
+            # the serving engine runs the 'truth' information model
+            # (no estimate tables in the serve rows): a family whose
+            # axes only bite under flooded localization would run as a
+            # silent no-op — scenario-free results sold as a scenario
+            # run. Refuse at the door; the trials/suite drivers serve
+            # those families (docs/SCENARIOS.md).
+            raise ValueError(
+                f"scenario family {sspec['family']!r} requires the "
+                f"{fam.localization!r} information model; serve "
+                "rollouts run 'truth' localization — drive it through "
+                "harness.trials or benchmarks/scenario_suite.py")
     arr = {k: (np.asarray(params[k]) if k in params else None)
            for k in ("points", "adjmat", "gains")}
     return _RolloutSpec(
@@ -313,13 +340,36 @@ def _parse_rollout(params: dict) -> _RolloutSpec:
         n_chunks=ticks // chunk,
         assignment=str(params.get("assignment", "auction")),
         assign_every=assign_every, seed=int(params.get("seed", 0)),
-        faults_spec=fspec, points=arr["points"], adjmat=arr["adjmat"],
-        gains=arr["gains"])
+        faults_spec=fspec, scenario_spec=sspec, points=arr["points"],
+        adjmat=arr["adjmat"], gains=arr["gains"])
 
 
 def _bucket_from_spec(spec: _RolloutSpec) -> tuple:
     return ("rollout", spec.n, spec.chunk_ticks, spec.assignment,
             spec.assign_every)
+
+
+def _scenario_to_rollout(params: dict) -> dict:
+    """The `scenario` request kind is a rollout drawn from the family
+    registry: flat params carry the rollout sizing keys (n, ticks, ...)
+    plus the scenario draw (family, seed, params, horizon). Normalized
+    here into rollout params with a nested scenario spec, so scenario
+    requests share the rollout state machine — and the rollout BUCKETS:
+    a scenario request batches with plain rollouts of the same shape
+    (the `no_scenario` normalization; docs/SCENARIOS.md)."""
+    if not isinstance(params, dict) or "family" not in params:
+        raise ValueError("scenario params require 'family' (a registry "
+                         "family name) plus the rollout sizing keys "
+                         "('n', 'ticks', ...)")
+    p = dict(params)
+    sspec = {k: p.pop(k) for k in ("family", "params", "horizon")
+             if k in p}
+    if "seed" in p:
+        # ONE seed drives both draws: the scenario script and the
+        # rollout's initial cloud (reproducible from the flat params)
+        sspec["seed"] = p["seed"]
+    p["scenario"] = sspec
+    return p
 
 
 def bucket_of(kind: str, params: dict) -> tuple:
@@ -333,6 +383,9 @@ def bucket_of(kind: str, params: dict) -> tuple:
     service would refuse."""
     if kind == "rollout":
         return _bucket_from_spec(_parse_rollout(params))
+    if kind == "scenario":
+        return _bucket_from_spec(
+            _parse_rollout(_scenario_to_rollout(params)))
     return ("single", kind)
 
 
@@ -385,10 +438,31 @@ def _rollout_problem(spec: _RolloutSpec):
                                       **spec.faults_spec)
     else:
         fs = stagelib.cached_no_faults(n, dt)
+    # ... and a Scenario (no_scenario when the request scripts none) —
+    # the same normalization, one axis up: scenario requests draw from
+    # the family registry at the SERVE-WIDE caps, so scenario-ful and
+    # scenario-free requests share one compiled program per bucket
+    # (no_scenario is bit-identical to scenario=None;
+    # tests/test_scenarios.py, docs/SCENARIOS.md)
+    if spec.scenario_spec is not None:
+        from aclswarm_tpu.scenarios import registry as scenreg
+        ss = spec.scenario_spec
+        # the horizon defaults to the REQUEST's own tick count: family
+        # event fractions then land inside the rollout being served (a
+        # fixed default would quietly schedule every event past a
+        # short request's end — a scenario-free run sold as a scenario)
+        scen = scenreg.sample(
+            str(ss["family"]), int(ss.get("seed", spec.seed)), n,
+            dtype=dt,
+            horizon=int(ss.get("horizon",
+                               spec.n_chunks * spec.chunk_ticks)),
+            params=ss.get("params"))
+    else:
+        scen = stagelib.cached_no_scenario(n, dt)
     # ONE compiled call instead of ~20 eager dispatches: prep runs on
     # client threads at submit, where eager-op GIL pressure was
     # measurable against the worker loop at saturation
-    state = stagelib.init_row(jnp.asarray(q0, dt), fs)
+    state = stagelib.init_row(jnp.asarray(q0, dt), fs, scen)
     cfg = sim.SimConfig(assignment=spec.assignment,
                         assign_every=spec.assign_every)
     return state, form, ControlGains(), sparams, cfg
@@ -693,8 +767,10 @@ class SwarmService:
     # --------------------------------------------------------- internals
 
     def _make_job(self, req: Request) -> _Job:
-        if req.kind == "rollout":
-            spec = _parse_rollout(req.params)
+        if req.kind in ("rollout", "scenario"):
+            spec = _parse_rollout(
+                _scenario_to_rollout(req.params)
+                if req.kind == "scenario" else req.params)
             job = _Job(req=req, ticket=Ticket(req.request_id),
                        bucket=_bucket_from_spec(spec),
                        spec=spec, chunks_total=spec.n_chunks)

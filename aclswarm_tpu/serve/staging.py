@@ -45,7 +45,8 @@ from typing import Any, List, Optional, Tuple
 
 __all__ = ["BucketStaging", "write_row", "gather_rows", "scatter_rows",
            "take_row", "unpack_round", "cached_default_formation",
-           "cached_sparams", "cached_no_faults", "pow2"]
+           "cached_sparams", "cached_no_faults", "cached_no_scenario",
+           "pow2"]
 
 
 def pow2(k: int) -> int:
@@ -165,12 +166,17 @@ def _build_init_row():
     from aclswarm_tpu import sim
 
     @jax.jit
-    def init_row(q0, faults):
+    def init_row(q0, faults, scenario=None):
         """The serve request's initial SimState row as ONE compiled
         call: submit-time prep runs on client threads, and ~20 eager
         op dispatches per accepted request was measurable GIL pressure
-        against the worker loop at saturation (~2 ms -> ~0.4 ms)."""
-        return sim.init_state(q0, faults=faults)
+        against the worker loop at saturation (~2 ms -> ~0.4 ms).
+        ``scenario`` (None = the historical trace, bit for bit) attaches
+        the request's scenario timeline — the serving layer always
+        passes one (`cached_no_scenario` when the request scripts none)
+        so scenario-free and scenario-ful requests share one compiled
+        program, exactly the `no_faults` normalization."""
+        return sim.init_state(q0, faults=faults, scenario=scenario)
 
     return init_row
 
@@ -213,8 +219,8 @@ def unpack_round(q_ticks, q_final):
     return _jitted("unpack_round", _build_unpack_round)(q_ticks, q_final)
 
 
-def init_row(q0, faults):
-    return _jitted("init_row", _build_init_row)(q0, faults)
+def init_row(q0, faults, scenario=None):
+    return _jitted("init_row", _build_init_row)(q0, faults, scenario)
 
 
 # the raw (un-jitted via __wrapped__) functions for the trace audit:
@@ -243,6 +249,7 @@ _CACHE_LOCK = threading.Lock()
 _FORM_CACHE: dict = {}
 _SPARAMS_CACHE: dict = {}
 _FAULTS_CACHE: dict = {}
+_SCEN_CACHE: dict = {}
 
 
 def _dt_key(dt) -> str:
@@ -305,6 +312,24 @@ def cached_no_faults(n: int, dt):
         return _FAULTS_CACHE.setdefault(key, fs)
 
 
+def cached_no_scenario(n: int, dt):
+    """The inert scenario every scenario-free serve rollout carries
+    (`scenarios.no_scenario` at the serve-wide axis caps): ONE pytree
+    structure per bucket, so scenario-ful and scenario-free requests
+    stack into the same batch — `no_scenario` is bit-identical to
+    ``scenario=None`` (tests/test_scenarios.py)."""
+    from aclswarm_tpu.scenarios import no_scenario
+
+    key = (int(n), _dt_key(dt))
+    with _CACHE_LOCK:
+        sc = _SCEN_CACHE.get(key)
+    if sc is not None:
+        return sc
+    sc = no_scenario(n, dtype=dt)
+    with _CACHE_LOCK:
+        return _SCEN_CACHE.setdefault(key, sc)
+
+
 def clear_caches() -> None:
     """Drop the problem + index caches (tests that flip the x64 flag
     or tear down jax backends)."""
@@ -312,6 +337,7 @@ def clear_caches() -> None:
         _FORM_CACHE.clear()
         _SPARAMS_CACHE.clear()
         _FAULTS_CACHE.clear()
+        _SCEN_CACHE.clear()
     with _IDX_LOCK:
         _IDX_CACHE.clear()
 

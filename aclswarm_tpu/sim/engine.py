@@ -48,6 +48,8 @@ from aclswarm_tpu.core.types import (ControlGains, Formation, SafetyParams,
 from aclswarm_tpu.faults import masking as faultmask
 from aclswarm_tpu.faults import schedule as faultlib
 from aclswarm_tpu.faults.schedule import FaultSchedule
+from aclswarm_tpu.scenarios import timeline as scenlib
+from aclswarm_tpu.scenarios.timeline import Scenario
 from aclswarm_tpu.sim import localization as loclib
 from aclswarm_tpu.sim import vehicle
 from aclswarm_tpu.sim.localization import EstimateTable
@@ -175,6 +177,18 @@ class SimState:
     # data, so batched trials may carry different scripts (and a no-fault
     # schedule is bit-identical to None; tests/test_faults.py).
     faults: FaultSchedule | None = None
+    # scenario timeline (`aclswarm_tpu.scenarios`): None = the
+    # scenario-free engine (structurally identical program to every
+    # pre-scenario rollout). A `Scenario` turns on the where-gated axes
+    # — pop-up/moving obstacles cast avoidance sectors, wind + sensor
+    # noise disturb dynamics and flooded estimates, tick-scheduled
+    # formation sequences and goal drift move the effective formation,
+    # byzantine agents bid on corrupted positions, and a re-matching
+    # cadence throttles accepted auctions — all keyed on the per-trial
+    # `tick` as pure data, so batched trials may carry different
+    # scenarios (and `no_scenario` is bit-identical to None;
+    # tests/test_scenarios.py).
+    scenario: Scenario | None = None
     # swarmcheck error carry (`analysis.invariants`): None = sanitizer
     # structurally absent (the zero-cost-off mode). An `InvariantState`
     # records the first contract violation (code + per-trial tick) as
@@ -203,6 +217,11 @@ class StepMetrics:
     # fault observables (None unless the state carries a FaultSchedule)
     alive: jnp.ndarray | None = None        # (n,) bool alive mask this tick
     fault_event: jnp.ndarray | None = None  # () bool: any alive bit flipped
+    # scenario observable (None unless the state carries a Scenario):
+    # any timeline axis flipped state this tick (obstacle appear/vanish,
+    # sequence stage landing, wind/noise/byzantine/drift onset) — feeds
+    # the same recovery clock as fault_event (`sim.summary`)
+    scen_event: jnp.ndarray | None = None   # () bool
     # swarmcheck code after the tick (None unless cfg.check_mode='on'):
     # 0 = clean so far, else the FIRST violated contract's code
     # (`analysis.invariants.CONTRACTS`) — rides the metric stack so
@@ -219,7 +238,8 @@ def init_state(q0, v2f0=None, flying: bool = True,
                localization: bool = False,
                faults: FaultSchedule | None = None,
                checks: bool = False,
-               telemetry: bool = False) -> SimState:
+               telemetry: bool = False,
+               scenario: Scenario | None = None) -> SimState:
     """``flying=True`` starts airborne in FLYING (historical rollouts);
     ``flying=False`` starts NOT_FLYING on the ground — send CMD_GO via
     `ExternalInputs` to take off (requires ``cfg.flight_fsm``).
@@ -230,7 +250,9 @@ def init_state(q0, v2f0=None, flying: bool = True,
     ``checks=True`` allocates the swarmcheck error carry (required iff
     the rollout runs with ``cfg.check_mode='on'``).
     ``telemetry=True`` allocates the swarmscope counter carry (required
-    iff the rollout runs with ``cfg.telemetry='on'``)."""
+    iff the rollout runs with ``cfg.telemetry='on'``).
+    ``scenario`` attaches a scenario timeline (`aclswarm_tpu.scenarios`);
+    None keeps the scenario-free engine."""
     # explicit strong dtype: a dtype-less asarray would inherit whatever
     # the caller passed (list vs np array vs f32 array), and every distinct
     # aval retraces the whole rollout (jaxcheck JC003)
@@ -238,6 +260,9 @@ def init_state(q0, v2f0=None, flying: bool = True,
     n = q0.shape[0]
     if v2f0 is None:
         v2f0 = permutil.identity(n)
+    if scenario is not None and scenario.n != n:
+        raise ValueError(f"scenario scripts n={scenario.n} agents but "
+                         f"the state carries n={n}")
     return SimState(
         swarm=SwarmState(q=q0, vel=jnp.zeros_like(q0)),
         goal=control.TrajGoal.hover_at(q0),
@@ -247,6 +272,7 @@ def init_state(q0, v2f0=None, flying: bool = True,
         loc=loclib.init_table(q0) if localization else None,
         first_auction=jnp.asarray(True),
         faults=faults,
+        scenario=scenario,
         inv=invlib.init_invariants() if checks else None,
         tel=devtel.init_telemetry(dtype=q0.dtype) if telemetry else None)
 
@@ -449,6 +475,38 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
     else:
         alive = link_mask = fault_event = None
 
+    # --- scenario timeline (`aclswarm_tpu.scenarios`): like the fault
+    # model, every axis is a mask/where against the baseline value,
+    # never control flow — keyed on the PER-TRIAL `state.tick`, so
+    # batched trials carry different scenarios under one vmap, and the
+    # inert `no_scenario` is bit-identical to None (the parity rule
+    # tests/test_scenarios.py pins). Python-gated on `scenario is None`,
+    # so the scenario-free program's HLO is untouched (the committed
+    # baseline's pre-scenario digests are unchanged).
+    scen = state.scenario
+    if scen is not None:
+        # (c)+(e): the EFFECTIVE formation — tick-scheduled sequence
+        # stages and goal drift move the points; the derived desired-
+        # distance matrices follow so assignment AND control track the
+        # timeline. `changed` False passes everything through bitwise.
+        pts_eff, form_changed = scenlib.formation_points_at(
+            scen, formation.points, state.tick, cfg.control_dt)
+        if checks:
+            inv = invlib.record(inv,
+                                invlib.nonfinite_points(pts_eff),
+                                "scen_points", state.tick)
+        formation = formation.replace(
+            points=pts_eff,
+            dstar_xy=jnp.where(form_changed,
+                               geometry.pdistmat(pts_eff[:, :2]),
+                               formation.dstar_xy),
+            dstar_z=jnp.where(form_changed,
+                              geometry.pdistmat(pts_eff[:, 2:3]),
+                              formation.dstar_z))
+        scen_event = scenlib.scenario_event_at(scen, state.tick)
+    else:
+        scen_event = None
+
     # --- operator flight-mode broadcast (`safety.cpp:101-121`) ---
     if cfg.flight_fsm:
         fs = vehicle.apply_command(fs, inputs.cmd)
@@ -478,7 +536,17 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
                                      cfg.flood_phases,
                                      target_block=cfg.flood_block,
                                      link_mask=link_mask)
-        est = loc.est
+        # (b) scenario sensor noise perturbs the CONSUMED view only
+        # (per-tick seeded, `scenarios.est_noise_at` ->
+        # `localization.noised_view`): the carried table stays clean,
+        # so a never-refreshed (link-masked) entry holds ~one draw of
+        # error instead of random-walking over the trial
+        loc_view = loc
+        if scen is not None:
+            loc_view = loclib.noised_view(
+                loc, scenlib.est_noise_at(scen, state.tick, n,
+                                          swarm.q.dtype))
+        est = loc_view.est
     elif cfg.localization == "truth":
         est = None
     else:
@@ -497,6 +565,11 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
     gate = state.assign_enabled
     if cfg.flight_fsm:
         gate = gate & jnp.all(flying)
+    if scen is not None:
+        # (e) re-matching cadence: off-cadence candidates are DISCARDED
+        # like any other gated-off auction (rematch_every=0 keeps the
+        # engine's own cadence bit-identically)
+        gate = gate & scenlib.rematch_ok_at(scen, state.tick)
     cand_rounds = None
     if cfg.assignment == "none":
         new_v2f, valid = v2f, jnp.asarray(True)
@@ -508,6 +581,20 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
         # return order (v2f, valid[, code][, rounds]); the no-assign
         # branch reports clean / zero rounds
         def _run(s, f, p, e):
+            # (d) byzantine bidders: the assignment layer consumes
+            # REPORTED positions — byz-masked rows lie by a per-tick
+            # seeded offset, so every solver's bids (centralized cost
+            # rows, CBAA self-bids) corrupt while control/dynamics
+            # keep the true state. Honest rows (and the no-byz
+            # scenario) pass through bitwise. Drawn INSIDE the cond
+            # branch: the lie is a pure function of (scen, tick), so
+            # auction-tick results are unchanged while the threefry +
+            # normal draw costs nothing on the other assign_every-1
+            # ticks (cond operands are computed before the branch).
+            if scen is not None:
+                s = SwarmState(
+                    q=scenlib.reported_positions(scen, s.q, state.tick),
+                    vel=s.vel)
             return assign(s, f, p, cfg, e, first=state.first_auction,
                           alive=alive, link_mask=link_mask,
                           check=checks, tel=tel_on)
@@ -520,7 +607,8 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
                 out = out + (jnp.zeros((), jnp.int32),)
             return out
 
-        outs = lax.cond(do_assign, _run, _hold, swarm, formation, v2f, est)
+        outs = lax.cond(do_assign, _run, _hold, swarm, formation, v2f,
+                        est)
         cand_v2f, cand_valid = outs[0], outs[1]
         take = do_assign & gate
         new_v2f = jnp.where(take, cand_v2f, v2f)
@@ -547,7 +635,7 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
                             "assign_perm", state.tick)
 
     # --- distributed control law -> distcmd (§3.3) ---
-    rel = None if est is None else loclib.relative_views(loc)
+    rel = None if est is None else loclib.relative_views(loc_view)
     ctrl_formation = formation
     if faults is not None:
         # dead vehicles vanish from the effective formation graph: their
@@ -577,9 +665,16 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
     u = control.saturate_velocity(u, sparams)
     u, yawrate = vehicle.mux_goals(u, inputs)
     if cfg.use_colavoid:
+        # (a) scenario obstacles cast avoidance sectors alongside the
+        # vehicles (their own keep-out radii; inactive slots cast none)
+        obstacles = None
+        if scen is not None:
+            obs_pos, obs_act = scenlib.obstacles_at(scen, state.tick,
+                                                    cfg.control_dt)
+            obstacles = (obs_pos, scen.obs_radius, obs_act)
         u, ca = control.collision_avoidance(
             swarm.q, u, sparams, max_neighbors=cfg.colavoid_neighbors,
-            neighbor_mask=alive)
+            neighbor_mask=alive, obstacles=obstacles)
     else:
         ca = jnp.zeros((n,), bool)
     safe_goal = control.make_safe_traj(cfg.control_dt, u, yawrate, goal,
@@ -610,6 +705,19 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
         swarm = SwarmState(q=swarm.q + vel * cfg.control_dt, vel=vel)
     else:
         raise ValueError(f"unknown dynamics model {cfg.dynamics!r}")
+
+    # --- (b) scenario wind: steady field + per-vehicle gusts displace
+    # the integrated positions. Applied BEFORE the fault freeze on
+    # purpose: a dead vehicle stays frozen even in wind (the freeze
+    # overwrites below), so the dead_frozen contract holds under any
+    # composition of the two subsystems.
+    if scen is not None:
+        wind_dq, wind_on = scenlib.wind_at(scen, state.tick,
+                                           cfg.control_dt, n,
+                                           swarm.q.dtype)
+        swarm = SwarmState(q=jnp.where(wind_on, swarm.q + wind_dq,
+                                       swarm.q),
+                           vel=swarm.vel)
 
     # --- fault freeze: dead vehicles hold pose, goal, and flight mode ---
     # (selected AFTER the full pipeline so every mask is a `where` on
@@ -658,12 +766,13 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
                          tick=state.tick + 1, flight=fs, loc=loc,
                          first_auction=first_auction,
                          assign_enabled=state.assign_enabled,
-                         faults=faults, inv=inv, tel=tel)
+                         faults=faults, scenario=scen, inv=inv, tel=tel)
     return new_state, StepMetrics(distcmd_norm=distcmd_norm, ca_active=ca,
                                   assign_valid=valid, reassigned=reassigned,
                                   auctioned=auctioned, q=swarm.q,
                                   mode=fs.mode, v2f=v2f,
                                   alive=alive, fault_event=fault_event,
+                                  scen_event=scen_event,
                                   inv_code=inv.code if checks else None,
                                   tel=tel if tel_on else None)
 

@@ -227,6 +227,25 @@ def flood(table: EstimateTable, comm: jnp.ndarray,
         age=lax.dynamic_update_slice(age, new_age_t, (jnp.int32(0), start)))
 
 
+def noised_view(table: EstimateTable, noise) -> EstimateTable:
+    """Scenario sensor noise (`aclswarm_tpu.scenarios`): ``noise`` is an
+    ``((n, n, 3) draw, () active)`` pair perturbing the table AS
+    CONSUMED this tick — a measurement-noise model. The engine applies
+    this to the view it hands the control law and CBAA, never to the
+    carried table, so the error per consumed estimate is exactly one
+    draw (~sigma) regardless of trial length — noising the carry
+    instead would random-walk entries the strictly-newer-wins merge
+    never refreshes (a link-masked neighbor's estimate would
+    accumulate unbounded phantom displacement). The diagonal is noised
+    too, but the control law consumes *relative* views
+    (`relative_views` subtracts own), so self-relative error stays
+    exactly zero. An inactive flag passes the table through bitwise
+    (the `no_scenario` parity rule)."""
+    draw, on = noise
+    return EstimateTable(est=jnp.where(on, table.est + draw, table.est),
+                         age=table.age)
+
+
 def tick(table: EstimateTable, q_true: jnp.ndarray, adjmat: jnp.ndarray,
          v2f: jnp.ndarray, do_flood: jnp.ndarray,
          target_block: int | None = None,
@@ -240,7 +259,9 @@ def tick(table: EstimateTable, q_true: jnp.ndarray, adjmat: jnp.ndarray,
     dead vehicles and lossy links (`aclswarm_tpu.faults`). A masked link
     is hold-last-value by construction: the strictly-newer-wins merge
     just keeps the receiver's stored estimate and its age keeps growing.
-    An all-true mask is bit-identical to no mask."""
+    An all-true mask is bit-identical to no mask. Scenario sensor noise
+    never enters this carry — it perturbs the consumed view
+    (`noised_view`)."""
     table = EstimateTable(est=table.est, age=table.age + 1)
     table = observe_self(table, q_true)
     comm = comm_mask(adjmat, v2f)

@@ -88,6 +88,11 @@ class ChunkSummary:
     # fault observables (None unless the rollout carried a FaultSchedule):
     fault_event: jnp.ndarray | None = None    # (T,) pass-through
     n_alive: jnp.ndarray | None = None        # (T,) int32 alive count
+    # scenario observable (None unless the rollout carried a Scenario):
+    # any timeline-axis flip this tick (`aclswarm_tpu.scenarios`) — the
+    # recovery clock below keys on fault_event OR scen_event, whichever
+    # subsystems are riding the rollout
+    scen_event: jnp.ndarray | None = None     # (T,) pass-through
     # recovery clock outputs, -1 except at the tick recovery completes:
     recovery_ticks: jnp.ndarray | None = None  # (T,) int32 event->conv ticks
     fault_churn: jnp.ndarray | None = None     # (T,) int32 reassigns in that
@@ -218,14 +223,24 @@ def summarize_chunk(metrics: StepMetrics, carry: SummaryCarry,
     fx, fy, cumdist, inited = _ewma_distance(metrics.q, carry)
     conv_all = jnp.all(dn_mean < ORIG_ZERO_VEL_THR, axis=1)
 
-    if metrics.alive is not None:
+    # the recovery clock keys on the union of whichever scripted-world
+    # events ride this rollout: fault drops/rejoins AND scenario axis
+    # flips both (re)start it (a fault-free scenario rollout still gets
+    # time-to-reconvergence per event — the scenario_suite metric)
+    event = metrics.fault_event if metrics.alive is not None else None
+    if metrics.scen_event is not None:
+        event = metrics.scen_event if event is None \
+            else (event | metrics.scen_event)
+    if event is not None:
         rec, chn, pending, since, churn = _recovery_clock(
-            metrics.fault_event, conv_all, metrics.reassigned, carry,
-            window)
-        fault_kw = dict(fault_event=metrics.fault_event,
-                        n_alive=jnp.sum(metrics.alive, axis=1,
-                                        dtype=jnp.int32),
-                        recovery_ticks=rec, fault_churn=chn)
+            event, conv_all, metrics.reassigned, carry, window)
+        fault_kw = dict(recovery_ticks=rec, fault_churn=chn)
+        if metrics.alive is not None:
+            fault_kw.update(fault_event=metrics.fault_event,
+                            n_alive=jnp.sum(metrics.alive, axis=1,
+                                            dtype=jnp.int32))
+        if metrics.scen_event is not None:
+            fault_kw["scen_event"] = metrics.scen_event
     else:
         pending, since, churn = (carry.rec_pending, carry.rec_since,
                                  carry.rec_churn)

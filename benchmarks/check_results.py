@@ -131,6 +131,108 @@ _STAGE_SET = {"round", "pack", "stack", "dispatch", "device_sync",
 _HOST_STAGES = ("pack", "stack", "unpack")
 _HOST_FRAC_BAR = 0.5
 
+# the scenario-suite artifact (benchmarks/scenario_suite.py;
+# docs/SCENARIOS.md): JSON-lines, TWO rows per scenario family —
+# completion (fraction of seeded trials that reconverged after
+# everything the family scripted) and recovery (ticks from the last
+# scenario event to reconvergence). Exact key set; NaN/Inf rejected;
+# both kinds owed per family; a committed (non-quick) artifact owes a
+# minimum family spread — a scenario vocabulary that quietly shrinks
+# is evidence rot.
+SCENARIO_SUITE = "scenario_suite.json"
+_SCEN_KEYS = {"name", "kind", "n", "family", "trials", "seed", "ticks",
+              "events", "wall_s", "device", "quick", "unit", "value"}
+_SCEN_KINDS = ("completion", "recovery")
+_SCEN_MIN_FAMILIES = 4
+
+
+def check_scenario_suite(rows: list, where: str) -> list[str]:
+    """Validate scenario_suite rows: exact key set per kind, finite
+    values (completion in [0, 1], recovery int >= -1 with a consistent
+    'recovered' flag), both kinds per family, and the minimum family
+    spread on committed artifacts."""
+    probs = []
+    fams: dict = {}
+    all_quick = True
+    for i, row in enumerate(rows, 1):
+        at = f"{where}:{i}"
+        if not isinstance(row, dict):
+            probs.append(f"{at}: row is not a JSON object")
+            continue
+        kind = row.get("kind")
+        if kind not in _SCEN_KINDS:
+            probs.append(f"{at}: 'kind' must be one of {_SCEN_KINDS}, "
+                         f"got {kind!r}")
+            continue
+        keys = _SCEN_KEYS | ({"recovered"} if kind == "recovery"
+                             else set())
+        missing, unknown = keys - set(row), set(row) - keys
+        if missing:
+            probs.append(f"{at}: missing keys {sorted(missing)}")
+        if unknown:
+            probs.append(f"{at}: unknown keys {sorted(unknown)} "
+                         "(exact-key-set schema)")
+        fam = row.get("family")
+        if not isinstance(fam, str) or not fam:
+            probs.append(f"{at}: 'family' must be a non-empty string")
+            fam = None
+        if fam is not None and row.get("name") != f"scenario_{fam}_{kind}":
+            probs.append(f"{at}: 'name' must be 'scenario_{fam}_{kind}', "
+                         f"got {row.get('name')!r}")
+        v = row.get("value")
+        if not _finite_num(v):
+            probs.append(f"{at}: 'value' must be a finite number, "
+                         f"got {v!r}")
+        elif kind == "completion":
+            if row.get("unit") != "frac":
+                probs.append(f"{at}: completion 'unit' must be 'frac'")
+            if not 0.0 <= v <= 1.0:
+                probs.append(f"{at}: completion must be within [0, 1], "
+                             f"got {v!r}")
+        else:
+            if row.get("unit") != "ticks":
+                probs.append(f"{at}: recovery 'unit' must be 'ticks'")
+            if not (isinstance(v, int) and v >= -1):
+                probs.append(f"{at}: recovery must be an int >= -1 "
+                             f"(-1 = never recovered), got {v!r}")
+            recd = row.get("recovered")
+            if not isinstance(recd, bool):
+                probs.append(f"{at}: 'recovered' must be a bool")
+            elif isinstance(v, int) and recd != (v >= 0):
+                probs.append(f"{at}: 'recovered' ({recd}) inconsistent "
+                             f"with value ({v})")
+        for k in ("n", "trials", "ticks"):
+            if k in row and not (_is_count(row[k]) and row[k] > 0):
+                probs.append(f"{at}: '{k}' must be a positive int, "
+                             f"got {row[k]!r}")
+        if "events" in row and not _is_count(row["events"]):
+            probs.append(f"{at}: 'events' must be a non-negative int")
+        if "wall_s" in row and not (_finite_num(row["wall_s"])
+                                    and row["wall_s"] >= 0):
+            probs.append(f"{at}: 'wall_s' must be a finite non-negative "
+                         "number")
+        if "quick" in row and not isinstance(row["quick"], bool):
+            probs.append(f"{at}: 'quick' must be a bool")
+        all_quick = all_quick and bool(row.get("quick"))
+        if fam is not None:
+            fams.setdefault(fam, set()).add(kind)
+    for fam, kinds in fams.items():
+        missing_kinds = set(_SCEN_KINDS) - kinds
+        if missing_kinds:
+            probs.append(f"{where}: family {fam!r} missing "
+                         f"{sorted(missing_kinds)} row(s) — every "
+                         "family owes completion AND recovery")
+    # the family-spread bar is waived ONLY for an all-quick smoke
+    # artifact: one stray quick row must not exempt a committed
+    # (non-quick) artifact whose vocabulary shrank
+    if rows and not all_quick and len(fams) < _SCEN_MIN_FAMILIES:
+        probs.append(
+            f"{where}: only {len(fams)} scenario family(ies); the "
+            f"committed artifact owes >= {_SCEN_MIN_FAMILIES} "
+            "(the scenario vocabulary must not silently shrink)")
+    return probs
+
+
 # the telemetry overhead artifact (aclswarm_tpu.telemetry.overhead):
 # exact key set per named row, and the <5% acceptance bar is part of
 # the schema — an artifact showing a regression must not pass silently
@@ -683,7 +785,7 @@ def check_file(path: Path) -> list[str]:
             return [f"{path.name}: unparseable trace-soak artifact"]
         return check_trace_soak(whole, path.name)
     if path.name in (SERVE_THROUGHPUT, TELEMETRY_OVERHEAD,
-                     SERVE_BREAKDOWN):
+                     SERVE_BREAKDOWN, SCENARIO_SUITE):
         rows, probs = [], []
         for i, line in enumerate(lines, 1):
             try:
@@ -692,7 +794,8 @@ def check_file(path: Path) -> list[str]:
                 probs.append(f"{path.name}:{i}: unparseable row ({e})")
         checker = {SERVE_THROUGHPUT: check_serve_throughput,
                    TELEMETRY_OVERHEAD: check_telemetry_overhead,
-                   SERVE_BREAKDOWN: check_serve_latency_breakdown}[
+                   SERVE_BREAKDOWN: check_serve_latency_breakdown,
+                   SCENARIO_SUITE: check_scenario_suite}[
                        path.name]
         return probs + checker(rows, path.name)
     if isinstance(whole, dict) and (

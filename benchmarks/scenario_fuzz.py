@@ -1,0 +1,193 @@
+"""Scenario fuzzer: random axis compositions vs the swarmcheck oracle.
+
+The invariant registry (`aclswarm_tpu.analysis.invariants`) stops being
+a passive sanitizer here and becomes an active bug-hunting harness: each
+fuzz case composes a RANDOM subset of the scenario axes (obstacles,
+wind, sensor noise, formation sequences, byzantine bidders, goal drift)
+at random in-space strengths — optionally stacked on a random
+FaultSchedule — and runs the batched engine with ``check_mode='on'``.
+Any contract violation (a dead vehicle moving under wind, a corrupted
+assignment that is not a permutation, a non-finite morph table, a
+Sinkhorn marginal blowout on byzantine costs, an out-of-bounds blow-out)
+fails the sweep with (seed, trial, tick, contract) attribution.
+
+Heterogeneity is the point: every trial in a fuzz batch carries a
+DIFFERENT composition inside ONE compiled vmapped scan — the same
+one-program property the scenario subsystem promises the serve layer.
+
+Run:
+    python benchmarks/scenario_fuzz.py               # 50 seeds (the bar)
+    python benchmarks/scenario_fuzz.py --seeds 8     # smoke (check.sh)
+
+Exit 0 = zero violations. Exit 1 names every violating case.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# per-axis fuzz spaces (mirrors the registry families' documented
+# ranges — in-space compositions are the zero-violation contract;
+# see aclswarm_tpu/scenarios/registry.py for the envelope rationale)
+AXIS_SPACES = {
+    "obstacles": lambda rng: dict(
+        count=int(rng.integers(1, 5)),   # inclusive of the K=4 cap —
+        #                                  the all-slots-active boundary
+        radius=float(rng.uniform(0.5, 1.5)),
+        speed=float(rng.choice([0.0, rng.uniform(0.2, 0.6)])),
+        appear_frac=float(rng.uniform(0.1, 0.4)),
+        vanish_frac=float(rng.uniform(0.5, 1.0))),
+    "wind": lambda rng: dict(
+        wind=float(rng.uniform(0.05, 0.25)),
+        gust=float(rng.uniform(0.0, 0.05)),
+        onset_frac=float(rng.uniform(0.0, 0.5))),
+    "noise": lambda rng: dict(
+        sigma=float(rng.uniform(0.05, 0.3)),
+        onset_frac=float(rng.uniform(0.0, 0.5))),
+    "sequence": lambda rng: dict(
+        stages=int(rng.integers(1, 3)),
+        split=bool(rng.integers(0, 2))),
+    "byzantine": lambda rng: dict(
+        frac=float(rng.uniform(0.1, 0.3)),
+        sigma=float(rng.uniform(0.5, 3.0)),
+        onset_frac=float(rng.uniform(0.0, 0.5))),
+    "drift": lambda rng: dict(
+        speed=float(rng.uniform(0.02, 0.1)),
+        onset_frac=float(rng.uniform(0.0, 0.5)),
+        rematch_every=int(rng.choice([0, 120, 240]))),
+}
+
+
+def _composition(rng: np.random.Generator, flooded: bool) -> dict:
+    """One random axis composition (>= 1 axis; noise only bites — and
+    is only scripted — under the flooded information model)."""
+    axes = [a for a in AXIS_SPACES if a != "noise" or flooded]
+    picked = [a for a in axes if rng.random() < 0.5]
+    if not picked:
+        picked = [axes[int(rng.integers(0, len(axes)))]]
+    return {a: AXIS_SPACES[a](rng) for a in picked}
+
+
+def run_fuzz(seeds: int = 50, *, n: int = 8, ticks: int = 480,
+             batch: int = 4, seed0: int = 0,
+             verbose: bool = True) -> list[dict]:
+    """Sweep ``seeds`` random compositions in batches of ``batch``
+    heterogeneous trials; returns a list of violation records (empty =
+    the oracle stayed silent)."""
+    import jax
+    import jax.numpy as jnp
+
+    from aclswarm_tpu import faults, scenarios as scn, sim
+    from aclswarm_tpu.analysis import invariants as invlib
+    from aclswarm_tpu.core.types import (ControlGains, SafetyParams,
+                                         make_formation)
+
+    dt = jnp.result_type(float)
+    sparams = SafetyParams(
+        bounds_min=jnp.asarray([-50.0, -50.0, 0.0], dt),
+        bounds_max=jnp.asarray([50.0, 50.0, 10.0], dt))
+    ang = np.linspace(0, 2 * np.pi, n, endpoint=False)
+    r = scn.registry.formation_scale(n)
+    pts = np.stack([r * np.cos(ang), r * np.sin(ang),
+                    np.full(n, 2.0)], 1)
+    form = make_formation(jnp.asarray(pts, dt),
+                          jnp.asarray(np.ones((n, n)) - np.eye(n), dt))
+
+    violations: list[dict] = []
+    case = 0
+    while case < seeds:
+        bsz = min(batch, seeds - case)
+        meta_rng = np.random.default_rng(seed0 + 7_000_003 + case)
+        # batch-shared engine knobs (one compiled config per batch):
+        # solver x information model x fault presence all rotate
+        solver = str(meta_rng.choice(["auction", "sinkhorn", "cbaa"]))
+        flooded = bool(meta_rng.integers(0, 2))
+        with_faults = meta_rng.random() < 0.4
+        cfg = sim.SimConfig(assignment=solver, assign_every=40,
+                            localization="flooded" if flooded else
+                            "truth", check_mode="on")
+        comps, states = [], []
+        for b in range(bsz):
+            s = seed0 + case + b
+            rng = np.random.default_rng(s)
+            parts = _composition(rng, flooded)
+            comps.append(sorted(parts))
+            scen = scn.compose(n, s, parts, dtype=dt, horizon=ticks)
+            fs = None
+            if with_faults:
+                fs = faults.sample_schedule(
+                    s, n, dropout_frac=float(rng.uniform(0, 0.3)),
+                    drop_tick=int(ticks * 0.25),
+                    rejoin_tick=int(ticks * 0.6),
+                    link_loss=float(rng.uniform(0, 0.3)), dtype=dt)
+            q0 = rng.normal(size=(n, 3)) * (0.4 * r)
+            q0[:, 2] = 2.0 + rng.normal(size=n) * 0.2
+            states.append(sim.init_state(
+                jnp.asarray(q0, dt), localization=flooded, faults=fs,
+                checks=True, scenario=scen))
+        bstate = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+        bform = jax.tree.map(lambda *xs: jnp.stack(xs), *([form] * bsz))
+        t0 = time.time()
+        _, metrics = sim.batched_rollout(bstate, bform, ControlGains(),
+                                         sparams, cfg, ticks)
+        codes = np.asarray(metrics.inv_code)        # (ticks, bsz)
+        for b in range(bsz):
+            hit = invlib.first_violation(codes[:, b])
+            tag = (f"seed {seed0 + case + b} [{solver}"
+                   f"{'/flooded' if flooded else ''}"
+                   f"{'/faults' if with_faults else ''}] "
+                   f"axes={'+'.join(comps[b])}")
+            if hit is None:
+                if verbose:
+                    print(f"ok   {tag}", flush=True)
+                continue
+            tick, contract = hit
+            violations.append(dict(seed=seed0 + case + b, trial=b,
+                                   tick=tick, contract=contract.id,
+                                   solver=solver, flooded=flooded,
+                                   faults=with_faults,
+                                   axes=comps[b]))
+            print(f"VIOLATION {tag}: {contract.id} at tick {tick}",
+                  flush=True)
+        if verbose:
+            print(f"  batch of {bsz} in {time.time() - t0:.1f}s",
+                  flush=True)
+        case += bsz
+    return violations
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seeds", type=int, default=50,
+                    help="fuzz cases to sweep (acceptance bar: >= 50)")
+    ap.add_argument("--n", type=int, default=8)
+    ap.add_argument("--ticks", type=int, default=480)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seed0", type=int, default=0)
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    t0 = time.time()
+    bad = run_fuzz(args.seeds, n=args.n, ticks=args.ticks,
+                   batch=args.batch, seed0=args.seed0,
+                   verbose=not args.quiet)
+    wall = time.time() - t0
+    if bad:
+        print(f"FUZZ FAILED: {len(bad)}/{args.seeds} compositions "
+              f"violated invariants ({wall:.0f}s):")
+        for v in bad:
+            print(f"  {v}")
+        return 1
+    print(f"fuzz clean: {args.seeds} random axis compositions, "
+          f"swarmcheck on, zero violations ({wall:.0f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

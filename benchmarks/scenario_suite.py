@@ -1,0 +1,189 @@
+"""Scenario-family sweep: committed completion/recovery evidence.
+
+The `aclswarm_tpu.scenarios` analogue of `faults_suite.py`: for every
+registry family, B seeded draws run as ONE batched rollout (every trial
+a DIFFERENT scenario of the family inside one compiled vmapped scan,
+sanitizer on), and the on-device recovery clock (`sim.summary` — keyed
+on scenario events exactly as on fault events) yields per-family
+
+- **completion**: fraction of trials whose windowed convergence
+  predicate holds in the final 20% of the horizon (the swarm absorbed
+  everything the family scripted), and
+- **recovery**: ticks from the LAST scenario event to reconvergence in
+  the first completing trial (-1 = never recovered inside the horizon).
+
+committed as strict rows to
+
+    benchmarks/results/scenario_suite.json      exact-key-set schema
+                                                (check_results
+                                                .check_scenario_suite)
+
+Run:
+    python benchmarks/scenario_suite.py [--quick] [--out PATH]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+RESULTS = Path(__file__).resolve().parent / "results"
+
+N = 10          # fleet size per family row
+B = 4           # seeded draws per family (one batched rollout)
+TICKS = 2400    # horizon (events land by 0.75 * TICKS; window = 100)
+WINDOW = 100    # 1 s supervisor convergence window at the 100 Hz tick
+
+
+def run_family(family: str, *, seed: int = 1, n: int = N, b: int = B,
+               ticks: int = TICKS, check_mode: str = "on") -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from aclswarm_tpu import scenarios as scn, sim
+    from aclswarm_tpu.analysis import invariants as invlib
+    from aclswarm_tpu.core.types import (ControlGains, SafetyParams,
+                                         make_formation)
+    from aclswarm_tpu.sim import summary as sumlib
+
+    fam = scn.FAMILIES[family]
+    dt = jnp.result_type(float)
+    r = scn.registry.formation_scale(n)
+    ang = np.linspace(0, 2 * np.pi, n, endpoint=False)
+    pts = np.stack([r * np.cos(ang), r * np.sin(ang),
+                    np.full(n, 2.0)], 1)
+    form = make_formation(jnp.asarray(pts, dt),
+                          jnp.asarray(np.ones((n, n)) - np.eye(n), dt))
+    sparams = SafetyParams(
+        bounds_min=jnp.asarray([-100.0, -100.0, 0.0], dt),
+        bounds_max=jnp.asarray([100.0, 100.0, 30.0], dt))
+    flooded = fam.localization == "flooded"
+    cfg = sim.SimConfig(assignment="auction", assign_every=120,
+                        localization=fam.localization,
+                        check_mode=check_mode)
+
+    scens, states = [], []
+    rng0 = np.random.default_rng(seed)
+    for k in range(b):
+        scen = scn.sample(family, seed * 1000 + k, n, dtype=dt,
+                          horizon=ticks)
+        scens.append(scen)
+        q0 = np.asarray(pts).copy()
+        q0[:, :2] += rng0.normal(size=(n, 2)) * 2.0   # short transit in
+        states.append(sim.init_state(jnp.asarray(q0, dt),
+                                     localization=flooded,
+                                     checks=check_mode == "on",
+                                     scenario=scen))
+    bstate = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+    bform = jax.tree.map(lambda *xs: jnp.stack(xs), *([form] * b))
+    carry = sumlib.init_carry(n, WINDOW, dtype=dt, batch=b)
+
+    chunk = 600
+    conv = np.zeros((b, 0), bool)
+    rec = np.zeros((b, 0), np.int32)
+    ev = np.zeros((b, 0), bool)
+    for c0 in range(0, ticks, chunk):
+        bstate, carry, summ = sumlib.batched_rollout_summary(
+            bstate, carry, bform, ControlGains(), sparams, cfg, chunk,
+            None, 0, window=WINDOW, takeoff_alt=2.0)
+        if check_mode == "on":
+            codes = np.asarray(summ.inv_code)
+            for bb in range(b):
+                invlib.raise_on_violation(codes[bb], trial=bb, tick0=c0)
+        conv = np.concatenate([conv, np.asarray(summ.conv_all)], axis=1)
+        rec = np.concatenate([rec, np.asarray(summ.recovery_ticks)],
+                             axis=1)
+        ev = np.concatenate([ev, np.asarray(summ.scen_event)], axis=1)
+
+    tail = int(0.8 * ticks)
+    completed = [bool(conv[bb, tail:].any()) for bb in range(b)]
+    # recovery: first clock fire after the LAST scripted event, taken
+    # from the first COMPLETING trial (a transient reconvergence in a
+    # trial that later diverged is not recovery evidence)
+    recovery = -1
+    for bb in range(b):
+        if not completed[bb]:
+            continue
+        evs = np.nonzero(ev[bb])[0]
+        if evs.size == 0:
+            continue
+        fired = np.nonzero(rec[bb, evs[-1]:] >= 0)[0]
+        if fired.size:
+            recovery = int(rec[bb, evs[-1] + fired[0]])
+            break
+    return dict(completion=sum(completed) / b, recovery=recovery,
+                events=int(ev.sum()), trials=b)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="short horizon smoke (rows marked quick)")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--families", action="append", default=None)
+    ap.add_argument("--out", default=str(RESULTS / "scenario_suite.json"))
+    ap.add_argument("--check-mode", choices=("off", "on"), default="on")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from aclswarm_tpu import scenarios as scn
+
+    ticks = 600 if args.quick else TICKS
+    fams = args.families or sorted(scn.FAMILIES)
+    rows, failed = [], []
+    for family in fams:
+        print(f"=== scenario family {family} (B={B}) ===", flush=True)
+        t0 = time.time()
+        try:
+            out = run_family(family, seed=args.seed, ticks=ticks,
+                             check_mode=args.check_mode)
+        except Exception as e:   # noqa: BLE001 — recorded, not hidden
+            failed.append(f"{family}: {e}")
+            print(f"FAILED {family}: {e} — continuing", flush=True)
+            continue
+        wall = round(time.time() - t0, 1)
+        base = dict(n=N, family=family, trials=out["trials"],
+                    seed=args.seed, ticks=ticks, events=out["events"],
+                    wall_s=wall, device=jax.default_backend(),
+                    quick=bool(args.quick))
+        rows.append(dict(base, name=f"scenario_{family}_completion",
+                         kind="completion", unit="frac",
+                         value=out["completion"]))
+        rows.append(dict(base, name=f"scenario_{family}_recovery",
+                         kind="recovery", unit="ticks",
+                         value=out["recovery"],
+                         recovered=out["recovery"] >= 0))
+        for rrow in rows[-2:]:
+            print(json.dumps(rrow), flush=True)
+
+    RESULTS.mkdir(exist_ok=True)
+    out_path = Path(args.out)
+    with out_path.open("w") as f:
+        for rrow in rows:
+            f.write(json.dumps(rrow) + "\n")
+    print(f"wrote {out_path} ({len(rows)} rows)")
+
+    from check_results import check_file
+    probs = check_file(out_path)
+    if probs:
+        print("SCHEMA DRIFT in freshly written artifact:")
+        for p in probs:
+            print(f"  {p}")
+        return 1
+    if failed:
+        print(f"{len(failed)} family(ies) FAILED:")
+        for c in failed:
+            print(f"  {c}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,7 +58,7 @@ from check_results import RESULTS, check_file  # noqa: E402
 
 for name in ("serve_throughput.json", "telemetry_overhead.json",
              "serve_multiworker_soak.json", "trace_soak.json",
-             "serve_latency_breakdown.json"):
+             "serve_latency_breakdown.json", "scenario_suite.json"):
     path = RESULTS / name
     if not path.exists():
         print(f"FAIL: missing owed artifact benchmarks/results/{name}")
@@ -69,6 +69,12 @@ for name in ("serve_throughput.json", "telemetry_overhead.json",
         sys.exit(1)
     print(f"{name}: committed and on schema")
 EOF
+
+echo "== swarmscenario fuzz smoke: random axis compositions (bounded =="
+echo "== seeds) vs the swarmcheck invariant oracle — zero violations =="
+echo "== (docs/SCENARIOS.md; the full >= 50-seed sweep is the slow =="
+echo "== tier: python benchmarks/scenario_fuzz.py) =="
+JAX_PLATFORMS=cpu python benchmarks/scenario_fuzz.py --seeds 8 -q
 
 echo "== crash-resume smoke: SIGKILL at chunk 1 of an n=5 rollout, =="
 echo "== resume from checkpoint, assert bit-parity (docs/RESILIENCE.md) =="
@@ -123,10 +129,11 @@ else
     echo "no tier-1 log at $T1_LOG — skipping (run tier-1 first)"
 fi
 
-echo "== guard self-tests (lint fixtures, audit grid, invariant contracts, resilience, serve, wire, telemetry, trace) =="
+echo "== guard self-tests (lint fixtures, audit grid, invariant contracts, resilience, serve, wire, telemetry, trace, scenarios) =="
 exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_analysis.py tests/test_invariants.py \
     tests/test_results_schema.py tests/test_resilience.py \
     tests/test_serve.py tests/test_serve_wire.py \
     tests/test_telemetry.py tests/test_trace.py \
+    tests/test_scenarios.py \
     -q -m 'not slow' -p no:cacheprovider

@@ -74,6 +74,15 @@ def pytest_configure(config):
         "(aclswarm_tpu.telemetry; docs/OBSERVABILITY.md)")
     config.addinivalue_line(
         "markers",
+        "scenario: swarmscenario composable scenario compiler — "
+        "timelines-as-pytrees (obstacles, wind/noise, formation "
+        "sequences, byzantine bidders, goal drift), no_scenario "
+        "bit-parity, family registry, invariant-oracle fuzzer, and "
+        "scenarios as a serve request kind (aclswarm_tpu.scenarios; "
+        "docs/SCENARIOS.md). The full >= 50-composition fuzz sweep "
+        "additionally carries `slow`; tier-1 runs a quick-seed subset")
+    config.addinivalue_line(
+        "markers",
         "invariants: swarmcheck runtime sanitizer — compiled-in "
         "invariant contracts (aclswarm_tpu.analysis.invariants; "
         "docs/STATIC_ANALYSIS.md runtime tier): clean-system positives, "

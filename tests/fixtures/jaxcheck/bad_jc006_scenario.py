@@ -1,0 +1,30 @@
+"""JC006 fixture, scenario flavor: the rule must see the NEW mask axes
+(`aclswarm_tpu.scenarios` — byzantine masks, obstacle activity masks)
+exactly as it sees the fault model's alive/link masks.
+
+This file is not under the fault-aware module prefixes, so it opts in:
+# jaxcheck: fault-aware-file
+"""
+import jax.numpy as jnp
+
+
+def byz_masked_ok(cost, byz_mask):
+    honest = jnp.where(byz_mask[:, None], 0.0, cost)
+    return jnp.sum(honest)              # ok: byz_mask feeds the operand
+
+
+def obstacle_masked_ok(d, obs_mask):
+    return jnp.min(d, where=obs_mask, initial=jnp.inf)  # ok: native mask
+
+
+def bad_byz_mean(scores, byz_mask):
+    return jnp.mean(scores)             # JC006
+
+
+def bad_obstacle_argmin(d, obs_mask):
+    nearest = jnp.argmin(d, axis=1)     # JC006
+    return jnp.where(obs_mask[nearest], -1, nearest)
+
+
+def no_mask_in_scope(seq_points):
+    return jnp.max(seq_points)          # ok: handles no mask -> exempt

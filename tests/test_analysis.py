@@ -47,6 +47,7 @@ class TestLintFixtures:
         ("bad_jc004.py", "JC004", 3),
         ("bad_jc005.py", "JC005", 2),
         ("bad_jc006.py", "JC006", 3),
+        ("bad_jc006_scenario.py", "JC006", 2),
     ])
     def test_rule_fires(self, fired, fixture, rule, count):
         vs = fired.get(fixture, [])

@@ -398,3 +398,69 @@ def test_telemetry_overhead_schema_flags_drift(tmp_path):
     assert any("unknown row name" in p
                for p in check_telemetry_overhead(
                    [frac, pub, {"name": "mystery", "value": 1.0}], "x"))
+
+
+def _scen_row(kind="completion", **kw):
+    base = {"name": f"scenario_wind_gust_{kind}", "kind": kind,
+            "n": 10, "family": "wind_gust", "trials": 4, "seed": 1,
+            "ticks": 2400, "events": 4, "wall_s": 1.0, "device": "cpu",
+            "quick": False, "unit": "frac" if kind == "completion"
+            else "ticks",
+            "value": 1.0 if kind == "completion" else 120}
+    if kind == "recovery":
+        base["recovered"] = base["value"] >= 0
+    base.update(kw)
+    return base
+
+
+def test_scenario_suite_artifact_committed():
+    """The owed per-family completion/recovery artifact
+    (docs/SCENARIOS.md): every registry family is represented with
+    BOTH kinds, and the committed rows pass the exact-key-set schema."""
+    path = RESULTS / "scenario_suite.json"
+    assert path.exists(), "benchmarks/results/scenario_suite.json " \
+                          "missing (python benchmarks/scenario_suite.py)"
+    assert check_file(path) == []
+    rows = [json.loads(ln) for ln in path.read_text().strip().splitlines()]
+    fams = {r["family"] for r in rows}
+    assert len(fams) >= 4
+    for fam in fams:
+        kinds = {r["kind"] for r in rows if r["family"] == fam}
+        assert kinds == {"completion", "recovery"}, (fam, kinds)
+    # the families match the registry vocabulary (no orphaned rows)
+    from aclswarm_tpu.scenarios import FAMILIES
+    assert fams <= set(FAMILIES), fams - set(FAMILIES)
+
+
+def test_scenario_suite_schema_flags_drift():
+    from check_results import check_scenario_suite
+
+    comp, rec = _scen_row(), _scen_row("recovery")
+    clean = []
+    for fam in ("wind_gust", "goal_drift", "sensor_noise",
+                "formation_morph"):
+        for kind in ("completion", "recovery"):
+            clean.append(_scen_row(kind, family=fam,
+                                   name=f"scenario_{fam}_{kind}"))
+    assert check_scenario_suite(clean, "x") == []
+    # NaN / non-finite values rejected
+    assert any("finite" in p for p in check_scenario_suite(
+        [dict(comp, value=float("nan")), rec], "x"))
+    # completion outside [0, 1] rejected
+    assert any("[0, 1]" in p for p in check_scenario_suite(
+        [dict(comp, value=1.5), rec], "x"))
+    # unknown keys rejected (exact-key-set schema)
+    assert any("unknown keys" in p for p in check_scenario_suite(
+        [dict(comp, bogus=1), rec], "x"))
+    # a family missing its recovery row is drift
+    assert any("owes completion AND recovery" in p
+               for p in check_scenario_suite([comp], "x"))
+    # recovered flag must be consistent with the value
+    assert any("inconsistent" in p for p in check_scenario_suite(
+        [comp, dict(rec, value=-1)], "x"))
+    # a shrunken family spread fails committed artifacts
+    assert any("family" in p and ">= 4" in p
+               for p in check_scenario_suite([comp, rec], "x"))
+    # ... but quick smoke rows are exempt from the spread bar
+    q = [_scen_row(quick=True), _scen_row("recovery", quick=True)]
+    assert not any(">= 4" in p for p in check_scenario_suite(q, "x"))

@@ -1,0 +1,554 @@
+"""swarmscenario tests (`aclswarm_tpu.scenarios`; docs/SCENARIOS.md).
+
+Pins the subsystem's contracts:
+
+1. **No-scenario parity**: a rollout carrying `no_scenario(n)` is
+   BIT-IDENTICAL to one carrying ``scenario=None`` — serial, batched,
+   flooded, composed with a FaultSchedule, and resumed from a
+   checkpoint codec round trip (every axis application is a `where`
+   whose inert case is the pass-through operand).
+2. **Axis semantics**: obstacles cast sectors only while active, wind
+   displaces (but never thaws a dead vehicle), sensor noise perturbs
+   only the flooded estimates, sequence stages and drift move the
+   effective formation, byzantine corruption changes assignments while
+   every output stays a permutation, and the re-matching cadence
+   throttles accepted auctions.
+3. **One compiled program**: heterogeneous scenarios across a batch
+   match their serial runs bit for bit.
+4. **Registry + fuzzer + serve**: families sample deterministically and
+   validate at the door; a quick-seed fuzz subset runs with the
+   swarmcheck oracle on (full >= 50-composition sweep marked slow);
+   scenario requests flow end-to-end through swarmserve and postmortem
+   reconstruction.
+"""
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from aclswarm_tpu import faults, scenarios as scn, sim
+from aclswarm_tpu.core.types import (ControlGains, SafetyParams,
+                                     make_formation)
+from aclswarm_tpu.scenarios import timeline as tl
+from aclswarm_tpu.sim import summary as sumlib
+
+pytestmark = pytest.mark.scenario
+
+METRIC_FIELDS = ("distcmd_norm", "ca_active", "assign_valid", "reassigned",
+                 "auctioned", "q", "mode", "v2f")
+
+N, T = 6, 130
+ASSIGN_EVERY = 60
+
+
+def _problem(B=1, n=N, seed=0, localization=False, scenarios=None,
+             scheds=None):
+    rng = np.random.default_rng(seed)
+    adj = np.ones((n, n)) - np.eye(n)
+    forms, states = [], []
+    for b in range(B):
+        pts = rng.normal(size=(n, 3)) * 5
+        gains = rng.normal(size=(n, n, 3, 3)) * 0.01
+        forms.append(make_formation(jnp.asarray(pts), jnp.asarray(adj),
+                                    jnp.asarray(gains)))
+        states.append(sim.init_state(
+            rng.normal(size=(n, 3)) * 5 + np.array([0, 0, 2.0]),
+            localization=localization,
+            faults=None if scheds is None else scheds[b],
+            scenario=None if scenarios is None else scenarios[b]))
+    sp = SafetyParams(bounds_min=jnp.asarray([-50.0, -50.0, 0.0]),
+                      bounds_max=jnp.asarray([50.0, 50.0, 20.0]))
+    return states, forms, sp
+
+
+def _stack(xs):
+    return jax.tree.map(lambda *ls: jnp.stack(ls), *xs)
+
+
+def _cfg(**kw):
+    kw.setdefault("assignment", "auction")
+    kw.setdefault("assign_every", ASSIGN_EVERY)
+    return sim.SimConfig(**kw)
+
+
+def _assert_rollouts_equal(m1, m2, f1, f2):
+    for name in METRIC_FIELDS:
+        np.testing.assert_array_equal(np.asarray(getattr(m1, name)),
+                                      np.asarray(getattr(m2, name)), name)
+    np.testing.assert_array_equal(np.asarray(f1.swarm.q),
+                                  np.asarray(f2.swarm.q))
+    np.testing.assert_array_equal(np.asarray(f1.swarm.vel),
+                                  np.asarray(f2.swarm.vel))
+    np.testing.assert_array_equal(np.asarray(f1.v2f), np.asarray(f2.v2f))
+
+
+def _dt():
+    return jnp.result_type(float)
+
+
+# --------------------------------------------------------------------------
+# 1. no_scenario == scenario=None, bit for bit
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("assignment", ["auction", "sinkhorn", "cbaa"])
+def test_no_scenario_bit_parity_serial(assignment):
+    states, forms, sp = _problem(seed=1)
+    cfg = _cfg(assignment=assignment, flight_fsm=True)
+    nos = scn.no_scenario(N, dtype=_dt())
+    f1, m1 = sim.rollout(states[0], forms[0], ControlGains(), sp, cfg, T)
+    f2, m2 = sim.rollout(states[0].replace(scenario=nos), forms[0],
+                         ControlGains(), sp, cfg, T)
+    _assert_rollouts_equal(m1, m2, f1, f2)
+    assert m1.scen_event is None
+    assert not np.asarray(m2.scen_event).any()
+
+
+def test_no_scenario_bit_parity_flooded_with_faults():
+    """Composed with the fault subsystem under the flooded information
+    model: estimate tables bit-identical too."""
+    scheds = [faults.sample_schedule(7, N, dropout_frac=0.3, drop_tick=30,
+                                     rejoin_tick=90, link_loss=0.2)]
+    states, forms, sp = _problem(seed=2, localization=True, scheds=scheds)
+    cfg = _cfg(assignment="cbaa", localization="flooded", flight_fsm=True)
+    nos = scn.no_scenario(N, dtype=_dt())
+    f1, m1 = sim.rollout(states[0], forms[0], ControlGains(), sp, cfg, T)
+    f2, m2 = sim.rollout(states[0].replace(scenario=nos), forms[0],
+                         ControlGains(), sp, cfg, T)
+    _assert_rollouts_equal(m1, m2, f1, f2)
+    np.testing.assert_array_equal(np.asarray(m1.alive),
+                                  np.asarray(m2.alive))
+    np.testing.assert_array_equal(np.asarray(f1.loc.est),
+                                  np.asarray(f2.loc.est))
+
+
+def test_no_scenario_bit_parity_batched():
+    B = 3
+    states, forms, sp = _problem(B, seed=3)
+    cfg = _cfg()
+    bstate, bform = _stack(states), _stack(forms)
+    nos = [scn.no_scenario(N, dtype=_dt()) for _ in range(B)]
+    bstate_nos = jax.tree.map(jnp.copy, bstate).replace(
+        scenario=_stack(nos))
+    bf1, bm1 = sim.batched_rollout(bstate, bform, ControlGains(), sp,
+                                   cfg, T)
+    bf2, bm2 = sim.batched_rollout(bstate_nos, bform, ControlGains(), sp,
+                                   cfg, T)
+    _assert_rollouts_equal(bm1, bm2, bf1, bf2)
+
+
+def test_no_scenario_bit_parity_resumed_from_checkpoint():
+    """Chunked + codec round trip mid-run: chunk 1 -> checkpoint ->
+    restore -> chunk 2 equals the uninterrupted scenario=None run."""
+    from aclswarm_tpu.resilience import checkpoint as ckptlib
+
+    states, forms, sp = _problem(seed=4)
+    cfg = _cfg()
+    half = T - T // 2
+    f_ref, m_ref = sim.rollout(states[0], forms[0], ControlGains(), sp,
+                               cfg, T)
+    nos = scn.no_scenario(N, dtype=_dt())
+    mid, _ = sim.rollout(states[0].replace(scenario=nos), forms[0],
+                         ControlGains(), sp, cfg, T // 2)
+    blob = ckptlib.dumps({"state": ckptlib.tree_arrays(mid)},
+                         ckptlib.make_manifest("test", "h", chunk=1))
+    payload, _ = ckptlib.loads(blob, "<mem>")
+    template = states[0].replace(scenario=nos)
+    restored = ckptlib.restore_tree(template, payload["state"],
+                                    what="SimState")
+    f2, m2 = sim.rollout(restored, forms[0], ControlGains(), sp, cfg,
+                         half)
+    np.testing.assert_array_equal(np.asarray(f_ref.swarm.q),
+                                  np.asarray(f2.swarm.q))
+    np.testing.assert_array_equal(np.asarray(f_ref.v2f),
+                                  np.asarray(f2.v2f))
+    for name in ("q", "v2f"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(m_ref, name))[T // 2:],
+            np.asarray(getattr(m2, name)), name)
+
+
+# --------------------------------------------------------------------------
+# 2. heterogeneous batched scenarios == serial
+# --------------------------------------------------------------------------
+
+def test_heterogeneous_scenarios_batched_matches_serial():
+    """The tentpole claim one axis up from faults: trials carrying
+    DIFFERENT scenario compositions run in ONE compiled vmapped scan,
+    bit-identical per trial to their serial rollouts."""
+    dt = _dt()
+    scens = [
+        scn.no_scenario(N, dtype=dt),
+        scn.compose(N, 11, {"wind": dict(wind=0.2, onset_frac=0.2)},
+                    dtype=dt, horizon=T),
+        scn.compose(N, 12, {"obstacles": dict(count=2, radius=1.0),
+                            "drift": dict(speed=0.05,
+                                          rematch_every=120)},
+                    dtype=dt, horizon=T),
+        scn.compose(N, 13, {"byzantine": dict(frac=0.3, sigma=2.0),
+                            "sequence": dict(stages=2)},
+                    dtype=dt, horizon=T),
+    ]
+    B = len(scens)
+    states, forms, sp = _problem(B, seed=5, scenarios=scens)
+    cfg = _cfg()
+    bstate, bform = _stack(states), _stack(forms)
+    bf, bm = sim.batched_rollout(bstate, bform, ControlGains(), sp, cfg,
+                                 T)
+    for b in range(B):
+        fs_, ms_ = sim.rollout(states[b], forms[b], ControlGains(), sp,
+                               cfg, T)
+        for name in METRIC_FIELDS:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(bm, name))[:, b],
+                np.asarray(getattr(ms_, name)), f"trial {b}: {name}")
+
+
+# --------------------------------------------------------------------------
+# 3. axis semantics
+# --------------------------------------------------------------------------
+
+def test_obstacle_pops_up_moves_and_vanishes():
+    dt = _dt()
+    scen = scn.no_scenario(N, dtype=dt).replace(
+        obs_center=jnp.zeros((tl.DEFAULT_MAX_OBSTACLES, 3), dt)
+            .at[0].set(jnp.asarray([1.0, 0.0, 2.0], dt)),
+        obs_vel=jnp.zeros((tl.DEFAULT_MAX_OBSTACLES, 3), dt)
+            .at[0].set(jnp.asarray([0.5, 0.0, 0.0], dt)),
+        obs_radius=jnp.zeros((tl.DEFAULT_MAX_OBSTACLES,), dt).at[0]
+            .set(1.2),
+        obs_appear=jnp.full((tl.DEFAULT_MAX_OBSTACLES,), tl.NEVER,
+                            jnp.int32).at[0].set(10),
+        obs_vanish=jnp.full((tl.DEFAULT_MAX_OBSTACLES,), tl.NEVER,
+                            jnp.int32).at[0].set(50))
+    pos, act = tl.obstacles_at(scen, 0, 0.01)
+    assert not bool(np.asarray(act)[0])
+    pos, act = tl.obstacles_at(scen, 20, 0.01)
+    assert bool(np.asarray(act)[0])
+    np.testing.assert_allclose(np.asarray(pos)[0, 0], 1.0 + 0.5 * 0.2)
+    _, act = tl.obstacles_at(scen, 50, 0.01)
+    assert not bool(np.asarray(act)[0])
+    # events fire exactly at appear and vanish
+    for t, want in ((9, False), (10, True), (11, False), (50, True)):
+        assert bool(np.asarray(tl.scenario_event_at(scen, t))) is want, t
+
+
+def test_obstacle_casts_sector_for_head_on_vehicle():
+    from aclswarm_tpu import control
+
+    q = jnp.asarray([[0.0, 0.0, 2.0], [40.0, 40.0, 2.0]], _dt())
+    vel = jnp.asarray([[0.5, 0.0, 0.0], [0.5, 0.0, 0.0]], _dt())
+    sp = SafetyParams()
+    obs = (jnp.asarray([[1.4, 0.0, 2.0]], _dt()),
+           jnp.asarray([1.2], _dt()), jnp.asarray([True]))
+    v_out, mod = control.collision_avoidance(q, vel, sp, obstacles=obs)
+    assert bool(np.asarray(mod)[0])        # vehicle 0 flies at the cylinder
+    assert not bool(np.asarray(mod)[1])    # vehicle 1 is far away
+    # inactive obstacle: output bit-identical to no obstacles at all
+    obs_off = (obs[0], obs[1], jnp.asarray([False]))
+    v_ref, mod_ref = control.collision_avoidance(q, vel, sp)
+    v_off, mod_off = control.collision_avoidance(q, vel, sp,
+                                                 obstacles=obs_off)
+    np.testing.assert_array_equal(np.asarray(v_ref), np.asarray(v_off))
+    np.testing.assert_array_equal(np.asarray(mod_ref),
+                                  np.asarray(mod_off))
+
+
+def test_wind_displaces_but_dead_vehicles_stay_frozen():
+    dt = _dt()
+    wind = scn.no_scenario(N, dtype=dt).replace(
+        wind_vel=jnp.asarray([0.2, 0.0, 0.0], dt),
+        wind_tick=jnp.asarray(0, jnp.int32))
+    sched = faults.sample_schedule(3, N, dropout_frac=0.5, drop_tick=20)
+    scheds = [sched]
+    states, forms, sp = _problem(seed=6, scheds=scheds)
+    cfg = _cfg()
+    st = states[0].replace(scenario=wind)
+    f1, m1 = sim.rollout(jax.tree.map(jnp.copy, states[0]), forms[0],
+                         ControlGains(), sp, cfg, T)
+    f2, m2 = sim.rollout(st, forms[0], ControlGains(), sp, cfg, T)
+    # wind changed the trajectory...
+    assert not np.array_equal(np.asarray(f1.swarm.q),
+                              np.asarray(f2.swarm.q))
+    # ...but dead vehicles stay frozen under wind (freeze wins)
+    alive = np.asarray(m2.alive)            # (T, n)
+    q = np.asarray(m2.q)
+    dead_rows = ~alive[-1]
+    assert dead_rows.any()
+    np.testing.assert_array_equal(q[-1][dead_rows], q[25][dead_rows])
+
+
+def test_sensor_noise_perturbs_only_flooded_estimates():
+    dt = _dt()
+    noisy = scn.no_scenario(N, dtype=dt).replace(
+        noise_std=jnp.asarray(0.2, dt),
+        noise_tick=jnp.asarray(40, jnp.int32),
+        key=jnp.asarray(tl.key_leaves(9), jnp.uint32))
+    states, forms, sp = _problem(seed=7, localization=True)
+    cfg = _cfg(assignment="cbaa", localization="flooded")
+    f1, m1 = sim.rollout(jax.tree.map(jnp.copy, states[0]), forms[0],
+                         ControlGains(), sp, cfg, T)
+    f2, m2 = sim.rollout(states[0].replace(scenario=noisy), forms[0],
+                         ControlGains(), sp, cfg, T)
+    # before onset the runs agree; after onset the estimates differ
+    np.testing.assert_array_equal(np.asarray(m1.q)[:40],
+                                  np.asarray(m2.q)[:40])
+    assert not np.array_equal(np.asarray(f1.loc.est),
+                              np.asarray(f2.loc.est))
+
+
+def test_sequence_and_drift_move_effective_formation():
+    dt = _dt()
+    base = jnp.asarray(np.random.default_rng(0).normal(size=(N, 3)), dt)
+    stage_pts = jnp.asarray(np.ones((2, N, 3)), dt) * 7.0
+    scen = scn.no_scenario(N, dtype=dt).replace(
+        seq_points=stage_pts,
+        seq_tick=jnp.asarray([50, tl.NEVER], jnp.int32),
+        drift_vel=jnp.asarray([0.1, 0.0, 0.0], dt),
+        drift_tick=jnp.asarray(100, jnp.int32))
+    pts, changed = tl.formation_points_at(scen, base, 0, 0.01)
+    np.testing.assert_array_equal(np.asarray(pts), np.asarray(base))
+    assert not bool(np.asarray(changed))
+    pts, changed = tl.formation_points_at(scen, base, 60, 0.01)
+    assert bool(np.asarray(changed))
+    np.testing.assert_allclose(np.asarray(pts), 7.0)
+    pts, _ = tl.formation_points_at(scen, base, 200, 0.01)
+    np.testing.assert_allclose(np.asarray(pts)[:, 0],
+                               7.0 + 0.1 * 1.0, rtol=1e-6)
+    assert bool(np.asarray(tl.scenario_event_at(scen, 50)))
+    assert bool(np.asarray(tl.scenario_event_at(scen, 100)))
+    assert not bool(np.asarray(tl.scenario_event_at(scen, 75)))
+
+
+def test_rematch_cadence_throttles_accepted_auctions():
+    dt = _dt()
+    # drift keeps the fleet re-matching; cadence 120 admits only every
+    # other scheduled auction (assign_every=60)
+    scen = scn.no_scenario(N, dtype=dt).replace(
+        rematch_every=jnp.asarray(120, jnp.int32))
+    states, forms, sp = _problem(seed=8)
+    cfg = _cfg()
+    _, m = sim.rollout(states[0].replace(scenario=scen), forms[0],
+                       ControlGains(), sp, cfg, T)
+    auct = np.nonzero(np.asarray(m.auctioned))[0]
+    assert list(auct) == [t for t in range(T)
+                          if t % ASSIGN_EVERY == 0 and t % 120 == 0]
+    # cadence 0 = the engine's own cadence, bit-identical
+    _, m0 = sim.rollout(states[0].replace(
+        scenario=scn.no_scenario(N, dtype=dt)), forms[0],
+        ControlGains(), sp, cfg, T)
+    assert np.nonzero(np.asarray(m0.auctioned))[0].tolist() == [
+        t for t in range(T) if t % ASSIGN_EVERY == 0]
+
+
+def test_byzantine_corrupts_assignment_but_extraction_stays_honest():
+    dt = _dt()
+    byz = scn.no_scenario(N, dtype=dt).replace(
+        byz_mask=jnp.asarray([True, True, False, False, False, False]),
+        byz_std=jnp.asarray(8.0, dt),
+        byz_tick=jnp.asarray(0, jnp.int32),
+        key=jnp.asarray(tl.key_leaves(21), jnp.uint32))
+    states, forms, sp = _problem(seed=9)
+    cfg = _cfg(check_mode="on")
+    q0 = np.asarray(states[0].swarm.q).copy()
+    q0[:, 2] = np.abs(q0[:, 2]) + 2.0      # airborne: inside the room
+    st_clean = sim.init_state(q0, checks=True)
+    st_byz = st_clean.replace(scenario=byz)
+    _, m1 = sim.rollout(st_clean, forms[0], ControlGains(), sp, cfg, T)
+    _, m2 = sim.rollout(st_byz, forms[0], ControlGains(), sp, cfg, T)
+    # the lies changed at least one accepted assignment...
+    assert not np.array_equal(np.asarray(m1.v2f), np.asarray(m2.v2f))
+    # ...but the sanitizer stayed silent: every extraction is honest
+    # (a permutation) and every contract held
+    assert np.asarray(m2.inv_code).max() == 0
+    for row in np.asarray(m2.v2f).reshape(-1, N):
+        assert sorted(row) == list(range(N))
+
+
+def test_scen_points_contract_trips_on_corrupt_table():
+    """The new swarmcheck contract: a NaN morph table is caught at the
+    tick its stage activates, blamed on scen_points (regression pin for
+    the fuzzer's oracle)."""
+    from aclswarm_tpu.analysis import invariants as invlib
+
+    dt = _dt()
+    S = tl.DEFAULT_MAX_STAGES
+    bad_tables = jnp.full((S, N, 3), jnp.nan, dt)
+    scen = scn.no_scenario(N, dtype=dt).replace(
+        seq_points=bad_tables,
+        seq_tick=jnp.asarray([40] + [tl.NEVER] * (S - 1), jnp.int32))
+    states, forms, sp = _problem(seed=10)
+    q0 = np.asarray(states[0].swarm.q).copy()
+    q0[:, 2] = np.abs(q0[:, 2]) + 2.0      # airborne: inside the room
+    st = sim.init_state(q0, checks=True, scenario=scen)
+    cfg = _cfg(check_mode="on")
+    _, m = sim.rollout(st, forms[0], ControlGains(), sp, cfg, T)
+    codes = np.asarray(m.inv_code)
+    with pytest.raises(invlib.InvariantViolation) as ei:
+        invlib.raise_on_violation(codes, trial=0)
+    assert ei.value.contract.id == "scen_points"
+    assert ei.value.tick == 40
+
+
+# --------------------------------------------------------------------------
+# 4. recovery clock, registry, fuzzer, serve
+# --------------------------------------------------------------------------
+
+def test_scenario_events_feed_recovery_clock():
+    dt = _dt()
+    B = 2
+    wind = scn.compose(N, 31, {"wind": dict(wind=0.2, onset_frac=0.25)},
+                       dtype=dt, horizon=120)
+    scens = [wind] * B
+    # converged start: the formation IS the cloud, so the wind onset is
+    # the only disturbance and the clock measures re-absorption
+    rng = np.random.default_rng(0)
+    pts = rng.normal(size=(N, 3)) * 4 + np.array([0, 0, 3.0])
+    form = make_formation(jnp.asarray(pts, dt),
+                          jnp.asarray(np.ones((N, N)) - np.eye(N), dt))
+    sp = SafetyParams(bounds_min=jnp.asarray([-50.0, -50.0, 0.0]),
+                      bounds_max=jnp.asarray([50.0, 50.0, 20.0]))
+    states = [sim.init_state(jnp.asarray(pts, dt), scenario=s)
+              for s in scens]
+    bstate, bform = _stack(states), _stack([form] * B)
+    carry = sumlib.init_carry(N, 5, dtype=dt, batch=B)
+    cfg = _cfg(assign_every=30)
+    _, carry, summ = sumlib.batched_rollout_summary(
+        bstate, carry, bform, ControlGains(), sp, cfg, 120, None, 0,
+        window=5, takeoff_alt=3.0)
+    ev = np.asarray(summ.scen_event)
+    rec = np.asarray(summ.recovery_ticks)
+    assert summ.fault_event is None and summ.n_alive is None
+    assert ev[:, 30].all() and ev.sum(axis=1).tolist() == [1, 1]
+    assert (rec >= 0).any()
+
+
+def test_registry_families_sample_deterministic_and_validate():
+    dt = _dt()
+    for name, fam in scn.FAMILIES.items():
+        s1 = scn.sample(name, 5, N, dtype=dt, horizon=200)
+        s2 = scn.sample(name, 5, N, dtype=dt, horizon=200)
+        for l1, l2 in zip(jax.tree.leaves(s1), jax.tree.leaves(s2)):
+            np.testing.assert_array_equal(np.asarray(l1),
+                                          np.asarray(l2), name)
+        assert s1.n == N
+        assert s1.max_obstacles == tl.DEFAULT_MAX_OBSTACLES
+        assert s1.max_stages == tl.DEFAULT_MAX_STAGES
+        assert fam.localization in ("truth", "flooded")
+    with pytest.raises(ValueError, match="unknown scenario family"):
+        scn.validate("nope")
+    with pytest.raises(ValueError, match="no parameter"):
+        scn.validate("wind_gust", {"wind.bogus": 1.0})
+    # overrides are range-checked, not just name-checked: an
+    # out-of-envelope scenario is a refused request, never a served one
+    with pytest.raises(ValueError, match="outside the"):
+        scn.validate("sensor_noise", {"noise.sigma": 1e6})
+    with pytest.raises(ValueError, match="outside the"):
+        scn.validate("wind_gust", {"wind.wind": True})
+    scn.validate("wind_gust", {"wind.wind": 0.2})   # in-space: fine
+    with pytest.raises(ValueError, match="unknown scenario axis"):
+        scn.compose(N, 1, {"bogus": {}})
+
+
+def test_fuzz_quick_seed_subset_zero_violations():
+    """Tier-1 slice of the invariant-oracle fuzzer (the full >= 50
+    sweep runs in test_fuzz_full_sweep, marked slow, and in
+    scripts/check.sh as a smoke)."""
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]
+                           / "benchmarks"))
+    import scenario_fuzz
+
+    bad = scenario_fuzz.run_fuzz(4, n=N, ticks=240, batch=4,
+                                 verbose=False)
+    assert bad == []
+
+
+@pytest.mark.slow
+def test_fuzz_full_sweep_zero_violations():
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]
+                           / "benchmarks"))
+    import scenario_fuzz
+
+    bad = scenario_fuzz.run_fuzz(50, n=8, ticks=480, batch=4,
+                                 verbose=False)
+    assert bad == []
+
+
+def test_serve_scenario_requests_end_to_end(tmp_path):
+    """Acceptance: a scenario request flows admission -> staged round ->
+    journal -> postmortem; it shares the bucket (one compiled program)
+    with a plain rollout; malformed scenarios are refused at the door."""
+    from aclswarm_tpu.serve.service import (ServiceConfig, SwarmService,
+                                            bucket_of)
+    from aclswarm_tpu.telemetry import postmortem
+
+    plain = {"n": 5, "ticks": 40, "chunk_ticks": 20, "seed": 3}
+    kind_params = {"n": 5, "ticks": 40, "chunk_ticks": 20, "seed": 3,
+                   "family": "crossing_obstacle", "horizon": 40}
+    nested = dict(plain, scenario={"family": "wind_gust", "seed": 4,
+                                   "horizon": 40,
+                                   "params": {"wind.wind": 0.2}})
+    # one compiled program: all three land in the SAME bucket
+    assert bucket_of("scenario", kind_params) \
+        == bucket_of("rollout", plain) == bucket_of("rollout", nested)
+
+    svc = SwarmService(ServiceConfig(journal_dir=str(tmp_path),
+                                     max_batch=4))
+    try:
+        with pytest.raises(ValueError, match="unknown scenario family"):
+            svc.submit("rollout", dict(plain,
+                                       scenario={"family": "nope"}))
+        with pytest.raises(ValueError, match="no parameter"):
+            svc.submit("scenario", dict(kind_params,
+                                        params={"obstacles.bogus": 1}))
+        # a flooded-model family would be a silent no-op on the serve
+        # engine (truth localization, no estimate tables) — refused
+        with pytest.raises(ValueError, match="flooded"):
+            svc.submit("scenario", dict(kind_params,
+                                        family="sensor_noise"))
+        t1 = svc.submit("rollout", plain, request_id="plain")
+        t2 = svc.submit("scenario", kind_params, request_id="kind")
+        t3 = svc.submit("rollout", nested, request_id="nested")
+        rs = [t.result(120) for t in (t1, t2, t3)]
+        assert all(r.ok for r in rs), rs
+        # the scenarios actually bit: outputs differ from the plain run
+        assert not np.array_equal(rs[0].value["q"], rs[2].value["q"])
+    finally:
+        svc.close()
+    rep = postmortem.reconstruct(str(tmp_path))
+    assert rep["accepted"] == 3
+    assert rep["complete"] == rep["gap_free"] == 3, rep
+
+
+def test_sharded_scenario_rollout_bit_parity():
+    """Agent-axis GSPMD sharding (virtual 8-device mesh): a
+    scenario-carrying state placed by `mesh.shard_problem` (byz mask
+    row-sharded, tables/tracks replicated) rolls out bit-identically
+    to the unsharded run."""
+    from aclswarm_tpu.parallel import mesh as meshlib
+
+    n = 16
+    rng = np.random.default_rng(0)
+    q0 = rng.normal(size=(n, 3)) * 3
+    q0[:, 2] = np.abs(q0[:, 2]) + 2.0
+    ang = np.linspace(0, 2 * np.pi, n, endpoint=False)
+    pts = np.stack([6 * np.cos(ang), 6 * np.sin(ang),
+                    np.full(n, 2.0)], 1)
+    form = make_formation(pts, np.ones((n, n)) - np.eye(n))
+    sp = SafetyParams(bounds_min=jnp.asarray([-50.0, -50.0, 0.0]),
+                      bounds_max=jnp.asarray([50.0, 50.0, 10.0]))
+    cfg = sim.SimConfig(assignment="auction", assign_every=4)
+    scen = scn.sample("kitchen_sink", 3, n, horizon=16)
+    f_ref, m_ref = sim.rollout(sim.init_state(q0, scenario=scen), form,
+                               ControlGains(), sp, cfg, 16)
+    mesh = meshlib.make_mesh()
+    st_s, form_s, _, _ = meshlib.shard_problem(
+        sim.init_state(q0, scenario=scen), form, mesh)
+    f_shd, m_shd = sim.rollout(st_s, form_s, ControlGains(), sp, cfg, 16)
+    np.testing.assert_array_equal(np.asarray(f_ref.swarm.q),
+                                  np.asarray(f_shd.swarm.q))
+    np.testing.assert_array_equal(np.asarray(m_ref.v2f),
+                                  np.asarray(m_shd.v2f))
