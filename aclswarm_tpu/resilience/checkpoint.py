@@ -152,9 +152,17 @@ def loads(buf: bytes, path="<bytes>") -> tuple[Any, dict]:
     `CheckpointCorrupt` on any structural damage."""
     if len(buf) < _HDR.size:
         raise CheckpointCorrupt(path, "short header")
-    magic, ver, _, _, meta_len, n_arrays, crc = _HDR.unpack_from(buf, 0)
+    magic, ver, r8, r16, meta_len, n_arrays, crc = _HDR.unpack_from(buf, 0)
     if magic != MAGIC:
         raise CheckpointCorrupt(path, f"bad magic 0x{magic:08X}")
+    if r8 != 0 or r16 != 0:
+        # the reserved header bytes are always written zero and sit
+        # OUTSIDE the body CRC: without this check they were the one
+        # place a bit flip slipped through undetected (found by the
+        # wire fuzzing — serve.traffic's corrupt-frame client)
+        raise CheckpointCorrupt(
+            path, f"nonzero reserved header bytes (r8={r8}, r16={r16})"
+                  " — header bit rot")
     if ver != FORMAT_VERSION:
         # a future format is indistinguishable from corruption to this
         # reader; the mismatch class gives the actionable message
@@ -164,6 +172,13 @@ def loads(buf: bytes, path="<bytes>") -> tuple[Any, dict]:
     if zlib_crc(body) != crc:
         raise CheckpointCorrupt(path, "crc mismatch (truncated or "
                                 "bit-rotted body)")
+    if meta_len > len(body):
+        # meta_len sits OUTSIDE the body CRC; on an array-free record a
+        # flipped high bit used to clamp harmlessly at the slice
+        # boundary and decode anyway (found by the wire fuzzing)
+        raise CheckpointCorrupt(
+            path, f"meta length {meta_len} exceeds body ({len(body)}) "
+                  "— header bit rot")
     try:
         meta = json.loads(body[:meta_len].decode())
         off = meta_len
@@ -185,11 +200,19 @@ def loads(buf: bytes, path="<bytes>") -> tuple[Any, dict]:
             off += nbytes
             arrays.append(np.frombuffer(raw, dtype.newbyteorder("<"))
                           .reshape(shape).astype(dtype, copy=False))
-    except (ValueError, KeyError, struct.error, UnicodeDecodeError) as e:
+        if off != len(body):
+            raise ValueError(f"{len(body) - off} trailing byte(s) "
+                             "after the array table")
+    except (ValueError, KeyError, IndexError, struct.error,
+            UnicodeDecodeError) as e:
         # CRC passed but the body does not parse: still corruption (the
-        # CRC guards bit rot, not a malicious/garbage writer)
+        # CRC guards bit rot, not a malicious/garbage writer; a flipped
+        # n_arrays surfaces as an array-index miss in _decode)
         raise CheckpointCorrupt(path, f"unparseable body ({e})") from e
-    return _decode(meta["payload"], arrays), meta["manifest"]
+    try:
+        return _decode(meta["payload"], arrays), meta["manifest"]
+    except (KeyError, IndexError, TypeError) as e:
+        raise CheckpointCorrupt(path, f"unparseable payload ({e})") from e
 
 
 # ---------------------------------------------------------------------------

@@ -53,5 +53,7 @@ __all__ = [
     "WorkerPool", "bucket_of", "place_slot",
 ]
 # WireServer / WireClient live in `aclswarm_tpu.serve.wire` and are
-# imported from there directly: they require the native shm transport
-# (make -C native), which must stay optional for the core service.
+# imported from there directly: the shm transport requires the native
+# library (make -C native), which must stay optional for the core
+# service. The TCP binding (`WireServer(tcp=...)`) and the traffic
+# fleet (`aclswarm_tpu.serve.traffic`) are pure stdlib.

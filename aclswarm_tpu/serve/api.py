@@ -159,6 +159,12 @@ class Ticket:
         self._events: queue.Queue = queue.Queue()
         self._result: Optional[Result] = None
         self._done = threading.Event()
+        # True once the service has accepted the request. In-process
+        # tickets exist only post-acceptance (submit raises otherwise);
+        # the wire client flips it False until the accept frame lands,
+        # so open-loop clients can tell accepted-and-running from
+        # still-awaiting-a-verdict without blocking on the result.
+        self.accepted = True
 
     # -- service side ------------------------------------------------------
     def _push(self, event: ChunkEvent) -> None:

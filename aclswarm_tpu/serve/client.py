@@ -19,13 +19,15 @@ the handful of patterns every caller was about to re-implement:
 from __future__ import annotations
 
 import time
+import uuid
+import zlib
 from typing import Optional
 
 from aclswarm_tpu.serve.api import (E_CLIENT_TIMEOUT, E_QUEUE_FULL,
                                     E_WORKER_DIED, FAILED, RejectedError,
                                     Result, ServeError)
-from aclswarm_tpu.utils.retry import (RetryPolicy, retry_call,
-                                      subprocess_output)
+from aclswarm_tpu.utils.retry import (RetryPolicy, retry_after_delay,
+                                      retry_call, subprocess_output)
 
 PROBE_CODE = "import jax; print('backend=' + jax.default_backend())"
 
@@ -63,13 +65,21 @@ def submit_and_wait(service, kind: str, params: dict, *,
                     deadline_s: Optional[float] = None,
                     client_timeout_s: Optional[float] = None,
                     poll_s: float = 5.0,
-                    trace_id: Optional[str] = None) -> Result:
+                    trace_id: Optional[str] = None,
+                    reject_retries: int = 4,
+                    max_retry_wait_s: float = 30.0) -> Result:
     """Submit one request and block for its terminal `Result`. Every
     non-answer comes back as a structured result (status ``failed``) so
     callers can treat every path uniformly — only programming errors
     raise:
 
-    - admission rejection -> ``queue_full`` (with the retry-after hint);
+    - admission rejection -> retried: the service's ``retry_after_s``
+      hint is HONORED (slept out with deterministic crc32 jitter,
+      `utils.retry.jittered`, so replays are identical and a rejected
+      fleet de-aligns) up to ``reject_retries`` times before the caller
+      sees a structured ``queue_full`` — backpressure becomes a short
+      wait, not a failure every caller re-implements around
+      (``reject_retries=0`` restores the old surface-it-raw behavior);
     - ``client_timeout_s`` lapsing -> ``client_timeout`` (the service
       STILL owes the result; the client just stopped waiting);
     - the worker dying with the ticket open -> ``worker_died`` (a dead
@@ -81,16 +91,31 @@ def submit_and_wait(service, kind: str, params: dict, *,
     ``trace_id`` threads a caller-held swarmtrace id through to the
     service (suites tracing their own cells); omitted, the service
     mints one and the terminal `Result.trace_id` carries it back."""
-    try:
-        ticket = service.submit(kind, params, tenant=tenant,
-                                request_id=request_id,
-                                deadline_s=deadline_s,
-                                trace_id=trace_id)
-    except RejectedError as e:
-        return Result(request_id=request_id or "", status=FAILED,
-                      error=ServeError(
-                          E_QUEUE_FULL, str(e),
-                          detail={"retry_after_s": e.retry_after_s}))
+    # the id is minted HERE when the caller brought none: it is both
+    # the idempotency key across the retries and the jitter seed — a
+    # fleet of auto-id callers must NOT share one crc32(tenant:kind)
+    # seed, or their retries march in lockstep (the herd the jitter
+    # exists to break)
+    request_id = request_id or uuid.uuid4().hex[:12]
+    seed = zlib.crc32(request_id.encode())
+    ticket = None
+    for attempt in range(max(0, reject_retries) + 1):
+        try:
+            ticket = service.submit(kind, params, tenant=tenant,
+                                    request_id=request_id,
+                                    deadline_s=deadline_s,
+                                    trace_id=trace_id)
+            break
+        except RejectedError as e:
+            if attempt >= reject_retries:
+                return Result(request_id=request_id or "", status=FAILED,
+                              error=ServeError(
+                                  E_QUEUE_FULL, str(e),
+                                  detail={"retry_after_s":
+                                          e.retry_after_s}))
+            time.sleep(retry_after_delay(e.retry_after_s, seed,
+                                         attempt, max_retry_wait_s))
+    assert ticket is not None
     deadline = (time.monotonic() + client_timeout_s
                 if client_timeout_s is not None else None)
     while True:

@@ -76,6 +76,28 @@ def delay_for(policy: RetryPolicy, attempt: int) -> float:
     return d * (1.0 + policy.jitter * _unit_hash(policy.seed, attempt))
 
 
+def jittered(delay_s: float, seed: int, attempt: int,
+             frac: float = 0.25) -> float:
+    """Deterministically jittered delay: the retry-after form of
+    `delay_for`, for waits whose BASE the other side names (the serve
+    admission ``retry_after_s`` hint). Same crc32 hash as the policy
+    jitter — replays are identical, and a fleet of rejected clients
+    folding distinct seeds de-aligns instead of thundering back in one
+    herd."""
+    return float(delay_s) * (1.0 + frac * _unit_hash(seed, attempt))
+
+
+def retry_after_delay(hint_s: float, seed: int, attempt: int,
+                      cap_s: float = 30.0) -> float:
+    """THE serve retry-after backoff: the server's hint, floored away
+    from zero, jittered deterministically, capped. One home for the
+    contract every hint-honoring client shares (`serve.client`,
+    `serve.wire.WireClient`, `serve.traffic`) — a tweak here changes
+    all of them together."""
+    return min(float(cap_s), jittered(max(0.01, float(hint_s)),
+                                      seed, attempt))
+
+
 @dataclasses.dataclass
 class ExecutionFailure:
     """One stage's failure record, committed into results JSON so a

@@ -39,7 +39,10 @@ _DIRECTION = {"Hz": 1, "hz": 1, "s": -1, "ms": -1, "us": -1,
 
 
 def load_rounds(directory: Path) -> list[tuple[int, dict]]:
-    """[(round, parsed-row)] for every BENCH_r*.json, round-ordered."""
+    """[(round, parsed-row)] for every BENCH_r*.json, round-ordered.
+    A capture may carry ONE row (``parsed``, the bench.py flagship) or
+    a LIST (``parsed_rows``) — multi-metric rounds trend per series
+    key, exactly like the single row always did."""
     out = []
     for path in sorted(directory.glob("BENCH_r*.json")):
         m = re.search(r"BENCH_r(\d+)\.json$", path.name)
@@ -50,14 +53,54 @@ def load_rounds(directory: Path) -> list[tuple[int, dict]]:
         except json.JSONDecodeError as e:
             print(f"WARN: {path.name} unparseable ({e}) — skipped")
             continue
+        rnd = int(m.group(1))
         parsed = cap.get("parsed")
         if isinstance(parsed, dict):
-            out.append((int(m.group(1)), parsed))
+            out.append((rnd, parsed))
+        extra = cap.get("parsed_rows")
+        if isinstance(extra, list):
+            out.extend((rnd, r) for r in extra if isinstance(r, dict))
     # NUMERIC round order, not the glob's lexical filename order —
     # BENCH_r100 sorts between r10 and r11 lexically, which would
     # compare non-adjacent rounds and mis-pick the newest
     out.sort(key=lambda t: t[0])
     return out
+
+
+# the committed overload surface (benchmarks/results/serve_overload.json)
+# contributes trend rows: goodput + p99 at the 1x and 10x offered-load
+# levels — the serve-SLO numbers that must not silently rot between
+# rounds. They join the series map as a pseudo-round AFTER the newest
+# BENCH capture (the artifact is the repo's CURRENT state), so any
+# historical capture carrying the same series gates the transition.
+OVERLOAD_LEVELS = ("1x", "10x")
+
+
+def overload_rows(results_dir: Path | None = None) -> list[dict]:
+    """Trend-shaped rows from the committed serve_overload artifact:
+    ``serve_overload_goodput`` (Hz, higher-better) and
+    ``serve_overload_p99`` (s, lower-better) at each of the 1x and 10x
+    levels, keyed by the ``level`` discriminator."""
+    results_dir = results_dir or (ROOT / "benchmarks" / "results")
+    path = results_dir / "serve_overload.json"
+    if not path.exists():
+        return []
+    rows = []
+    for line in path.read_text().strip().splitlines():
+        try:
+            r = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(r, dict) or r.get("quick") \
+                or r.get("level") not in OVERLOAD_LEVELS:
+            continue
+        common = {"level": r["level"], "n": r.get("n"),
+                  "backend": r.get("backend")}
+        rows.append(dict(common, name="serve_overload_goodput",
+                         value=r.get("value"), unit="Hz"))
+        rows.append(dict(common, name="serve_overload_p99",
+                         value=r.get("p99_s"), unit="s"))
+    return rows
 
 
 def _comparable(row: dict) -> bool:
@@ -68,10 +111,11 @@ def _comparable(row: dict) -> bool:
 
 # discriminator fields folded into the series key when present: rows
 # like serve_stage carry one (name, unit) per STAGE per shape per
-# backend, and matching by name alone would compare pack against
-# unpack across rounds — a meaningless delta that can both mask a real
-# regression and invent a fake one
-_SERIES_KEYS = ("stage", "n", "backend")
+# backend (and serve_overload rows one per offered-load LEVEL), and
+# matching by name alone would compare pack against unpack — or 1x
+# against 10x — across rounds: a meaningless delta that can both mask
+# a real regression and invent a fake one
+_SERIES_KEYS = ("stage", "n", "backend", "level")
 
 
 def series_key(row: dict) -> str | None:
@@ -98,8 +142,21 @@ def series(rounds: list[tuple[int, dict]]) -> dict[str, list]:
 
 
 def trend(directory: Path, threshold: float) -> tuple[list[str], int]:
-    """(report lines, regression count) over every metric series."""
+    """(report lines, regression count) over every metric series —
+    the BENCH_r* captures plus the committed overload surface (as the
+    round after the newest capture: the artifact is current state, so
+    a capture that carried the same series gates the transition)."""
     rounds = load_rounds(directory)
+    # a repo-shaped --dir (tests, forks) provides its own artifact;
+    # a bare captures directory falls back to THIS repo's committed
+    # results — the overload gate must not silently vanish just
+    # because --dir pointed somewhere without a benchmarks/ tree
+    cur = overload_rows(directory / "benchmarks" / "results")
+    if not cur and directory.resolve() != ROOT.resolve():
+        cur = overload_rows()
+    if cur:
+        nxt = (rounds[-1][0] if rounds else 0) + 1
+        rounds.extend((nxt, r) for r in cur)
     lines, regressions = [], 0
     if not rounds:
         return ([f"no BENCH_r*.json captures under {directory}"], 0)

@@ -233,6 +233,134 @@ def check_scenario_suite(rows: list, where: str) -> list[str]:
     return probs
 
 
+# the serve_overload artifact (benchmarks/serve_overload.py; ROADMAP
+# open item 3): JSON-lines, one row per offered-load level driven by
+# the adversarial open-loop traffic fleet over the TCP front end. The
+# acceptance criteria ARE the schema: >= 4 committed levels up to 10x
+# measured capacity, ZERO silent losses on every row (every accepted
+# request journal-attributable), goodput at 10x holding >= 90% of
+# goodput at 1x (admission sheds load instead of collapsing), and a
+# real shed at 10x (rejects > 0 — otherwise "capacity" was mismeasured
+# and the 10x level proves nothing).
+SERVE_OVERLOAD = "serve_overload.json"
+_OVERLOAD_COUNTS = ("offered", "accepted", "completed", "timed_out",
+                    "cancelled", "shed", "wire_lost", "failed_other",
+                    "server_rejected", "retry_submits",
+                    "accepted_after_retry", "silent_losses",
+                    "pm_complete", "pm_reconstructed", "crc_rejected",
+                    "slowloris_dropped", "reconnects", "unresolved")
+_OVERLOAD_KEYS = set(_OVERLOAD_COUNTS) | {
+    "name", "level", "multiplier", "n", "backend", "capacity_hz",
+    "offered_hz", "value", "unit", "p50_s", "p99_s", "reject_rate",
+    "retry_after_p50", "wall_s", "quick"}
+_OVERLOAD_MIN_LEVELS = 4
+_OVERLOAD_MAX_MULT = 10.0
+_OVERLOAD_GOODPUT_FRAC = 0.9
+
+
+def check_serve_overload(rows: list, where: str) -> list[str]:
+    """Validate serve_overload rows: exact key set, reconciling
+    counts, and the overload acceptance bars AS schema."""
+    probs = []
+    by_mult: dict = {}
+    any_committed = False
+    for i, row in enumerate(rows, 1):
+        at = f"{where}:{i}"
+        if not isinstance(row, dict):
+            probs.append(f"{at}: row is not a JSON object")
+            continue
+        missing, unknown = _OVERLOAD_KEYS - set(row), \
+            set(row) - _OVERLOAD_KEYS
+        if missing:
+            probs.append(f"{at}: missing keys {sorted(missing)}")
+        if unknown:
+            probs.append(f"{at}: unknown keys {sorted(unknown)} "
+                         "(exact-key-set schema)")
+        if row.get("name") != "serve_overload":
+            probs.append(f"{at}: 'name' must be 'serve_overload'")
+        if row.get("unit") != "Hz":
+            probs.append(f"{at}: 'unit' must be 'Hz'")
+        for k in _OVERLOAD_COUNTS:
+            if k in row and not _is_count(row[k]):
+                probs.append(f"{at}: '{k}' must be a non-negative int, "
+                             f"got {row[k]!r}")
+        for k in ("multiplier", "capacity_hz", "offered_hz", "value",
+                  "p50_s", "p99_s", "retry_after_p50", "wall_s"):
+            if k in row and not (_finite_num(row[k]) and row[k] >= 0):
+                probs.append(f"{at}: '{k}' must be a finite non-negative"
+                             f" number, got {row[k]!r}")
+        if "reject_rate" in row and not (
+                _finite_num(row["reject_rate"])
+                and 0.0 <= row["reject_rate"] <= 1.0):
+            probs.append(f"{at}: 'reject_rate' must be within [0, 1]")
+        if "quick" in row and not isinstance(row["quick"], bool):
+            probs.append(f"{at}: 'quick' must be a bool")
+        # the ledger must reconcile: every offered arrival is completed,
+        # timed out, cancelled, shed, or still counted unresolved (and
+        # unresolved must be zero)
+        if all(_is_count(row.get(k)) for k in
+               ("offered", "completed", "timed_out", "cancelled",
+                "shed", "wire_lost", "failed_other", "unresolved")):
+            total = (row["completed"] + row["timed_out"]
+                     + row["cancelled"] + row["shed"]
+                     + row["wire_lost"] + row["failed_other"]
+                     + row["unresolved"])
+            if total != row["offered"]:
+                probs.append(
+                    f"{at}: offered ({row['offered']}) != completed + "
+                    f"timed_out + cancelled + shed + wire_lost + "
+                    f"failed_other + unresolved ({total}) — the client "
+                    "ledger must reconcile")
+        if row.get("silent_losses") not in (0, None):
+            probs.append(f"{at}: silent_losses must be 0 — an accepted "
+                         "request without a journal-attributable "
+                         "terminal state is the one forbidden outcome "
+                         f"(got {row.get('silent_losses')!r})")
+        if row.get("unresolved") not in (0, None):
+            probs.append(f"{at}: unresolved must be 0 (got "
+                         f"{row.get('unresolved')!r})")
+        if _is_count(row.get("pm_complete")) \
+                and _is_count(row.get("pm_reconstructed")) \
+                and row["pm_complete"] != row["pm_reconstructed"]:
+            probs.append(f"{at}: postmortem attributed "
+                         f"{row['pm_complete']} of "
+                         f"{row['pm_reconstructed']} timelines — every "
+                         "accepted request must reconstruct complete")
+        if _finite_num(row.get("multiplier")):
+            by_mult[row["multiplier"]] = row
+            any_committed = any_committed or not row.get("quick")
+    committed = {m: r for m, r in by_mult.items() if not r.get("quick")}
+    if rows and any_committed:
+        if len(committed) < _OVERLOAD_MIN_LEVELS:
+            probs.append(
+                f"{where}: only {len(committed)} committed offered-load"
+                f" level(s); the artifact owes >= "
+                f"{_OVERLOAD_MIN_LEVELS} (0.5x..10x)")
+        if committed and max(committed) < _OVERLOAD_MAX_MULT:
+            probs.append(
+                f"{where}: highest committed level is "
+                f"{max(committed):g}x; the overload proof owes >= "
+                f"{_OVERLOAD_MAX_MULT:g}x capacity")
+        ten = committed.get(_OVERLOAD_MAX_MULT)
+        one = committed.get(1.0)
+        if ten is not None and one is not None \
+                and _finite_num(ten.get("value")) \
+                and _finite_num(one.get("value")) and one["value"] > 0:
+            frac = ten["value"] / one["value"]
+            if frac < _OVERLOAD_GOODPUT_FRAC:
+                probs.append(
+                    f"{where}: goodput at 10x is {frac:.1%} of goodput "
+                    f"at 1x — below the {_OVERLOAD_GOODPUT_FRAC:.0%} "
+                    "bar: admission is collapsing instead of shedding")
+        if ten is not None and _is_count(ten.get("shed")) \
+                and ten["shed"] == 0:
+            probs.append(
+                f"{where}: the 10x level shed nothing — either "
+                "capacity was mismeasured or admission never engaged; "
+                "the overload proof proves nothing")
+    return probs
+
+
 # the telemetry overhead artifact (aclswarm_tpu.telemetry.overhead):
 # exact key set per named row, and the <5% acceptance bar is part of
 # the schema — an artifact showing a regression must not pass silently
@@ -785,7 +913,7 @@ def check_file(path: Path) -> list[str]:
             return [f"{path.name}: unparseable trace-soak artifact"]
         return check_trace_soak(whole, path.name)
     if path.name in (SERVE_THROUGHPUT, TELEMETRY_OVERHEAD,
-                     SERVE_BREAKDOWN, SCENARIO_SUITE):
+                     SERVE_BREAKDOWN, SCENARIO_SUITE, SERVE_OVERLOAD):
         rows, probs = [], []
         for i, line in enumerate(lines, 1):
             try:
@@ -795,7 +923,8 @@ def check_file(path: Path) -> list[str]:
         checker = {SERVE_THROUGHPUT: check_serve_throughput,
                    TELEMETRY_OVERHEAD: check_telemetry_overhead,
                    SERVE_BREAKDOWN: check_serve_latency_breakdown,
-                   SCENARIO_SUITE: check_scenario_suite}[
+                   SCENARIO_SUITE: check_scenario_suite,
+                   SERVE_OVERLOAD: check_serve_overload}[
                        path.name]
         return probs + checker(rows, path.name)
     if isinstance(whole, dict) and (

@@ -58,7 +58,8 @@ from check_results import RESULTS, check_file  # noqa: E402
 
 for name in ("serve_throughput.json", "telemetry_overhead.json",
              "serve_multiworker_soak.json", "trace_soak.json",
-             "serve_latency_breakdown.json", "scenario_suite.json"):
+             "serve_latency_breakdown.json", "scenario_suite.json",
+             "serve_overload.json"):
     path = RESULTS / name
     if not path.exists():
         print(f"FAIL: missing owed artifact benchmarks/results/{name}")
@@ -96,6 +97,13 @@ echo "== alone — complete, causally ordered, gap-free =="
 echo "== (docs/OBSERVABILITY.md §swarmtrace) =="
 JAX_PLATFORMS=cpu python -m aclswarm_tpu.serve.smoke --postmortem
 
+echo "== overload smoke: TCP clients at 10x measured capacity (the =="
+echo "== adversarial open-loop fleet — slow-loris, corrupt frames, =="
+echo "== reconnect storms) against a journaled service; assert ZERO =="
+echo "== silent losses with every request postmortem-attributable =="
+echo "== (docs/SERVICE.md §off-host serving) =="
+JAX_PLATFORMS=cpu python benchmarks/serve_overload.py --smoke
+
 echo "== bench trajectory (informational: benchmarks/bench_trend.py =="
 echo "== exits nonzero standalone on a >10% regression) =="
 python benchmarks/bench_trend.py --soft
@@ -129,11 +137,12 @@ else
     echo "no tier-1 log at $T1_LOG — skipping (run tier-1 first)"
 fi
 
-echo "== guard self-tests (lint fixtures, audit grid, invariant contracts, resilience, serve, wire, telemetry, trace, scenarios) =="
+echo "== guard self-tests (lint fixtures, audit grid, invariant contracts, resilience, serve, wire, traffic, telemetry, trace, scenarios) =="
 exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_analysis.py tests/test_invariants.py \
     tests/test_results_schema.py tests/test_resilience.py \
     tests/test_serve.py tests/test_serve_wire.py \
+    tests/test_traffic.py \
     tests/test_telemetry.py tests/test_trace.py \
     tests/test_scenarios.py \
     -q -m 'not slow' -p no:cacheprovider

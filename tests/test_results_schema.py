@@ -464,3 +464,98 @@ def test_scenario_suite_schema_flags_drift():
     # ... but quick smoke rows are exempt from the spread bar
     q = [_scen_row(quick=True), _scen_row("recovery", quick=True)]
     assert not any(">= 4" in p for p in check_scenario_suite(q, "x"))
+
+
+# --------------------------------------------------- serve_overload
+
+def _overload_row(mult=10.0, **over):
+    row = {
+        "name": "serve_overload", "level": f"{mult:g}x",
+        "multiplier": mult, "n": 5, "backend": "cpu",
+        "capacity_hz": 8.0, "offered_hz": 8.0 * mult,
+        "value": 7.5, "unit": "Hz", "p50_s": 1.0, "p99_s": 5.0,
+        "offered": 100, "accepted": 40, "completed": 35,
+        "timed_out": 2, "cancelled": 3, "shed": 60, "wire_lost": 0,
+        "failed_other": 0, "reject_rate": 0.6, "server_rejected": 120,
+        "retry_submits": 80, "accepted_after_retry": 10,
+        "retry_after_p50": 2.0, "silent_losses": 0, "pm_complete": 40,
+        "pm_reconstructed": 40, "crc_rejected": 5,
+        "slowloris_dropped": 1, "reconnects": 2, "unresolved": 0,
+        "wall_s": 20.0, "quick": False,
+    }
+    row.update(over)
+    return row
+
+
+def _overload_rows():
+    return [_overload_row(0.5, value=4.0, offered=10, accepted=10,
+                          completed=10, timed_out=0, cancelled=0,
+                          shed=0, reject_rate=0.0, pm_complete=10,
+                          pm_reconstructed=10),
+            _overload_row(1.0, value=7.0, offered=20, accepted=20,
+                          completed=18, timed_out=1, cancelled=1,
+                          shed=0, reject_rate=0.0, pm_complete=20,
+                          pm_reconstructed=20),
+            _overload_row(2.0, value=7.2, offered=40, accepted=30,
+                          completed=28, timed_out=1, cancelled=1,
+                          shed=10, reject_rate=0.25, pm_complete=30,
+                          pm_reconstructed=30),
+            _overload_row(10.0)]
+
+
+def test_serve_overload_artifact_committed():
+    """The ISSUE-13 acceptance artifact: committed, on schema, >= 4
+    levels up to 10x, zero silent losses, goodput held at 10x."""
+    path = RESULTS / "serve_overload.json"
+    assert path.exists(), \
+        "benchmarks/results/serve_overload.json missing (run " \
+        "benchmarks/serve_overload.py)"
+    assert check_file(path) == []
+    rows = [json.loads(ln) for ln in
+            path.read_text().strip().splitlines()]
+    mults = {r["multiplier"] for r in rows if not r.get("quick")}
+    assert len(mults) >= 4 and max(mults) >= 10.0
+    assert all(r["silent_losses"] == 0 for r in rows)
+
+
+def test_serve_overload_schema_flags_drift():
+    from check_results import check_serve_overload
+
+    assert check_serve_overload(_overload_rows(), "x") == []
+    # a silent loss is the one forbidden outcome
+    rows = _overload_rows()
+    rows[3] = dict(rows[3], silent_losses=1)
+    assert any("silent_losses must be 0" in p
+               for p in check_serve_overload(rows, "x"))
+    # goodput collapse at 10x fails the artifact
+    rows = _overload_rows()
+    rows[3] = dict(rows[3], value=1.0)
+    assert any("collapsing" in p
+               for p in check_serve_overload(rows, "x"))
+    # a 10x level that shed nothing proves nothing
+    rows = _overload_rows()
+    rows[3] = dict(rows[3], shed=0, completed=95, accepted=100,
+                   timed_out=2, cancelled=3, reject_rate=0.0,
+                   pm_complete=100, pm_reconstructed=100)
+    assert any("shed nothing" in p
+               for p in check_serve_overload(rows, "x"))
+    # the sweep must reach 10x with >= 4 levels
+    assert any(">= 10x" in p
+               for p in check_serve_overload(_overload_rows()[:3], "x"))
+    assert any(">= 4" in p
+               for p in check_serve_overload(_overload_rows()[:3], "x"))
+    # the client ledger must reconcile to the offered count
+    rows = _overload_rows()
+    rows[0] = dict(rows[0], completed=9)
+    assert any("must reconcile" in p
+               for p in check_serve_overload(rows, "x"))
+    # unattributed timelines fail
+    rows = _overload_rows()
+    rows[3] = dict(rows[3], pm_complete=39)
+    assert any("reconstruct complete" in p
+               for p in check_serve_overload(rows, "x"))
+    # exact key set (unknown keys rejected)
+    rows = _overload_rows()
+    rows[0] = dict(rows[0], bogus=1)
+    assert any("unknown keys" in p
+               for p in check_serve_overload(rows, "x"))

@@ -556,12 +556,45 @@ class TestSubmitAndWait:
         assert r.status == FAILED and r.error.code == "worker_died"
         svc.close(drain=False)
         # queue full: the retry-after hint survives the translation
+        # (reject_retries=0 = the raw pre-ISSUE-13 surface)
         svc2 = SwarmService(ServiceConfig(max_queue_per_tenant=1),
                             start=False)
         svc2.submit("assign", {"n": 6})
-        r2 = submit_and_wait(svc2, "assign", {"n": 6})
+        r2 = submit_and_wait(svc2, "assign", {"n": 6},
+                             reject_retries=0)
         assert r2.status == FAILED and r2.error.code == "queue_full"
         assert r2.error.detail["retry_after_s"] > 0
+        svc2.close(drain=False)
+
+    def test_retry_after_honored_by_default(self):
+        """ISSUE-13 satellite: a queue_full rejection sleeps out the
+        hint (deterministic crc32 jitter) and re-submits — callers see
+        the eventual result, not raw backpressure. Exhausted budgets
+        still surface the structured queue_full."""
+        import threading
+
+        svc = SwarmService(ServiceConfig(max_queue_per_tenant=1,
+                                         max_batch=1,
+                                         idle_poll_s=0.01),
+                           start=False)
+        svc.submit("assign", {"n": 6, "seed": 1})   # pins the cap slot
+        starter = threading.Timer(0.6, svc.start)
+        starter.start()
+        r = submit_and_wait(svc, "assign", {"n": 6, "seed": 2},
+                            reject_retries=16, client_timeout_s=120)
+        starter.join()
+        assert r.ok, r.error
+        assert svc.stats["rejected"] >= 1   # the backpressure was real
+        svc.close()
+        # exhausted budget: the structured queue_full surfaces, after
+        # exactly the bounded number of re-submits
+        svc2 = SwarmService(ServiceConfig(max_queue_per_tenant=1),
+                            start=False)
+        svc2.submit("assign", {"n": 6})
+        r2 = submit_and_wait(svc2, "assign", {"n": 6},
+                             reject_retries=2, max_retry_wait_s=0.05)
+        assert r2.status == FAILED and r2.error.code == "queue_full"
+        assert svc2.stats["rejected"] == 3      # 1 try + 2 retries
         svc2.close(drain=False)
 
     def test_client_timeout_while_service_still_owes(self):

@@ -418,4 +418,59 @@ class TestBenchTrend:
 
         lines, reg = bench_trend.trend(REPO, 0.10)
         assert any("sinkhorn_assign_n1000_hz" in ln for ln in lines)
+        # the committed overload surface contributes its goodput/p99
+        # rows at the 1x and 10x levels (ISSUE-13 satellite)
+        assert any("serve_overload_goodput" in ln and "level=10x" in ln
+                   for ln in lines)
+        assert any("serve_overload_p99" in ln and "level=1x" in ln
+                   for ln in lines)
         assert reg == 0
+
+    def test_parsed_rows_list_and_overload_pseudo_round(self, tmp_path):
+        """ISSUE-13 satellite: captures may carry a ``parsed_rows``
+        LIST (multi-metric rounds), overload rows key by their
+        ``level`` discriminator, and the committed serve_overload
+        artifact joins the trend as the round AFTER the newest capture
+        — so a capture carrying the same series gates the artifact's
+        transition."""
+        import json as jsonlib
+
+        import bench_trend
+
+        def orow(level, name, value, unit):
+            return {"name": name, "level": level, "n": 5,
+                    "backend": "cpu", "value": value, "unit": unit}
+
+        # round 1: a capture with overload series via parsed_rows
+        (tmp_path / "BENCH_r01.json").write_text(jsonlib.dumps(
+            {"n": 1, "cmd": "", "rc": 0, "tail": "", "parsed_rows": [
+                orow("1x", "serve_overload_goodput", 10.0, "Hz"),
+                orow("10x", "serve_overload_goodput", 10.0, "Hz"),
+                orow("10x", "serve_overload_p99", 1.0, "s")]}))
+        rounds = bench_trend.load_rounds(tmp_path)
+        assert len(rounds) == 3
+        # levels are distinct series: same name at 1x vs 10x never
+        # cross-compares
+        k1 = bench_trend.series_key(
+            orow("1x", "serve_overload_goodput", 1, "Hz"))
+        k10 = bench_trend.series_key(
+            orow("10x", "serve_overload_goodput", 1, "Hz"))
+        assert k1 != k10 and "level=1x" in k1
+        # the committed artifact = the NEXT round: a goodput collapse
+        # vs the capture gates
+        rdir = tmp_path / "benchmarks" / "results"
+        rdir.mkdir(parents=True)
+        art = dict(level="10x", multiplier=10.0, n=5, backend="cpu",
+                   value=5.0, p99_s=1.05, quick=False)
+        (rdir / "serve_overload.json").write_text(jsonlib.dumps(art))
+        lines, reg = bench_trend.trend(tmp_path, 0.10)
+        assert reg == 1, lines      # 10 -> 5 Hz at 10x: -50% gates
+        # a healthy artifact does not
+        art["value"] = 10.2
+        (rdir / "serve_overload.json").write_text(jsonlib.dumps(art))
+        _, reg = bench_trend.trend(tmp_path, 0.10)
+        assert reg == 0
+        # quick rows never contribute
+        art["quick"] = True
+        (rdir / "serve_overload.json").write_text(jsonlib.dumps(art))
+        assert bench_trend.overload_rows(rdir) == []

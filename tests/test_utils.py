@@ -122,3 +122,29 @@ class TestLogging:
         with caplog.at_level(logging.INFO, logger="aclswarm_tpu"):
             log.info("hello %d", 7)
         assert any("hello 7" in r.message for r in caplog.records)
+
+
+class TestJittered:
+    """`utils.retry.jittered` — the retry-after form of the policy
+    jitter (ISSUE-13 satellite): deterministic, bounded, de-aligned
+    across seeds."""
+
+    def test_deterministic_and_bounded(self):
+        from aclswarm_tpu.utils.retry import jittered
+
+        for seed in (0, 1, 0xDEAD):
+            for attempt in range(5):
+                d1 = jittered(2.0, seed, attempt)
+                d2 = jittered(2.0, seed, attempt)
+                assert d1 == d2                     # replayable
+                assert 2.0 <= d1 < 2.0 * 1.25      # base + frac bound
+
+    def test_dealigns_across_seeds_and_attempts(self):
+        from aclswarm_tpu.utils.retry import jittered
+
+        ds = {round(jittered(1.0, seed, 0), 9) for seed in range(16)}
+        assert len(ds) > 8          # a herd of seeds spreads out
+        assert jittered(1.0, 3, 0) != jittered(1.0, 3, 1)
+        # zero stays zero; frac=0 disables the jitter entirely
+        assert jittered(0.0, 1, 0) == 0.0
+        assert jittered(5.0, 1, 2, frac=0.0) == 5.0
