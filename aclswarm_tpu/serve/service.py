@@ -80,13 +80,16 @@ from aclswarm_tpu.telemetry import (LifecycleLog, MetricsRegistry,
 from aclswarm_tpu.utils import get_logger
 from aclswarm_tpu.utils.retry import RetryPolicy
 
-BUILTIN_KINDS = ("rollout", "assign", "gains", "stats", "scenario")
+BUILTIN_KINDS = ("rollout", "assign", "gains", "stats", "scenario",
+                 "health")
 CRASH_SITE = "serve"        # maybe_crash site: one boundary per round
 
 # lifecycle events journaled even with cfg.trace=False: the PR-8
 # worker-failure ledger recovery restores its counters from (turning
-# tracing off must not also turn off the failover evidence)
-_LEDGER_EVENTS = frozenset({"failover", "migrated", "poisoned"})
+# tracing off must not also turn off the failover evidence), and the
+# swarmwatch alert stream (turning tracing off must not blind the
+# detection evidence the slo_detection artifact is built from)
+_LEDGER_EVENTS = frozenset({"failover", "migrated", "poisoned", "alert"})
 
 
 @dataclasses.dataclass(frozen=True)
@@ -150,6 +153,21 @@ class ServiceConfig:
     # each round before picking the next (staged but sequential).
     # Requires staging; ignored when staging=False.
     pipeline: bool = True
+    # ---- swarmwatch (telemetry.timeseries/slo; docs/OBSERVABILITY.md
+    # §swarmwatch): continuous time-series over this service's registry
+    # + live SLO evaluation with a pending→firing→resolved alert state
+    # machine. Off by default (a sampler thread per service would tax
+    # every short-lived test service); production/soak services turn it
+    # on. With a journal, history persists to <journal>/timeseries.log
+    # (the resilience frame log — survives SIGKILL, readable from disk
+    # alone) and alert transitions append to events.log as schema'd
+    # ``alert`` fleet events.
+    watch: bool = False
+    watch_interval_s: float = 0.25    # sampler + SLO evaluation cadence
+    watch_capacity: int = 1024        # points retained per series
+    # SLO catalog override (tuple of telemetry.slo.SloSpec); None =
+    # telemetry.slo.default_slos(max_queue_total=cfg.max_queue_total)
+    slos: Optional[tuple] = None
 
 
 @dataclasses.dataclass
@@ -550,6 +568,25 @@ class SwarmService:
         # a service outage
         from aclswarm_tpu.serve.workers import WorkerPool
         self._pool = WorkerPool(self, cfg)
+        # swarmwatch (docs/OBSERVABILITY.md §swarmwatch): memory +
+        # judgment over the registry. Built AFTER the pool so the probe
+        # can read fleet liveness; the alert emit rides the journal's
+        # events.log (fleet-scope `alert` records, always journaled —
+        # _LEDGER_EVENTS), so the live surface and the postmortem
+        # surface share one stream.
+        self.watch = None
+        if cfg.watch:
+            from aclswarm_tpu.telemetry.slo import SwarmWatch, default_slos
+            specs = (list(cfg.slos) if cfg.slos is not None
+                     else default_slos(max_queue_total=cfg.max_queue_total))
+            self.watch = SwarmWatch(
+                self.telemetry, specs,
+                interval_s=cfg.watch_interval_s,
+                capacity=cfg.watch_capacity,
+                persist_path=(self._journal / "timeseries.log"
+                              if self._journal is not None else None),
+                emit=self._emit_alert, probe=self._watch_probe,
+                log=self.log)
         if start:
             self.start()
 
@@ -661,6 +698,7 @@ class SwarmService:
                 self.telemetry.histogram("serve_retry_after_s").observe(
                     e.retry_after_s)
             self._adm.cancel(job)
+            self._sample_queue()
             if self._journal is not None:
                 self._req_path(rid).unlink(missing_ok=True)
             # a duplicate submit that attached during the reservation
@@ -676,6 +714,8 @@ class SwarmService:
             self.stats["accepted"] += 1
             orphaned = self._closed
         self.telemetry.counter("serve_accepted_total").inc()
+        self._sample_queue()   # depth is fresh the moment work exists —
+        #                        not at some future chunk boundary
         if orphaned:
             # close() raced this submit and its cleanup sweep already
             # ran: nobody is left to schedule the job, so honor the
@@ -698,6 +738,10 @@ class SwarmService:
         from __init__ for admission-control tests and staged recovery
         drills (``start=False``)."""
         self._pool.start()
+        if self.watch is not None:
+            # after the fleet: the first sample must see live
+            # worker_up gauges, not a pre-spawn fleet of zeros
+            self.watch.start()
 
     @property
     def alive(self) -> bool:
@@ -756,6 +800,11 @@ class SwarmService:
                 timeout, len(pending), E_SHUTDOWN)
         for job in pending:
             self._finish(job, FAILED, error=err, journal=False)
+        if self.watch is not None:
+            # before the trace log closes: the sampler's final tick
+            # covers the shutdown edge, and any last alert transition
+            # still lands in events.log
+            self.watch.stop()
         if self._span_dump is not None:
             # clean close: final flush, then disarm the atexit/SIGTERM
             # hooks so long-lived test processes don't accumulate them
@@ -1084,7 +1133,9 @@ class SwarmService:
                 job.ticket._push(ev)
             with self._lock:
                 self.stats["chunks"] += len(done_live)
-            self._adm.note_service((time.monotonic() - t0) / max(1, B))
+            dev_s = time.monotonic() - t0
+            self._adm.note_service(dev_s / max(1, B))
+            self._attribute_device(done_live, dev_s)
             self._sample_boundary(len(done_live), worker)
 
         with span("serve.round.resolve", **wat):
@@ -1443,8 +1494,11 @@ class SwarmService:
                     job.ticket._push(ev)
                 with self._lock:
                     self.stats["chunks"] += len(done_live)
-                self._adm.note_service(
-                    (time.monotonic() - pending.t0) / max(1, pending.B))
+                # the round's device span (dispatch -> sync complete):
+                # one wall window, attributed across the occupied rows
+                dev_s = time.monotonic() - pending.t0
+                self._adm.note_service(dev_s / max(1, pending.B))
+                self._attribute_device(done_live, dev_s)
                 self._sample_boundary(len(done_live), worker)
             with span("serve.round.resolve", **wat):
                 self._resolve_round_staged(pending, done_live,
@@ -1577,12 +1631,15 @@ class SwarmService:
                                   batch=1, bucket=str(job.bucket[0]))
         fn = {"assign": self._do_assign,
               "gains": self._do_gains,
-              "stats": self._do_stats}.get(kind) or self._kinds[kind]
+              "stats": self._do_stats,
+              "health": self._do_health}.get(kind) or self._kinds[kind]
         t0 = time.monotonic()
         value = self._execu.run(
             lambda: fn(job.req.params),
             stage=f"{kind}:{job.req.request_id}:w{worker.slot}")
-        self._adm.note_service(time.monotonic() - t0)
+        dev_s = time.monotonic() - t0
+        self._adm.note_service(dev_s)
+        self._attribute_device([job], dev_s)
         self._sample_boundary(1, worker)
         if self._stale(job, epoch):
             return                     # failed over mid-execution
@@ -1659,6 +1716,42 @@ class SwarmService:
         raise ValueError(f"unknown stats format {fmt!r} "
                          "(expected 'prometheus' or 'snapshot')")
 
+    def _do_health(self, params: dict):
+        """Built-in ``health`` kind: the live fleet-health surface as a
+        request, scrapeable over the wire front end exactly like
+        ``stats`` (docs/OBSERVABILITY.md §swarmwatch). Returns the SLO
+        verdicts + burn rates from the swarmwatch engine (null when
+        ``cfg.watch`` is off — liveness still reported), worker
+        liveness, queue/in-flight levels refreshed AT SCRAPE TIME (not
+        the last chunk boundary), and the service's promise counters —
+        everything codec-serializable, so it crosses the wire and the
+        journal unchanged."""
+        t = self.telemetry
+        self._watch_probe()            # a scrape reads NOW, not stale
+        per_worker = {}
+        for m in t.metrics():
+            if m.name == "serve_worker_up" \
+                    and m.labels.get("worker") is not None:
+                per_worker[m.labels["worker"]] = bool(m.value)
+        with self._lock:
+            counts = dict(self.stats)
+        out = {
+            "t_wall": time.time(),
+            "alive": bool(self.alive),
+            "watch_enabled": self.watch is not None,
+            "watch": (self.watch.health()
+                      if self.watch is not None else None),
+            "workers": {
+                "total": int(t.gauge("serve_workers_total").value),
+                "up": int(t.gauge("serve_workers_up").value),
+                "per_worker": per_worker,
+            },
+            "queue_depth": int(t.gauge("serve_queue_depth").value),
+            "inflight": int(t.gauge("serve_inflight").value),
+            "counts": counts,
+        }
+        return out
+
     # ------------------------------------------------------ finalization
 
     def _expired(self, job: _Job) -> bool:
@@ -1696,6 +1789,7 @@ class SwarmService:
             job.cancelled = reason
         if self._adm.cancel(job):      # was queued: cancel right now
             self._cancel_at_boundary(job)
+            self._sample_queue()
             return "queued"
         return "resident"
 
@@ -2055,6 +2149,50 @@ class SwarmService:
             t.histogram("serve_worker_occupancy_hist",
                         labels=lbl).observe(occ)
             t.counter("serve_worker_chunks_total", labels=lbl).inc(live)
+        t.gauge("serve_inflight").set(self._pool.inflight_total())
+
+    def _sample_queue(self) -> None:
+        """Refresh the queue-depth GAUGE outside chunk boundaries
+        (submit / reject / cancel / the watch probe): an idle or wedged
+        service must not show a stale depth forever — the gauge is the
+        liveness signal swarmwatch's queue-saturation and silent-loss
+        SLOs read, and chunk boundaries never come on an idle service.
+        Only the gauge: the ``*_hist`` distributions stay
+        boundary-sampled so the per-round statistics the committed
+        throughput artifact reports keep their sampling cadence."""
+        self.telemetry.gauge("serve_queue_depth").set(self._adm.pending())
+
+    def _watch_probe(self) -> None:
+        """Sampler pre-tick hook: refresh the liveness gauges so every
+        sample reads CURRENT state, not the last chunk boundary's."""
+        self._sample_queue()
+        self.telemetry.gauge("serve_inflight").set(
+            self._pool.inflight_total())
+
+    def _emit_alert(self, ev: dict) -> None:
+        """Append one swarmwatch alert transition to the journal's
+        events.log as a schema'd fleet-scope ``alert`` record (the
+        postmortem and the live surface share one stream). Unjournaled
+        services keep the in-memory engine state only."""
+        self._journal_event("alert", None, **ev)
+
+    def _attribute_device(self, jobs: list, span_s: float) -> None:
+        """Per-tenant device-time cost accounting: one round's device
+        span divided across the OCCUPIED batch rows into
+        ``serve_device_s{tenant,kind}`` counters — padding rows bill
+        nobody, so the counters sum to wall actually spent serving.
+        Makes per-tenant SLOs evaluable over the sampled series and
+        turns the round-robin fairness claim into a measured cost
+        series (the matching-under-drift framing needs per-tenant cost,
+        not spot checks)."""
+        if not jobs or span_s <= 0:
+            return
+        share = span_s / len(jobs)
+        for job in jobs:
+            self.telemetry.counter(
+                "serve_device_s",
+                labels={"tenant": job.req.tenant,
+                        "kind": job.req.kind}).inc(share)
 
     def serve_stats(self) -> ServeStats:
         """Plain-data swarmscope snapshot of this service's registry

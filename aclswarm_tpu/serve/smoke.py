@@ -182,9 +182,15 @@ def run_multiworker() -> int:
     assert want.ok
 
     with tempfile.TemporaryDirectory(prefix="aclswarm_mw_smoke_") as d:
+        # swarmwatch rides the drill (docs/OBSERVABILITY.md §swarmwatch):
+        # the kill below must surface on the LIVE health surface, not
+        # just in the postmortem journal. Rejoin backoff > sampler
+        # interval so the dead slot's gauge is sampled down at least
+        # once before the respawn flips it back.
         svc = SwarmService(ServiceConfig(
             workers=2, max_batch=1, quantum_chunks=8, journal_dir=d,
-            supervise_poll_s=0.02, rejoin_base_s=0.05))
+            supervise_poll_s=0.02, rejoin_base_s=0.3,
+            watch=True, watch_interval_s=0.05))
         # kill the worker that OWNS the rollout bucket, at its round 2:
         # one chunk done + checkpointed, the next mid-flight. The
         # rollout goes in ALONE so the victim's round schedule is
@@ -208,7 +214,30 @@ def run_multiworker() -> int:
         arm(None)
         stats = dict(svc.stats)
         alive_through = svc.alive
+        # the swarmwatch half of the drill: scrape the `health` kind
+        # (the same request surface a WireClient scrapes over TCP) and
+        # assert the kill is VISIBLE — a worker_up alert fired
+        health = svc.submit("health", {}, tenant="ops").result(60)
         svc.close()
+        if not health.ok or not health.value.get("watch_enabled"):
+            print(f"FAIL: health scrape unusable: {health.status}")
+            return 1
+        wu = (health.value.get("watch") or {}).get(
+            "verdicts", {}).get("worker_up", {})
+        if int(wu.get("fired", 0)) < 1:
+            print("FAIL: the worker kill never fired a worker_up alert "
+                  f"on the live health surface (verdict: {wu})")
+            return 1
+        from aclswarm_tpu.telemetry.lifecycle import LifecycleLog
+        alert_rows, _ = LifecycleLog.read(Path(d) / "events.log")
+        alert_fired = any(
+            r.get("event") == "alert" and r.get("slo") == "worker_up"
+            and r.get("state") == "firing" for r in alert_rows)
+        if not alert_fired:
+            print("FAIL: no worker_up firing alert record in the "
+                  "journal's events.log — the live surface and the "
+                  "postmortem stream disagree")
+            return 1
 
         losses = [rid for rid, res in results.items()
                   if res.status not in ("completed",)]
@@ -235,7 +264,9 @@ def run_multiworker() -> int:
     print("PASS: worker kill mid-batch lost nothing — 3/3 requests "
           f"terminal, rollout migrated off worker {slot} after "
           f"{roll_res.failovers} failover(s), resume bit-identical "
-          f"(digest {roll_res.value['digest']:#010x}), "
+          f"(digest {roll_res.value['digest']:#010x}); swarmwatch saw "
+          f"the kill live (worker_up fired {int(wu.get('fired', 0))}x "
+          "on the health surface + journaled alert record), "
           f"{time.time() - t0:.1f}s")
     return 0
 

@@ -20,6 +20,10 @@ service in one process) and records into it:
 - **per-tenant end-to-end latency** histograms
   (``serve_latency_s{tenant=...}``): accept -> terminal wall seconds,
   observed in `_finish` for every terminal status;
+- **per-tenant device-time cost** counters
+  (``serve_device_s{tenant,kind}``): each round's device span divided
+  across its occupied batch rows (swarmwatch cost accounting,
+  docs/OBSERVABILITY.md §swarmwatch);
 - **round spans** in the registry's flight recorder (name
   ``serve.round``, attrs: round index, bucket, batch size).
 
@@ -60,6 +64,11 @@ class ServeStats:
     trace_events: int = 0
     trace_lost: int = 0
     trace_spent_s: float = 0.0
+    # swarmwatch per-tenant device-time cost accounting
+    # (docs/OBSERVABILITY.md §swarmwatch): tenant -> {kind: seconds},
+    # each round's device span attributed across its occupied batch
+    # rows (serve_device_s{tenant,kind} counters)
+    device_s: dict = dataclasses.field(default_factory=dict)
 
     @classmethod
     def of(cls, service) -> "ServeStats":
@@ -74,8 +83,12 @@ class ServeStats:
         occ_row, dep_row = occ.to_row(), dep.to_row()
         lat = {}
         per_worker: dict = {}
+        device_s: dict = {}
         for m in reg.metrics():
-            if m.name == "serve_latency_s" and m.labels.get("tenant"):
+            if m.name == "serve_device_s" and m.labels.get("tenant"):
+                device_s.setdefault(m.labels["tenant"], {})[
+                    m.labels.get("kind", "?")] = round(float(m.value), 6)
+            elif m.name == "serve_latency_s" and m.labels.get("tenant"):
                 row = m.to_row()
                 lat[m.labels["tenant"]] = {
                     "count": row["count"],
@@ -113,7 +126,8 @@ class ServeStats:
             trace_lost=(service._trace.lost
                         if service._trace is not None else 0),
             trace_spent_s=(round(service._trace.spent_s, 6)
-                           if service._trace is not None else 0.0))
+                           if service._trace is not None else 0.0),
+            device_s=device_s)
 
     def compact(self) -> dict:
         """The bench-row summary: bucket occupancy, queue depth,

@@ -20,6 +20,15 @@ Three tiers, one substrate:
   causal request tracing — a `TraceContext` minted at submit, the
   schema'd journaled lifecycle-event stream, and postmortem timeline
   reconstruction from disk alone (docs/OBSERVABILITY.md §swarmtrace).
+- **swarmwatch** (`telemetry.timeseries` + `telemetry.slo` +
+  `telemetry.watch`): continuous memory and judgment over the registry
+  — a bounded `TimeSeriesStore` fed by a cadenced `Sampler` (history
+  persisted through the resilience frame log, readable from disk
+  alone), a declarative SLO catalog evaluated by a multi-window
+  burn-rate engine with a pending→firing→resolved alert state machine
+  (transitions journaled as schema'd ``alert`` fleet events), and the
+  live `watch` CLI / wire ``health`` kind
+  (docs/OBSERVABILITY.md §swarmwatch).
 
 This package __init__ stays stdlib-only on purpose: `utils.log` and
 `utils.timing` import it at configure time and must not drag jax in.
@@ -29,10 +38,16 @@ from aclswarm_tpu.telemetry.lifecycle import (LifecycleLog, TraceContext,
 from aclswarm_tpu.telemetry.registry import (Counter, Gauge, Histogram,
                                              MetricsRegistry, get_registry,
                                              reset_registry)
+from aclswarm_tpu.telemetry.slo import (SloEngine, SloSpec, SwarmWatch,
+                                        default_slos)
 from aclswarm_tpu.telemetry.spans import (FlightRecorder, Span, SpanDump,
                                           install_crash_dump)
+from aclswarm_tpu.telemetry.timeseries import (Sampler, TimeSeriesStore,
+                                               load_store)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "get_registry", "reset_registry", "FlightRecorder", "Span",
            "SpanDump", "install_crash_dump", "LifecycleLog",
-           "TraceContext", "mint_trace_id"]
+           "TraceContext", "mint_trace_id", "TimeSeriesStore", "Sampler",
+           "load_store", "SloSpec", "SloEngine", "SwarmWatch",
+           "default_slos"]

@@ -97,6 +97,13 @@ EVENTS: dict[str, frozenset] = {
 # whole batch; the per-request half of the story is its `migrated` event)
 FLEET_EVENTS: dict[str, frozenset] = {
     "failover": frozenset({"worker", "reason", "orphans"}),   # + retired
+    # swarmwatch (telemetry.slo): one record per alert state-machine
+    # transition — state is "firing" or "resolved" ("pending" never
+    # emits: a flap that clears before its dwell is suppressed, not
+    # archived). labels partitions one SLO into independent alerts
+    # (worker_up fires per worker; fleet-scope SLOs use "").
+    "alert": frozenset({"slo", "state", "labels"}),
+    #                                   + burn_short, burn_long, value
 }
 
 TERMINAL_EVENTS = ("resolved",)

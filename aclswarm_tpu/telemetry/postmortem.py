@@ -2,7 +2,7 @@
 journal alone (docs/OBSERVABILITY.md §swarmtrace).
 
     python -m aclswarm_tpu.telemetry.postmortem <journal-dir> \
-        [--request-id RID] [--json]
+        [--request-id RID] [--all] [--json]
 
 The serve journal is the ONLY input: the ``events.log`` lifecycle
 stream (`telemetry.lifecycle`, torn-tail-tolerant), the ``req_*.req``
@@ -46,7 +46,8 @@ from typing import Optional
 from aclswarm_tpu.telemetry.lifecycle import (EVENTS, TERMINAL_EVENTS,
                                               LifecycleLog)
 
-__all__ = ["load_journal", "analyze_request", "reconstruct", "main"]
+__all__ = ["load_journal", "analyze_request", "reconstruct",
+           "fleet_summary", "main"]
 
 EVENTS_LOG = "events.log"
 
@@ -280,6 +281,74 @@ def reconstruct(journal_dir, request_id: Optional[str] = None,
     }
 
 
+def fleet_summary(report: dict) -> dict:
+    """One-pass fleet rollup over a `reconstruct` report: verdict
+    counts, terminal-status census, chaos counters, and the AGGREGATE
+    per-stage latency table (sum / mean / max across every request) —
+    the `--all` CLI surface. Shares the loaders: the report is the
+    same object the per-request CLI renders."""
+    reqs = report["requests"]
+    statuses: dict[str, int] = {}
+    stages = {k: {"sum_s": 0.0, "max_s": 0.0} for k in STAGES}
+    migrations = preemptions = resumes = dup_chunks = chunks = 0
+    for rep in reqs.values():
+        statuses[str(rep.get("status"))] = \
+            statuses.get(str(rep.get("status")), 0) + 1
+        migrations += rep.get("migrations", 0)
+        preemptions += rep.get("preemptions", 0)
+        resumes += rep.get("resumes", 0)
+        dup_chunks += rep.get("duplicate_chunks", 0)
+        chunks += rep.get("chunks", 0)
+        for k, v in rep.get("stages", {}).items():
+            if k in stages and isinstance(v, (int, float)):
+                stages[k]["sum_s"] += v
+                stages[k]["max_s"] = max(stages[k]["max_s"], v)
+    n = max(1, len(reqs))
+    for k in stages:
+        stages[k] = {"sum_s": round(stages[k]["sum_s"], 6),
+                     "mean_s": round(stages[k]["sum_s"] / n, 6),
+                     "max_s": round(stages[k]["max_s"], 6)}
+    return {
+        "journal": report["journal"],
+        "accepted": report["accepted"],
+        "reconstructed": report["reconstructed"],
+        "complete": report["complete"],
+        "gap_free": report["gap_free"],
+        "events": report["events"],
+        "torn_tail": report["torn_tail"],
+        "statuses": statuses,
+        "chunks": chunks,
+        "duplicate_chunks": dup_chunks,
+        "migrations": migrations,
+        "preemptions": preemptions,
+        "resumes": resumes,
+        "stages": stages,
+        "incomplete": sorted(rid for rid, r in reqs.items()
+                             if not (r["complete"] and r["gap_free"])),
+    }
+
+
+def _print_fleet(summary: dict) -> None:
+    print(f"journal {summary['journal']}: {summary['accepted']} "
+          f"accepted, {summary['reconstructed']} reconstructed — "
+          f"{summary['complete']} complete, {summary['gap_free']} "
+          f"gap-free"
+          + (" [torn tail dropped]" if summary["torn_tail"] else ""))
+    print(f"  statuses: {json.dumps(summary['statuses'], sort_keys=True)}")
+    print(f"  chunks {summary['chunks']} "
+          f"(dup {summary['duplicate_chunks']})  "
+          f"migrations {summary['migrations']}  "
+          f"preemptions {summary['preemptions']}  "
+          f"resumes {summary['resumes']}  events {summary['events']}")
+    print(f"  {'stage':<16} {'sum_s':>10} {'mean_s':>10} {'max_s':>10}")
+    for k in STAGES:
+        st = summary["stages"][k]
+        print(f"  {k:<16} {st['sum_s']:>10.3f} {st['mean_s']:>10.3f} "
+              f"{st['max_s']:>10.3f}")
+    for rid in summary["incomplete"]:
+        print(f"  PROBLEM: {rid} does not reconstruct complete+gap-free")
+
+
 def _fmt_event(r: dict, t0: float) -> str:
     skip = {"event", "request_id", "trace_id", "t_wall", "t_mono",
             "seq", "pid"}
@@ -293,11 +362,23 @@ def main(argv=None) -> int:
     ap.add_argument("journal", help="serve journal directory")
     ap.add_argument("--request-id", default=None,
                     help="reconstruct one request (default: all)")
+    ap.add_argument("--all", action="store_true", dest="fleet",
+                    help="one-pass fleet summary over every request "
+                         "(verdict counts + aggregate per-stage latency "
+                         "table) instead of per-request timelines")
     ap.add_argument("--json", action="store_true",
                     help="emit the full machine-readable report")
     args = ap.parse_args(argv)
     report = reconstruct(args.journal, request_id=args.request_id,
-                         timelines=True)
+                         timelines=not args.fleet)
+    if args.fleet:
+        summary = fleet_summary(report)
+        if args.json:
+            print(json.dumps(summary, indent=1, sort_keys=True,
+                             default=str))
+        else:
+            _print_fleet(summary)
+        return 0 if not summary["incomplete"] else 1
     if args.json:
         print(json.dumps(report, indent=1, sort_keys=True, default=str))
     else:

@@ -298,10 +298,16 @@ class MetricsRegistry:
         return out
 
     def to_jsonl(self) -> str:
-        """JSON-lines export: one line per metric, then one per retained
-        span (the artifact format `check_results.py` understands)."""
+        """JSON-lines export: one line per metric, a span-census line
+        (recorded/dropped — ring drops under load must be first-class,
+        not silent), then one per retained span (the artifact format
+        `check_results.py` understands)."""
         lines = [json.dumps(m.to_row(), sort_keys=True)
                  for m in self.metrics()]
+        lines.append(json.dumps(
+            {"name": "spans_dropped_total", "kind": "counter",
+             "value": self.recorder.dropped,
+             "recorded": self.recorder.recorded}, sort_keys=True))
         lines += [json.dumps(s.to_row(), sort_keys=True)
                   for s in self.spans()]
         return "\n".join(lines) + ("\n" if lines else "")
@@ -338,7 +344,14 @@ class MetricsRegistry:
                 lines.append(f"# TYPE {name} {m.kind}")
                 lines.append(f"{name}{_fmt_labels(m.labels)} "
                              f"{_fmt_num(m.value)}")
-        return "\n".join(lines) + ("\n" if lines else "")
+        # flight-recorder census: a scraper must see span LOSS, not just
+        # the spans that survived the ring — otherwise a wrapped ring
+        # under load reads as "all quiet" exactly when it is lossy
+        lines.append("# TYPE spans_recorded_total counter")
+        lines.append(f"spans_recorded_total {self.recorder.recorded}")
+        lines.append("# TYPE spans_dropped_total counter")
+        lines.append(f"spans_dropped_total {self.recorder.dropped}")
+        return "\n".join(lines) + "\n"
 
 
 def _escape_help(s: str) -> str:

@@ -126,6 +126,10 @@ class SpanDump:
         self._lock = threading.Lock()
         self._dead = False
         self.dumps = 0
+        self.drops = 0           # recorder drop count at the last dump:
+        #                          the span-loss census a postmortem of
+        #                          the dump file can trust (the ring may
+        #                          be gone with the process by then)
         # set by install_crash_dump when a SIGTERM hook was chained:
         # (our handler object, the disposition it replaced) — uninstall
         # restores `prev` when ours is still the installed handler
@@ -139,10 +143,11 @@ class SpanDump:
             if self._dead or self.recorder is None:
                 return 0
             rows = self.recorder.to_rows()
+            self.drops = self.recorder.dropped
             header = {"span_dump": reason, "t_wall": time.time(),
                       "pid": os.getpid(), "spans": len(rows),
                       "recorded": self.recorder.recorded,
-                      "dropped": self.recorder.dropped}
+                      "dropped": self.drops}
             try:
                 with open(self.path, "a") as f:
                     f.write(json.dumps(header, sort_keys=True) + "\n")

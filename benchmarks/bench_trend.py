@@ -103,6 +103,30 @@ def overload_rows(results_dir: Path | None = None) -> list[dict]:
     return rows
 
 
+def slo_detection_rows(results_dir: Path | None = None) -> list[dict]:
+    """Trend-shaped rows from the committed slo_detection artifact
+    (benchmarks/slo_soak.py): ``slo_detection_p95`` — the kill→alert
+    detection latency p95 (s, lower-better) swarmwatch proves. Joins
+    the series map exactly like the overload rows: as the pseudo-round
+    after the newest capture."""
+    results_dir = results_dir or (ROOT / "benchmarks" / "results")
+    path = results_dir / "slo_detection.json"
+    if not path.exists():
+        return []
+    try:
+        r = json.loads(path.read_text())
+    except json.JSONDecodeError:
+        return []
+    if not isinstance(r, dict) or r.get("quick"):
+        return []
+    det = r.get("detection_s")
+    p95 = det.get("p95") if isinstance(det, dict) else None
+    if not isinstance(p95, (int, float)) or p95 <= 0:
+        return []
+    return [{"name": "slo_detection_p95", "value": p95, "unit": "s",
+             "n": r.get("n"), "backend": r.get("backend")}]
+
+
 def _comparable(row: dict) -> bool:
     v = row.get("value")
     return (isinstance(v, (int, float)) and not isinstance(v, bool)
@@ -151,9 +175,16 @@ def trend(directory: Path, threshold: float) -> tuple[list[str], int]:
     # a bare captures directory falls back to THIS repo's committed
     # results — the overload gate must not silently vanish just
     # because --dir pointed somewhere without a benchmarks/ tree
-    cur = overload_rows(directory / "benchmarks" / "results")
-    if not cur and directory.resolve() != ROOT.resolve():
-        cur = overload_rows()
+    res_dir = directory / "benchmarks" / "results"
+    over = overload_rows(res_dir)
+    slo = slo_detection_rows(res_dir)
+    if directory.resolve() != ROOT.resolve():
+        # PER-FAMILY fallback to this repo's committed results: a
+        # capture dir carrying one artifact but not the other must not
+        # silently drop the missing family's gate
+        over = over or overload_rows()
+        slo = slo or slo_detection_rows()
+    cur = over + slo
     if cur:
         nxt = (rounds[-1][0] if rounds else 0) + 1
         rounds.extend((nxt, r) for r in cur)

@@ -361,6 +361,138 @@ def check_serve_overload(rows: list, where: str) -> list[str]:
     return probs
 
 
+# the swarmwatch SLO-detection artifact (benchmarks/slo_soak.py;
+# docs/OBSERVABILITY.md §swarmwatch): summary-shaped, exact key set,
+# and the ISSUE-15 acceptance bars baked in AS schema — every scripted
+# worker kill detected (a worker_up alert fired, or was already firing
+# from a repeated kill inside the clear dwell) within the committed
+# bound, ZERO false-positive alerts in the clean control soak, sampler
+# overhead under 2% of soak wall, and the persisted time-series
+# history actually readable from disk. An artifact that stops proving
+# detection is rejected, not quietly re-interpreted.
+SLO_DETECTION = "slo_detection.json"
+_SLO_COUNTS = ("workers", "tenants", "accepted", "completed",
+               "silent_losses", "kills", "detected", "already_firing",
+               "alerts_fired", "alerts_resolved", "sampler_samples",
+               "persist_lost", "persisted_ticks", "series",
+               "control_accepted", "control_completed",
+               "false_positives")
+_SLO_KEYS = set(_SLO_COUNTS) | {"name", "n", "backend", "detection_s",
+                                "bound_s", "watch_interval_s",
+                                "sampler_overhead_frac",
+                                "control_overhead_frac", "wall_s",
+                                "quick"}
+_SLO_DETECTION_PCTS = ("p50", "p95", "max")
+_SLO_OVERHEAD_BAR = 0.02
+_SLO_BOUND_CAP_S = 5.0
+
+
+def check_slo_detection(obj, where: str) -> list[str]:
+    """Validate the slo_detection summary: exact key set, and the
+    detection acceptance bars AS schema (100% of kills detected within
+    the bound, zero control false positives, <2% sampler overhead,
+    history persisted)."""
+    if not isinstance(obj, dict):
+        return [f"{where}: not a JSON object"]
+    probs = []
+    missing, unknown = _SLO_KEYS - set(obj), set(obj) - _SLO_KEYS
+    if missing:
+        probs.append(f"{where}: missing keys {sorted(missing)}")
+    if unknown:
+        probs.append(f"{where}: unknown keys {sorted(unknown)} "
+                     "(exact-key-set schema)")
+    if obj.get("name") != "slo_detection":
+        probs.append(f"{where}: 'name' must be 'slo_detection'")
+    for k in _SLO_COUNTS:
+        if k in obj and not _is_count(obj[k]):
+            probs.append(f"{where}: '{k}' must be a non-negative int, "
+                         f"got {obj[k]!r}")
+    if _is_count(obj.get("kills")) and _is_count(obj.get("detected")) \
+            and obj["detected"] != obj["kills"]:
+        probs.append(
+            f"{where}: detected ({obj['detected']}) != kills "
+            f"({obj['kills']}) — EVERY scripted kill must raise (or "
+            "land inside) a worker_up alert (the acceptance bar)")
+    for k in ("silent_losses", "false_positives", "persist_lost"):
+        if obj.get(k) not in (0, None):
+            probs.append(f"{where}: {k} must be 0 (got {obj.get(k)!r})")
+    for pair in (("completed", "accepted"),
+                 ("control_completed", "control_accepted")):
+        if all(_is_count(obj.get(k)) for k in pair) \
+                and obj[pair[0]] != obj[pair[1]]:
+            probs.append(f"{where}: {pair[0]} ({obj[pair[0]]}) != "
+                         f"{pair[1]} ({obj[pair[1]]}) — the soak mix "
+                         "must fully complete")
+    if _is_count(obj.get("persisted_ticks")) \
+            and obj["persisted_ticks"] < 1:
+        probs.append(f"{where}: persisted_ticks must be >= 1 — the "
+                     "history must be readable from disk alone")
+    bound = obj.get("bound_s")
+    if not (_finite_num(bound) and 0 < bound <= _SLO_BOUND_CAP_S):
+        probs.append(f"{where}: 'bound_s' must be a finite number in "
+                     f"(0, {_SLO_BOUND_CAP_S}], got {bound!r} — "
+                     "'bounded latency' means a real bound")
+    det = obj.get("detection_s")
+    if not isinstance(det, dict):
+        probs.append(f"{where}: 'detection_s' must be an object")
+    else:
+        miss = set(_SLO_DETECTION_PCTS) - set(det)
+        unk = set(det) - set(_SLO_DETECTION_PCTS)
+        if miss:
+            probs.append(f"{where}: detection_s missing {sorted(miss)}")
+        if unk:
+            probs.append(f"{where}: detection_s unknown keys "
+                         f"{sorted(unk)}")
+        vals = [det.get(k) for k in _SLO_DETECTION_PCTS]
+        for k, v in zip(_SLO_DETECTION_PCTS, vals):
+            if v is not None and not (_finite_num(v) and v >= 0):
+                probs.append(f"{where}: detection_s.{k} must be a "
+                             f"finite non-negative number, got {v!r}")
+        if all(_finite_num(v) and v >= 0 for v in vals):
+            if not (vals[0] <= vals[1] <= vals[2]):
+                probs.append(f"{where}: detection percentiles must be "
+                             f"non-decreasing, got {vals}")
+            if _finite_num(bound) and vals[2] > bound:
+                probs.append(
+                    f"{where}: max detection latency {vals[2]} s over "
+                    f"the committed {bound} s bound — detection is not "
+                    "bounded")
+    for k in ("sampler_overhead_frac", "control_overhead_frac"):
+        v = obj.get(k)
+        if not (_finite_num(v) and v >= 0):
+            probs.append(f"{where}: '{k}' must be a finite non-negative "
+                         f"number, got {v!r}")
+        elif v >= _SLO_OVERHEAD_BAR:
+            probs.append(f"{where}: {k} {v} breaches the < "
+                         f"{_SLO_OVERHEAD_BAR} acceptance bar")
+    if "watch_interval_s" in obj and not (
+            _finite_num(obj["watch_interval_s"])
+            and obj["watch_interval_s"] > 0):
+        probs.append(f"{where}: 'watch_interval_s' must be a positive "
+                     "number")
+    if "quick" in obj and not isinstance(obj["quick"], bool):
+        probs.append(f"{where}: 'quick' must be a bool")
+    if not obj.get("quick"):
+        # the committed (non-quick) artifact IS the acceptance evidence
+        if _is_count(obj.get("workers")) and obj["workers"] < 3:
+            probs.append(f"{where}: committed soak needs >= 3 workers, "
+                         f"got {obj['workers']}")
+        if _is_count(obj.get("kills")) and obj["kills"] < 3:
+            probs.append(f"{where}: committed soak owes >= 3 scripted "
+                         f"kills, got {obj.get('kills')}")
+        if _is_count(obj.get("alerts_resolved")) \
+                and obj["alerts_resolved"] < 1:
+            probs.append(f"{where}: committed soak recorded no resolved "
+                         "alert — the state machine never closed")
+    if "wall_s" in obj and not (_finite_num(obj["wall_s"])
+                                and obj["wall_s"] >= 0):
+        probs.append(f"{where}: 'wall_s' must be a finite non-negative "
+                     f"number, got {obj['wall_s']!r}")
+    if "n" in obj and not (_is_count(obj["n"]) and obj["n"] > 0):
+        probs.append(f"{where}: 'n' must be a positive int")
+    return probs
+
+
 # the telemetry overhead artifact (aclswarm_tpu.telemetry.overhead):
 # exact key set per named row, and the <5% acceptance bar is part of
 # the schema — an artifact showing a regression must not pass silently
@@ -912,6 +1044,10 @@ def check_file(path: Path) -> list[str]:
         if whole is None:
             return [f"{path.name}: unparseable trace-soak artifact"]
         return check_trace_soak(whole, path.name)
+    if path.name == SLO_DETECTION:
+        if whole is None:
+            return [f"{path.name}: unparseable slo-detection artifact"]
+        return check_slo_detection(whole, path.name)
     if path.name in (SERVE_THROUGHPUT, TELEMETRY_OVERHEAD,
                      SERVE_BREAKDOWN, SCENARIO_SUITE, SERVE_OVERLOAD):
         rows, probs = [], []

@@ -59,7 +59,7 @@ from check_results import RESULTS, check_file  # noqa: E402
 for name in ("serve_throughput.json", "telemetry_overhead.json",
              "serve_multiworker_soak.json", "trace_soak.json",
              "serve_latency_breakdown.json", "scenario_suite.json",
-             "serve_overload.json"):
+             "serve_overload.json", "slo_detection.json"):
     path = RESULTS / name
     if not path.exists():
         print(f"FAIL: missing owed artifact benchmarks/results/{name}")
@@ -88,7 +88,10 @@ JAX_PLATFORMS=cpu python -m aclswarm_tpu.serve.smoke
 
 echo "== multi-worker crash-failover smoke: kill one of two workers =="
 echo "== mid-batch — zero loss, bit-identical migrated resume, the =="
-echo "== service keeps serving (docs/SERVICE.md §multi-worker) =="
+echo "== service keeps serving (docs/SERVICE.md §multi-worker). =="
+echo "== Doubles as the swarmwatch smoke: the kill must fire a =="
+echo "== worker_up alert on the live 'health' surface AND land as a =="
+echo "== journaled alert record (docs/OBSERVABILITY.md §swarmwatch) =="
 JAX_PLATFORMS=cpu python -m aclswarm_tpu.serve.smoke --multiworker
 
 echo "== swarmtrace postmortem smoke: kill a worker mid-rollout, =="
@@ -137,12 +140,13 @@ else
     echo "no tier-1 log at $T1_LOG — skipping (run tier-1 first)"
 fi
 
-echo "== guard self-tests (lint fixtures, audit grid, invariant contracts, resilience, serve, wire, traffic, telemetry, trace, scenarios) =="
+echo "== guard self-tests (lint fixtures, audit grid, invariant contracts, resilience, serve, wire, traffic, telemetry, trace, watch, scenarios) =="
 exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_analysis.py tests/test_invariants.py \
     tests/test_results_schema.py tests/test_resilience.py \
     tests/test_serve.py tests/test_serve_wire.py \
     tests/test_traffic.py \
     tests/test_telemetry.py tests/test_trace.py \
+    tests/test_watch.py \
     tests/test_scenarios.py \
     -q -m 'not slow' -p no:cacheprovider

@@ -705,6 +705,7 @@ class TestOperator:
 
 @needs_native
 class TestBridgeLifecycle:
+    @pytest.mark.slow
     def test_takeoff_fly_land_kill_over_wire(self):
         """The whole flight lifecycle wire-only: an operator broadcasts
         GO/LAND/KILL `FlightMode` messages and dispatches a `Formation`;
