@@ -312,6 +312,30 @@ def _build_admm(gp: GridPoint):
     return args, {"planar": False, "params": AdmmParams()}
 
 
+def _build_admm_warm(gp: GridPoint):
+    # the warm-start variant: same graph, seeded with the COLD carry
+    # (`init_carry` — the seed whose warm solve is bit-identical to the
+    # cold path, so this trace is the dispatch-loop re-seed program)
+    from aclswarm_tpu.gains.admm import init_carry
+    args, kw = _build_admm(gp)
+    return args, dict(kw, carry=init_carry(gp.n, planar=False))
+
+
+def _build_admm_batch(gp: GridPoint, B: int = 2):
+    # the vmapped designer: the serial builder's formation stacked B
+    # times (shared constraint bucket, shared planarity statics)
+    args, kw = _build_admm(gp)
+    return tuple(np.stack([np.asarray(a)] * B) for a in args), kw
+
+
+def _build_cbaa_warm(gp: GridPoint):
+    import jax.numpy as jnp
+
+    from aclswarm_tpu.assignment.cbaa import init_tables
+    args, _ = _build_cbaa(gp)
+    return args, {"warm": init_tables(gp.n, dtype=jnp.float32)}
+
+
 def _build_planner_tick(gp: GridPoint):
     import jax.numpy as jnp
 
@@ -448,6 +472,21 @@ def _install_default_registry() -> None:
                    build=_build_cbaa)
     register_entry("gains.admm.solve", admm._solve_jit,
                    static_argnames=("planar", "params"), build=_build_admm)
+    # warm-pipeline variants (ROADMAP item 1): the carry-threaded ADMM
+    # re-seed, the vmapped batch designer, and the table-seeded CBAA
+    # re-auction must be transfer-free, cache-stable, and f64-clean
+    # like every other entry point. Baseline-participating ADDITIONS:
+    # the unseeded `gains.admm.solve` / `assignment.cbaa.cbaa_from_state`
+    # digests are unchanged (carry=None / warm=None lower to the
+    # identical programs — the zero-cost-off claim).
+    register_entry("gains.admm.solve[warm]", admm._solve_jit,
+                   static_argnames=("planar", "params"),
+                   build=_build_admm_warm)
+    register_entry("gains.admm.solve_batch", admm._solve_batch_jit,
+                   static_argnames=("planar", "params"),
+                   build=_build_admm_batch)
+    register_entry("assignment.cbaa.cbaa_from_state[warm]",
+                   cbaa.cbaa_from_state, build=_build_cbaa_warm)
     register_entry("interop.planner.tick", planner._tick,
                    static_argnames=("cfg",), build=_build_planner_tick,
                    axes=("n", "solver", "localization"))

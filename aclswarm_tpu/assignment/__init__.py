@@ -12,8 +12,9 @@ Four modes, mirroring + extending the reference's two paths (SURVEY.md §2.5):
 """
 from aclswarm_tpu.assignment.auction import (AuctionResult, assign_min_dist,
                                              auction_lap)
-from aclswarm_tpu.assignment.cbaa import (CBAAResult, bid_prices, cbaa_assign,
-                                          cbaa_from_state)
+from aclswarm_tpu.assignment.cbaa import (CBAAResult, CbaaTables, bid_prices,
+                                          cbaa_assign, cbaa_from_state,
+                                          init_tables)
 from aclswarm_tpu.assignment.lapjv import lapjv, solve_assignment_host
 from aclswarm_tpu.assignment.sinkhorn import (SinkhornResult, round_dominant,
                                               round_parallel,
@@ -24,6 +25,7 @@ from aclswarm_tpu.assignment.sinkhorn import (SinkhornResult, round_dominant,
 __all__ = [
     "auction_lap", "assign_min_dist", "AuctionResult",
     "cbaa_assign", "cbaa_from_state", "bid_prices", "CBAAResult",
+    "CbaaTables", "init_tables",
     "lapjv", "solve_assignment_host",
     "sinkhorn_assign", "sinkhorn_log", "round_to_permutation",
     "round_parallel", "round_dominant", "two_opt_refine",

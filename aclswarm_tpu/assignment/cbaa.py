@@ -65,6 +65,35 @@ class CBAAResult(NamedTuple):
     rounds: jnp.ndarray  # () int32: bid rounds actually executed
 
 
+class CbaaTables(NamedTuple):
+    """The persistent auction state threaded ACROSS auctions (ROADMAP
+    open item 1's CBAA warm start): the (n, n) price and winner tables
+    a finished auction left behind (`CBAAResult.price`/`.who`). The
+    reference cannot carry them — each `Auctioneer::start` wipes its
+    maps (`auctioneer.cpp:100-105`) because the per-vehicle processes
+    are stateless between formations — but the bulk-synchronous form
+    holds all n tables in one array and can re-seed the next auction
+    from the last fixed point: when the fleet barely moved between the
+    dispatch-cadence auctions, consensus re-converges in a handful of
+    rounds instead of up to 2n. A NamedTuple, so a pytree: it rides
+    the `SimState` scan carry, the resilience checkpoint codec, and
+    serve requests unchanged."""
+
+    price: jnp.ndarray   # (n, n) per-agent price tables
+    who: jnp.ndarray     # (n, n) per-agent winner tables
+
+
+def init_tables(n: int, dtype=None) -> CbaaTables:
+    """The COLD auction start as tables: empty prices, no winners
+    (`auctioneer.cpp:100-105`). Seeding `cbaa_assign(warm=...)` with
+    `init_tables` is bit-identical in value to the table-free cold
+    auction (pinned by tests/test_assignment.py), so drivers thread
+    one tables variable from the first auction on."""
+    dtype = dtype or jnp.result_type(float)
+    return CbaaTables(price=jnp.zeros((n, n), dtype=dtype),
+                      who=jnp.full((n, n), -1, dtype=jnp.int32))
+
+
 def bid_prices(q_veh: jnp.ndarray, paligned: jnp.ndarray) -> jnp.ndarray:
     """Candidate prices: price[v, j] = 1 / (||q_v - paligned_v[j]|| + eps).
 
@@ -164,7 +193,10 @@ def cbaa_assign(q_veh: jnp.ndarray,
                 task_block: Optional[int] = None,
                 early_exit: bool = True,
                 alive: Optional[jnp.ndarray] = None,
-                comm_extra: Optional[jnp.ndarray] = None) -> CBAAResult:
+                comm_extra: Optional[jnp.ndarray] = None,
+                warm: Optional[CbaaTables] = None,
+                assign_eps: float = 0.0,
+                first: Optional[jnp.ndarray] = None) -> CBAAResult:
     """Run a full synchronous CBAA auction on device.
 
     Args:
@@ -197,6 +229,31 @@ def cbaa_assign(q_veh: jnp.ndarray,
       comm_extra: optional (n, n) bool — per-auction link degradation
         (dead endpoints, lossy links) ANDed onto the consensus graph.
         Self-loops never drop (an agent always sees its own table).
+      warm: optional `CbaaTables` — seed from a previous auction's fixed
+        point instead of the cold empty start: the carried WINNER LIST
+        is re-priced at the winners' fresh bids before the initial
+        greedy bid (raw stale prices would ratchet-lock under
+        max-consensus — see the seeding comment below). Unchanged
+        geometry re-converges in one round; moved agents open a normal
+        outbid/rebid cascade from the near-solution. Seeding with
+        `init_tables` is bit-identical in value to None; None is
+        Python-gated, so the cold path's lowered HLO is the committed
+        baseline. The incumbent bias is real lag: an equal-or-worse
+        candidate never displaces the carried assignment — the
+        churn/lag trade benchmarks/pipeline_rate.py publishes.
+      assign_eps: relative cost-improvement hysteresis on the RESULT
+        (`SimConfig.assign_eps`, here at the CBAA level): the returned
+        ``v2f`` keeps ``v2f_prev`` unless the candidate assignment
+        improves the summed own-aligned-point distance by this margin.
+        0.0 (the default) is Python-gated — the accept-any-valid
+        reference semantics and the committed-baseline HLO. ``price``/
+        ``who``/``f2v`` stay the raw consensus outcome either way (the
+        tables are the auction's state; hysteresis only vetoes the
+        *acted-on* assignment).
+      first: optional () bool — the first auction after a formation
+        dispatch bypasses the hysteresis (`formation_just_received_`,
+        `auctioneer.cpp:310-316`), exactly like the centralized
+        solvers' `sim.engine.assign` gate.
 
     Returns a `CBAAResult`; `valid` mirrors the reference's detect-and-skip
     recovery for non-permutation outcomes (`auctioneer.cpp:283-292`).
@@ -217,10 +274,48 @@ def cbaa_assign(q_veh: jnp.ndarray,
         myprice = jnp.where(alive[:, None] & alive_pt[None, :], myprice,
                             jnp.zeros((), myprice.dtype))
 
-    # START bids (auctioneer.cpp:100-105): empty tables + initial greedy bid
-    price0 = jnp.zeros((n, n), dtype=myprice.dtype)
-    who0 = jnp.full((n, n), -1, dtype=jnp.int32)
-    price0, who0 = _select_task(myprice, price0, who0, vehids)
+    # START bids (auctioneer.cpp:100-105): empty tables + initial greedy
+    # bid — or, when warm, the previous auction's WINNER LIST re-priced
+    # at the winners' fresh bids. Raw stale prices cannot be carried:
+    # max-consensus only ever raises a price, so a stale high bid would
+    # ratchet-lock its task (and an agent that switched tasks would
+    # orphan its old entry into a permanent non-permutation). Projecting
+    # the carried assignment onto the CURRENT geometry keeps the two
+    # properties the warm start is for — unchanged geometry re-converges
+    # in one round (nobody can strictly outbid the incumbent's fresh
+    # price), while a genuinely better bid still opens a normal
+    # outbid/rebid cascade. An empty carry (`init_tables`) projects to
+    # the cold tables bit-identically.
+    if warm is None:
+        price0 = jnp.zeros((n, n), dtype=myprice.dtype)
+        who0 = jnp.full((n, n), -1, dtype=jnp.int32)
+        price0, who0 = _select_task(myprice, price0, who0, vehids)
+    else:
+        tasks = jnp.arange(n)
+        f2v_c = warm.who[0].astype(jnp.int32)     # carried winner list
+        held = f2v_c >= 0
+        # release-at-seed: an incumbent keeps its carried task only if
+        # that task is still its own best bid — otherwise the entry is
+        # cleared and the ex-holder bids fresh. (Max-consensus has no
+        # release: keeping the entry while its holder bids elsewhere
+        # would orphan it into a permanent non-permutation.)
+        pref = jnp.argmax(myprice, axis=1)
+        keep = held & (pref[f2v_c] == tasks)
+        wprice = jnp.where(keep, myprice[f2v_c, tasks],
+                           jnp.zeros((), myprice.dtype))
+        price0 = jnp.broadcast_to(wprice[None, :], (n, n)) \
+            .astype(myprice.dtype)
+        who0 = jnp.broadcast_to(jnp.where(keep, f2v_c, -1)[None, :],
+                                (n, n)).astype(jnp.int32)
+        # kept incumbents sit out the initial greedy bid (their seeded
+        # entry IS their fresh bid; `_select_task` would voluntarily
+        # move them to a worse-but-open task). They re-enter through
+        # the normal outbid/rebid path like any settled agent.
+        kept_agent = jnp.zeros((n,), bool).at[
+            jnp.where(keep, f2v_c, n)].set(True, mode="drop")
+        bid_price = jnp.where(kept_agent[:, None],
+                              jnp.zeros((), myprice.dtype), myprice)
+        price0, who0 = _select_task(bid_price, price0, who0, vehids)
 
     def one_round(price, who):
         newp, neww, outbid = _consensus_round(price, who, comm_mask, vehids,
@@ -278,13 +373,30 @@ def cbaa_assign(q_veh: jnp.ndarray,
         valid = jnp.any(alive) & agree & permutil.is_valid(f2v)
     safe_f2v = jnp.where(valid, f2v, jnp.arange(n, dtype=jnp.int32))
     v2f = permutil.invert(safe_f2v)
+    if assign_eps > 0.0:
+        # churn-only re-assignment veto (`SimConfig.assign_eps`, at the
+        # CBAA level): accept the consensus assignment only if it
+        # improves each agent's own-aligned-point distance in total by
+        # the relative margin. Dead-pinned agents hold the same point
+        # in both candidates, so their (equal) terms cancel. Python-
+        # gated on the static 0.0 default: the reference's accept-any-
+        # valid semantics and the committed-baseline HLO are untouched.
+        bypass = jnp.asarray(False) if first is None else first
+        d = jnp.linalg.norm(q_veh[:, None, :] - paligned, axis=-1)
+        rows = jnp.arange(n)
+        # jaxcheck: disable=JC006 — dead-pinned terms cancel (see above)
+        cost_new = jnp.sum(d[rows, v2f])
+        cost_cur = jnp.sum(d[rows, v2f_prev])   # jaxcheck: disable=JC006
+        take = (cost_new < (1.0 - assign_eps) * cost_cur) | bypass
+        v2f = jnp.where(take, v2f, v2f_prev)
     return CBAAResult(v2f=v2f, f2v=f2v, valid=valid, price=price, who=who,
                       rounds=rounds)
 
 
 def cbaa_from_state(q_veh, formation_points, adjmat, v2f_prev, n_iters=None,
                     est=None, task_block=None, early_exit=True,
-                    alive=None, comm_extra=None):
+                    alive=None, comm_extra=None, warm=None,
+                    assign_eps=0.0, first=None):
     """Convenience wrapper: local alignment + auction, the full `start()` ->
     consensus pipeline of `auctioneer.cpp:78-120` for the whole swarm.
 
@@ -298,9 +410,13 @@ def cbaa_from_state(q_veh, formation_points, adjmat, v2f_prev, n_iters=None,
     alignment deliberately stays unmasked — a dead vehicle keeps
     anchoring its neighbors' alignments at its frozen position, exactly
     like a silent-but-remembered vehicle in the reference (its last
-    flooded estimate persists in every tracker)."""
+    flooded estimate persists in every tracker).
+
+    ``warm``/``assign_eps``/``first``: warm-start tables and the
+    churn-veto hysteresis, see `cbaa_assign`."""
     paligned = geometry.align_formation_local(
         q_veh, formation_points, adjmat, v2f_prev, est=est)
     return cbaa_assign(q_veh, paligned, adjmat, v2f_prev, n_iters=n_iters,
                        task_block=task_block, early_exit=early_exit,
-                       alive=alive, comm_extra=comm_extra)
+                       alive=alive, comm_extra=comm_extra, warm=warm,
+                       assign_eps=assign_eps, first=first)

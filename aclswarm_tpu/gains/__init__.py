@@ -4,9 +4,12 @@
 - ``reference`` — sequential NumPy mirror of the C++ solver, the test oracle
                   (matches `test_admm.cpp` goldens to machine precision).
 """
-from aclswarm_tpu.gains.admm import (AdmmSolveStats, solve_gains,
-                                     solve_gains_blocks, validate_gains)
+from aclswarm_tpu.gains.admm import (AdmmCarry, AdmmSolveStats, init_carry,
+                                     planar_of, solve_gains,
+                                     solve_gains_batch, solve_gains_blocks,
+                                     solve_gains_f32, validate_gains)
 from aclswarm_tpu.gains.reference import AdmmParams
 
-__all__ = ["AdmmSolveStats", "solve_gains", "solve_gains_blocks",
-           "validate_gains", "AdmmParams"]
+__all__ = ["AdmmCarry", "AdmmSolveStats", "init_carry", "planar_of",
+           "solve_gains", "solve_gains_batch", "solve_gains_blocks",
+           "solve_gains_f32", "validate_gains", "AdmmParams"]

@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -67,6 +68,57 @@ class AdmmSolveStats:
 
     iters: int
     residual: float
+
+
+class AdmmCarry(NamedTuple):
+    """Persistent solver state threaded ACROSS dispatches (ROADMAP open
+    item 1): the final (X, S) iterates of both subproblems plus the
+    iteration count the producing solve took. The reference re-solves
+    cold only because its per-vehicle ROS processes are stateless
+    (`solver.cpp:264-347` always starts from X = tile(eye), S = 0); our
+    dispatches aren't — re-seeding the next formation's solve from the
+    last fixed point reaches tolerance in ~2 iterations instead of ~12
+    on dispatch-cadence formation changes (benchmarks/pipeline_rate.py).
+
+    Shapes are per size bucket: ``x2/s2`` are (2 dm2, 2 dm2) with
+    dm2 = 2n - 4, ``x1/s1`` are (2 dm1, 2 dm1) with dm1 = n - 1 (flat
+    formations) or n - 2 — a carry only re-seeds solves of the SAME n
+    and planarity (`solve_gains` validates and raises on mismatch).
+    A NamedTuple, so it is a pytree: it rides jit boundaries, vmaps,
+    the resilience checkpoint codec, and serve requests unchanged.
+    """
+
+    x2: jnp.ndarray      # (2*dm2, 2*dm2) 2D subproblem X iterate
+    s2: jnp.ndarray      # (2*dm2, 2*dm2) 2D subproblem S iterate
+    x1: jnp.ndarray      # (2*dm1, 2*dm1) 1D subproblem X iterate
+    s1: jnp.ndarray      # (2*dm1, 2*dm1) 1D subproblem S iterate
+    iters: jnp.ndarray   # () int32: iterations of the producing solve
+
+
+def init_carry(n: int, planar: bool = False, dtype=None) -> AdmmCarry:
+    """The COLD starting point as a carry: X = tile(eye), S = 0 for both
+    subproblems (`solver.cpp:270-272`). Warm-starting from `init_carry`
+    is bit-identical in value to the carry-free cold solve (pinned by
+    tests/test_gains.py), so drivers thread one carry variable from the
+    first dispatch on without special-casing it."""
+    dtype = dtype or jnp.result_type(float)
+    dm2 = 2 * n - 4
+    dm1 = (n - 1) if planar else (n - 2)
+    x2 = jnp.tile(jnp.eye(dm2, dtype=dtype), (2, 2))
+    x1 = jnp.tile(jnp.eye(dm1, dtype=dtype), (2, 2))
+    return AdmmCarry(x2=x2, s2=jnp.zeros_like(x2),
+                     x1=x1, s1=jnp.zeros_like(x1),
+                     iters=jnp.zeros((), jnp.int32))
+
+
+def planar_of(points, params: AdmmParams | None = None) -> bool:
+    """The solver's compile-time planarity test for ``points`` — the
+    exact rule `solve_gains` applies, exposed so drivers can build a
+    cold `init_carry` (or check an old carry's compatibility) for the
+    formation they are about to dispatch."""
+    params = params or AdmmParams()
+    return bool(np.std(np.asarray(points)[:, 2], ddof=1)
+                < params.thr_planar)
 
 
 def _proj_struct(B: jnp.ndarray, d: int) -> jnp.ndarray:
@@ -173,7 +225,7 @@ def _constraint_system(Q: jnp.ndarray, i_idx: jnp.ndarray,
 def _subproblem(Q: jnp.ndarray, i_idx: jnp.ndarray, j_idx: jnp.ndarray,
                 valid: jnp.ndarray, d: int,
                 params: AdmmParams, check: bool = False,
-                tel: bool = False) -> jnp.ndarray:
+                tel: bool = False, warm=None) -> jnp.ndarray:
     """Solve one (2D or 1D) gain subproblem; returns the full-space gains
     -Q Abar Q^T (`solver.cpp:143,207`).
 
@@ -183,9 +235,14 @@ def _subproblem(Q: jnp.ndarray, i_idx: jnp.ndarray, j_idx: jnp.ndarray,
     nor with a net residual decrease. ``tel=True`` (swarmscope,
     `telemetry.device`) appends ``(iters, final_residual)`` — the
     iteration count and last diffX the paper's warm-start evaluation
-    needs per solve. Flag-gated returns compose as
-    ``(gains[, code][, iters, residual])``; Python-gated, so with both
-    flags off the carry and the lowered HLO are unchanged."""
+    needs per solve. ``warm`` (optional ``(X0, S0)``) re-seeds the ADMM
+    iteration from a previous solve's fixed point instead of the cold
+    X = tile(eye) / S = 0 start, and PREPENDS ``(X, S, iters)`` — the
+    final loop iterates and iteration count — to the return for the
+    next dispatch's carry. Flag-gated returns compose as
+    ``(gains[, X, S, iters][, code][, iters, residual])``; every flag is
+    Python-gated, so with all off the loop carry and the lowered HLO
+    are unchanged."""
     dtype = Q.dtype
     dm = Q.shape[1]
     mu = params.mu
@@ -332,8 +389,13 @@ def _subproblem(Q: jnp.ndarray, i_idx: jnp.ndarray, j_idx: jnp.ndarray,
 
     psd_part = psd_eigh if method == "eigh" else psd_newton
 
-    X0 = jnp.tile(jnp.eye(dm, dtype=dtype), (2, 2))
-    S0 = jnp.zeros_like(X0)
+    if warm is None:
+        X0 = jnp.tile(jnp.eye(dm, dtype=dtype), (2, 2))
+        S0 = jnp.zeros_like(X0)
+    else:
+        # re-seed from the previous dispatch's fixed point; the cast is
+        # a no-op at matching dtype and bridges the f32 tier's carries
+        X0, S0 = warm[0].astype(dtype), warm[1].astype(dtype)
 
     def cond(carry):
         X, S, it, stop = carry[:4]
@@ -368,6 +430,8 @@ def _subproblem(Q: jnp.ndarray, i_idx: jnp.ndarray, j_idx: jnp.ndarray,
     X22 = (-W / mu)[dm:, dm:]
     gains = -(Q @ X22 @ Q.T)
     extras = ()
+    if warm is not None:
+        extras = extras + (X, S, fin[2])
     if check:
         extras = extras + (jnp.where(
             invlib.admm_residual_violated(fin[4], fin[5], fin[3]),
@@ -413,15 +477,27 @@ def _solve_jit(points: jnp.ndarray, i_idx: jnp.ndarray, j_idx: jnp.ndarray,
                valid: jnp.ndarray, adjmask: jnp.ndarray, planar: bool,
                params: AdmmParams,
                check_mode: str = "off",
-               telemetry: str = "off") -> jnp.ndarray:
+               telemetry: str = "off",
+               carry: AdmmCarry | None = None) -> jnp.ndarray:
     check = check_mode == "on"
     tel = telemetry == "on"
-    if check or tel:
+    warm = carry is not None
+    new_carry = None
+    if check or tel or warm:
         A2d, *ex2 = _subproblem(_kernel_2d(points[:, :2]), i_idx, j_idx,
-                                valid, 2, params, check=check, tel=tel)
+                                valid, 2, params, check=check, tel=tel,
+                                warm=(carry.x2, carry.s2) if warm else None)
         A1d, *ex1 = _subproblem(_kernel_1d(points[:, 2], planar), i_idx,
                                 j_idx, valid, 1, params, check=check,
-                                tel=tel)
+                                tel=tel,
+                                warm=(carry.x1, carry.s1) if warm else None)
+        if warm:
+            # the leading (X, S, iters) triples become the next
+            # dispatch's carry; the per-flag extras keep their order
+            new_carry = AdmmCarry(x2=ex2[0], s2=ex2[1],
+                                  x1=ex1[0], s1=ex1[1],
+                                  iters=ex2[2] + ex1[2])
+            ex2, ex1 = ex2[3:], ex1[3:]
     else:
         A2d = _subproblem(_kernel_2d(points[:, :2]), i_idx, j_idx, valid, 2,
                           params)
@@ -439,8 +515,8 @@ def _solve_jit(points: jnp.ndarray, i_idx: jnp.ndarray, j_idx: jnp.ndarray,
     flat = out.reshape(3 * n, 3 * n)
     # kill numerically-zero entries (`solver.cpp:144,208`)
     flat = jnp.where(jnp.abs(flat) > params.thr_sparse_zero, flat, 0.0)
-    if check or tel:
-        extras = ()
+    if check or tel or warm:
+        extras = (new_carry,) if warm else ()
         k = 0
         if check:
             extras = extras + (jnp.maximum(ex2[0], ex1[0]),)
@@ -457,7 +533,8 @@ def _solve_jit(points: jnp.ndarray, i_idx: jnp.ndarray, j_idx: jnp.ndarray,
 def solve_gains(points, adj, params: AdmmParams | None = None,
                 max_nonedges: int | None = None,
                 check_mode: str = "off",
-                telemetry: bool = False) -> jnp.ndarray:
+                telemetry: bool = False,
+                carry: AdmmCarry | None = None) -> jnp.ndarray:
     """Design (3n, 3n) formation gains on device.
 
     The graph enters as *traced* padded index arrays, so one compiled
@@ -478,6 +555,16 @@ def solve_gains(points, adj, params: AdmmParams | None = None,
     ``(gains, AdmmSolveStats)`` — iteration count + final residual per
     solve, same dispatch-time host sync as check_mode. Both flags are
     static and Python-gated: off is the committed-baseline HLO.
+
+    ``carry`` (an `AdmmCarry`, e.g. from `init_carry` or a previous
+    solve) WARM-STARTS the ADMM from that solve's fixed point and makes
+    the return ``(gains, new_carry)`` (``(gains, new_carry, stats)``
+    with telemetry) — the driver re-seeds the next dispatch instead of
+    the reference's stateless cold start (ROADMAP open item 1; warm
+    dispatch-cadence solves converge in ~2 iterations vs ~12 cold,
+    benchmarks/pipeline_rate.py). ``carry=None`` is Python-gated: the
+    cold path's lowered HLO is bit-identical to the committed baseline
+    (`trace_audit.verify_zero_cost_off`).
     """
     params = params or AdmmParams()
     if check_mode not in ("off", "on"):
@@ -505,16 +592,31 @@ def solve_gains(points, adj, params: AdmmParams | None = None,
         # should call from host with concrete points
         planar = False
     else:
-        planar = bool(np.std(np.asarray(points)[:, 2], ddof=1)
-                      < params.thr_planar)
-    if check_mode == "on" or telemetry:
+        planar = planar_of(points, params)
+    if carry is not None:
+        dm2, dm1 = 2 * n - 4, (n - 1) if planar else (n - 2)
+        want = {"x2": (2 * dm2, 2 * dm2), "s2": (2 * dm2, 2 * dm2),
+                "x1": (2 * dm1, 2 * dm1), "s1": (2 * dm1, 2 * dm1)}
+        for field, shape in want.items():
+            got = tuple(getattr(carry, field).shape)
+            if got != shape:
+                raise ValueError(
+                    f"AdmmCarry.{field} has shape {got}, expected "
+                    f"{shape} for n={n} planar={planar} — a carry only "
+                    "re-seeds solves of the same size and planarity")
+    if check_mode == "on" or telemetry or carry is not None:
         outs = _solve_jit(jnp.asarray(points), jnp.asarray(i_idx),
                           jnp.asarray(j_idx), jnp.asarray(valid),
                           jnp.asarray(adjmask), planar, params,
                           check_mode=check_mode,
-                          telemetry="on" if telemetry else "off")
-        gains = outs[0]
+                          telemetry="on" if telemetry else "off",
+                          carry=carry)
+        gains = outs[0] if isinstance(outs, tuple) else outs
         k = 1
+        new_carry = None
+        if carry is not None:
+            new_carry = outs[k]
+            k += 1
         if check_mode == "on":
             code = int(outs[k])   # deliberate host sync: dispatch path
             k += 1
@@ -524,8 +626,9 @@ def solve_gains(points, adj, params: AdmmParams | None = None,
         if telemetry:
             stats = AdmmSolveStats(iters=int(outs[k]),
                                    residual=float(outs[k + 1]))
-            return gains, stats
-        return gains
+            return (gains, new_carry, stats) if carry is not None \
+                else (gains, stats)
+        return (gains, new_carry) if carry is not None else gains
     return _solve_jit(jnp.asarray(points), jnp.asarray(i_idx),
                       jnp.asarray(j_idx), jnp.asarray(valid),
                       jnp.asarray(adjmask), planar, params)
@@ -536,6 +639,112 @@ def solve_gains_blocks(points, adj, params: AdmmParams | None = None
     """Same, in the framework's (n, n, 3, 3) block layout."""
     from aclswarm_tpu.core.types import gains_from_flat
     return gains_from_flat(solve_gains(points, adj, params))
+
+
+def solve_gains_f32(points, adj, params: AdmmParams | None = None,
+                    max_nonedges: int | None = None,
+                    carry: AdmmCarry | None = None,
+                    tol: float = 1e-4):
+    """f32 device-precision solve GATED by the eigenstructure self-check
+    (`validate_gains`; ROADMAP open item 1's fast tier).
+
+    Solves at f32 — the Newton-Schulz MXU path (`psd_method='auto'`
+    picks 'newton' at f32) — then validates the eigenstructure at the
+    f32 tolerance (tol=1e-4: the solve leaves ~3e-5 kernel residue with
+    a ~1.0 spectral gap, see `validate_gains`). A failed check falls
+    back to the default-precision solve transparently, so callers get
+    the f32 speed when it is safe and the f64-class answer when it is
+    not — the validation IS the gate, never a silent downgrade of the
+    gains' stability guarantee.
+
+    Returns ``(gains, report)`` where ``report`` is the `validate_gains`
+    dict plus ``f32_ok`` (True = the f32 solve passed and was kept).
+    With ``carry``, returns ``(gains, new_carry, report)`` — the carry
+    follows whichever solve was kept (f32 carries re-seed f64 solves
+    and vice versa; `_subproblem` casts the seed to the solve dtype).
+    """
+    pts32 = jnp.asarray(np.asarray(points), jnp.float32)
+    out = solve_gains(pts32, adj, params=params,
+                      max_nonedges=max_nonedges, carry=carry)
+    gains, new_carry = out if carry is not None else (out, None)
+    report = validate_gains(np.asarray(gains), np.asarray(points),
+                            tol=tol)
+    ok = bool(report["no_positive"] and report["kernel_ok"]
+              and report["strictly_negative_rest"])
+    report = dict(report, f32_ok=ok)
+    if not ok:
+        out = solve_gains(points, adj, params=params,
+                          max_nonedges=max_nonedges, carry=carry)
+        gains, new_carry = out if carry is not None else (out, None)
+    if carry is not None:
+        return gains, new_carry, report
+    return gains, report
+
+
+def solve_gains_batch(points, adjs, params: AdmmParams | None = None,
+                      max_nonedges: int | None = None) -> jnp.ndarray:
+    """Design gains for a BATCH of formations in one device program:
+    ``points`` (B, n, 3) and ``adjs`` (B, n, n) -> (B, 3n, 3n) gains,
+    vmapped over the formation axis.
+
+    A single ADMM solve runs (2 dm, 2 dm) matmuls at ~1.6% of MXU peak
+    (benchmarks/results/scale_tpu.json roofline columns) — the matrix
+    unit is idle waiting on one small problem. Batching formations is
+    the road to real utilization: the graph already enters `_solve_jit`
+    as TRACED padded index arrays, so the per-formation constraint
+    systems batch like any other operand and one compiled program
+    serves the whole fleet of designs (Monte-Carlo seeds, the serve
+    layer's queued gain requests, multi-formation dispatch plans).
+
+    All formations share one padded constraint bucket
+    (``max_nonedges``, default = the batch max) and must agree on
+    planarity (compile-time, like the serial path). Per-formation
+    results are BIT-IDENTICAL to the serial `solve_gains` loop
+    (tests/test_gains.py pins B >= 2 parity).
+    """
+    params = params or AdmmParams()
+    pts_np = np.asarray(points)
+    adjs_np = np.asarray(adjs)
+    if pts_np.ndim != 3 or adjs_np.ndim != 3:
+        raise ValueError("solve_gains_batch wants stacked (B, n, 3) "
+                         f"points and (B, n, n) adjacencies, got "
+                         f"{pts_np.shape} / {adjs_np.shape}")
+    B, n = pts_np.shape[:2]
+    iu, ju = np.triu_indices(n, k=1)
+    packs = []
+    for b in range(B):
+        off = adjs_np[b][iu, ju] == 0
+        packs.append((iu[off], ju[off]))
+    ne_max = max(p[0].shape[0] for p in packs)
+    K = ne_max if max_nonedges is None else max_nonedges
+    if ne_max > K:
+        raise ValueError(f"batch has {ne_max} non-edges > bucket {K}")
+    K = max(K, 1)
+    i_b = np.zeros((B, K), np.int64)
+    j_b = np.zeros((B, K), np.int64)
+    v_b = np.zeros((B, K), bool)
+    for b, (ii, jj) in enumerate(packs):
+        ne = ii.shape[0]
+        i_b[b, :ne], j_b[b, :ne], v_b[b, :ne] = ii, jj, True
+    a_b = (adjs_np != 0) | np.eye(n, dtype=bool)[None]
+    flats = np.std(pts_np[:, :, 2], axis=1, ddof=1) < params.thr_planar
+    if flats.any() and not flats.all():
+        raise ValueError("batch mixes flat and non-flat formations — "
+                         "planarity is compile-time; split the batch")
+    planar = bool(flats.all())
+    return _solve_batch_jit(jnp.asarray(points), jnp.asarray(i_b),
+                            jnp.asarray(j_b), jnp.asarray(v_b),
+                            jnp.asarray(a_b), planar, params)
+
+
+@partial(jax.jit, static_argnames=("planar", "params"))
+def _solve_batch_jit(points, i_idx, j_idx, valid, adjmask, planar, params):
+    """The vmapped designer core (registered in `analysis.trace_audit`
+    as ``gains.admm.solve_batch``): vmap of the serial `_solve_jit`
+    computation over the stacked formation axis, statics shared."""
+    return jax.vmap(
+        lambda p, i, j, v, a: _solve_jit(p, i, j, v, a, planar, params)
+    )(points, i_idx, j_idx, valid, adjmask)
 
 
 def validate_gains(A: np.ndarray, points: np.ndarray,

@@ -211,6 +211,17 @@ class TrialConfig:
     # convergence rides the auction/alignment loop, not the slow modes,
     # so trials complete *faster* (formation snaps assignments).
     gain_scale: Optional[float] = None
+    # warm-start the on-dispatch ADMM gain design from the previous
+    # dispatch's fixed point (`gains.AdmmCarry`; ROADMAP item 1): each
+    # formation cycle re-seeds the solver instead of the reference's
+    # stateless cold start, and the carry rides the resilience
+    # checkpoint so a resumed trial keeps its warm seed. Off (default)
+    # is the reference-faithful cold solve, bit-identical to today —
+    # the flag is Python-gated end to end. Only affects dispatches that
+    # actually solve (library-shipped gains pass the carry through);
+    # a planarity flip between formations re-seeds cold for that shape
+    # (a carry only fits solves of the same size and planarity).
+    warm_gains: bool = False
     verbose: bool = True
     # per-trial rollout recordings ("bags", `harness.review`): directory
     # for trial_<k>.npz files, or None to skip
@@ -262,7 +273,8 @@ def _formations_for_trial(cfg: TrialConfig, seed: int
 
 def _gains_for(spec: FormationSpec,
                max_nonedges: Optional[int] = None,
-               stats: bool = False):
+               stats: bool = False,
+               warm: bool = False, carry=None):
     """Library gains if shipped, else the on-dispatch device ADMM solve
     (`coordination_ros.cpp:112-119`). ``max_nonedges`` pins the padded
     constraint bucket so Monte-Carlo trials over random graphs (whose
@@ -270,11 +282,35 @@ def _gains_for(spec: FormationSpec,
     `simformN` groups the generator removes at most n-4 edges
     (`generate_random_formation.py:61-73`), so n-4 is a static bound.
     ``stats=True`` (swarmscope) returns ``(gains, AdmmSolveStats |
-    None)`` — None when the library shipped the gains (no solve ran)."""
+    None)`` — None when the library shipped the gains (no solve ran).
+    ``warm=True`` threads an `AdmmCarry` through the solve: the return
+    grows a trailing ``new_carry`` element, seeded from ``carry`` (a
+    previous dispatch's fixed point; None or a shape-incompatible carry
+    falls back to the cold `init_carry`, which is value-identical to
+    the carry-free solve). Library-shipped gains run no solve, so the
+    carry passes through unchanged."""
     if spec.gains is not None:
-        return (np.asarray(spec.gains), None) if stats \
-            else np.asarray(spec.gains)
+        g = np.asarray(spec.gains)
+        if warm:
+            return (g, None, carry) if stats else (g, carry)
+        return (g, None) if stats else g
     from aclswarm_tpu import gains as gainslib
+    if warm:
+        n = np.asarray(spec.points).shape[0]
+        cold = gainslib.init_carry(n, gainslib.planar_of(spec.points))
+        if carry is None or any(
+                tuple(getattr(carry, f).shape) != tuple(
+                    getattr(cold, f).shape)
+                for f in ("x2", "s2", "x1", "s1")):
+            carry = cold
+        out = gainslib.solve_gains(spec.points, spec.adjmat,
+                                   max_nonedges=max_nonedges,
+                                   telemetry=stats, carry=carry)
+        if stats:
+            g, new_carry, st = out
+            return np.asarray(g), st, new_carry
+        g, new_carry = out
+        return np.asarray(g), new_carry
     if stats:
         g, st = gainslib.solve_gains(spec.points, spec.adjmat,
                                      max_nonedges=max_nonedges,
@@ -352,25 +388,54 @@ def _engine_kw(cfg: TrialConfig) -> dict:
 
 
 def _dispatch_gains(cfg: TrialConfig, spec: FormationSpec,
-                    n: int, stats: bool = False):
+                    n: int, stats: bool = False, carry=None):
     """On-dispatch gain design with the padded-constraint bucket rule:
     fc graphs have exactly zero non-edges (a 1-slot bucket avoids padding
     n-4 dead constraint slots into the solve); random simformN graphs
     remove at most n-4 edges, a static bound that lets Monte-Carlo seeds
     share one compiled solver. ``stats=True`` additionally returns the
     solve's `AdmmSolveStats` (None for library gains) — the swarmscope
-    drivers fold it into the `ChunkTelemetry` carry at commit."""
+    drivers fold it into the `ChunkTelemetry` carry at commit.
+    With ``cfg.warm_gains`` the return grows a trailing ``new_carry``
+    (`_gains_for`): ``(g[, stats], new_carry)``."""
     if not _SIMFORM.match(cfg.formation):
         bucket = None
     elif cfg.sim_fc:
         bucket = 1
     else:
         bucket = max(n - 4, 1)
-    out = _gains_for(spec, bucket, stats=stats)
-    g, st = out if stats else (out, None)
+    warm = cfg.warm_gains
+    out = _gains_for(spec, bucket, stats=stats, warm=warm, carry=carry)
+    if warm:
+        (g, st, new_carry) = out if stats else (out[0], None, out[1])
+    else:
+        g, st = out if stats else (out, None)
+        new_carry = None
     if cfg.gain_scale is not None:
         g = g * cfg.gain_scale
+    if warm:
+        return (g, st, new_carry) if stats else (g, new_carry)
     return (g, st) if stats else g
+
+
+def _carry_payload(carry):
+    """`AdmmCarry` -> checkpoint-codec payload (dict of arrays | None):
+    the warm-start seed survives preemption like `gains_cache` does, so
+    a resumed trial's next dispatch is as warm as the uninterrupted
+    run's would have been."""
+    if carry is None:
+        return None
+    return {k: np.asarray(v) for k, v in carry._asdict().items()}
+
+
+def _carry_restore(d):
+    """Checkpoint payload -> `AdmmCarry` (None passes through)."""
+    if d is None:
+        return None
+    import jax.numpy as jnp
+
+    from aclswarm_tpu import gains as gainslib
+    return gainslib.AdmmCarry(**{k: jnp.asarray(v) for k, v in d.items()})
 
 
 def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
@@ -407,6 +472,9 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
     hover_formation = make_formation(specs[0].points,
                                      np.zeros((n, n)), None)
     gains_cache: dict[int, np.ndarray] = {}
+    # warm-start seed for the NEXT dispatch solve (None until the first
+    # solve, and always None with warm_gains off)
+    admm_carry = None
 
     tel_on = cfg.telemetry == "on"
     state = sim.init_state(q0, flying=False,
@@ -465,6 +533,7 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
             fsm.restore(payload["fsm"])
             gains_cache = {int(k): np.asarray(v)
                            for k, v in payload["gains_cache"].items()}
+            admm_carry = _carry_restore(payload.get("admm_carry"))
             pending_go = payload["pending_go"]
             pending_dispatch = payload["pending_dispatch"]
             formation_just_received = payload["formation_just_received"]
@@ -540,7 +609,15 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
             spec = specs[pending_dispatch]
             solve_st = None
             if pending_dispatch not in gains_cache:
-                if tel_on:
+                if cfg.warm_gains:
+                    out = _dispatch_gains(cfg, spec, n, stats=tel_on,
+                                          carry=admm_carry)
+                    if tel_on:
+                        g, solve_st, admm_carry = out
+                    else:
+                        g, admm_carry = out
+                    gains_cache[pending_dispatch] = g
+                elif tel_on:
                     g, solve_st = _dispatch_gains(cfg, spec, n, stats=True)
                     gains_cache[pending_dispatch] = g
                 else:
@@ -583,6 +660,7 @@ def run_trial(cfg: TrialConfig, trial_idx: int) -> TrialFSM:
                 "fsm": fsm.snapshot(),
                 "gains_cache": {str(k): v
                                 for k, v in gains_cache.items()},
+                "admm_carry": _carry_payload(admm_carry),
                 "pending_go": pending_go,
                 "pending_dispatch": pending_dispatch,
                 "formation_just_received": formation_just_received,
@@ -725,6 +803,9 @@ def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
     torig = list(trial_indices)  # original trial index per current row
     scarry = sumlib.init_carry(n, window, dtype=dtype, batch=B)
     gains_cache: list[dict] = [dict() for _ in range(B)]
+    # per-row warm-start seeds (run_trial's `admm_carry`, one per live
+    # batch row; compacted alongside `gains_cache`)
+    admm_carries: list = [None] * B
     pending_go = [False] * B
     pending_dispatch: list[Optional[int]] = [None] * B
     max_ticks = int(trial_timeout / dt) + 10 * chunk
@@ -773,6 +854,9 @@ def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
             specs_per = [specs_per_orig[i] for i in live_rows]
             gains_cache = [{int(k): np.asarray(v) for k, v in g.items()}
                            for g in payload["gains_cache"]]
+            admm_carries = [_carry_restore(d) for d in
+                            payload.get("admm_carries",
+                                        [None] * len(live_rows))]
             pending_go = list(payload["pending_go"])
             pending_dispatch = list(payload["pending_dispatch"])
             ticks_done = payload["ticks_done"]
@@ -802,6 +886,7 @@ def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
             torig = [torig[k] for k in keep]
             specs_per = [specs_per[k] for k in keep]
             gains_cache = [gains_cache[k] for k in keep]
+            admm_carries = [admm_carries[k] for k in keep]
             pending_go = [pending_go[k] for k in keep]
             pending_dispatch = [pending_dispatch[k] for k in keep]
         bc = len(fsms)
@@ -878,7 +963,15 @@ def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
             spec = specs_per[b][idx]
             solve_st = None
             if idx not in gains_cache[b]:
-                if tel_on:
+                if cfg.warm_gains:
+                    out = _dispatch_gains(cfg, spec, n, stats=tel_on,
+                                          carry=admm_carries[b])
+                    if tel_on:
+                        g, solve_st, admm_carries[b] = out
+                    else:
+                        g, admm_carries[b] = out
+                    gains_cache[b][idx] = g
+                elif tel_on:
                     g, solve_st = _dispatch_gains(cfg, spec, n, stats=True)
                     gains_cache[b][idx] = g
                 else:
@@ -916,6 +1009,7 @@ def run_trial_batch(cfg: TrialConfig, trial_indices: list[int]
                 "live_rows": [row_of[t] for t in torig],
                 "gains_cache": [{str(k): v for k, v in g.items()}
                                 for g in gains_cache],
+                "admm_carries": [_carry_payload(c) for c in admm_carries],
                 "pending_go": list(pending_go),
                 "pending_dispatch": list(pending_dispatch),
                 "ticks_done": ticks_done,

@@ -86,7 +86,8 @@ def sim_state_sharding(mesh: Mesh, localization: bool = False,
                        faults: bool = False,
                        checks: bool = False,
                        telemetry: bool = False,
-                       scenario: bool = False) -> sim.SimState:
+                       scenario: bool = False,
+                       cbaa_warm: bool = False) -> sim.SimState:
     """Sharding pytree for `sim.SimState`: per-agent leaves row-sharded.
 
     ``localization=True`` matches states built with
@@ -118,8 +119,15 @@ def sim_state_sharding(mesh: Mesh, localization: bool = False,
     obstacle tracks (K slots), disturbance scalars, sequence point
     tables (every agent's alignment consumes all points, exactly why
     `Formation.points` replicates), drift/cadence scalars, and the
-    per-trial key — replicates."""
+    per-trial key — replicates.
+
+    ``cbaa_warm=True`` matches states built with
+    ``init_state(..., cbaa_warm=True)``: the carried (n, n) price/winner
+    tables are per-agent local views, so they shard on the owning-agent
+    axis exactly like the localization belief tables and the fault
+    link-loss matrix."""
     from aclswarm_tpu.analysis.invariants import InvariantState
+    from aclswarm_tpu.assignment.cbaa import CbaaTables
     from aclswarm_tpu.faults import FaultSchedule
     from aclswarm_tpu.scenarios.timeline import Scenario
     from aclswarm_tpu.telemetry.device import ChunkTelemetry
@@ -147,7 +155,8 @@ def sim_state_sharding(mesh: Mesh, localization: bool = False,
         tel=ChunkTelemetry(auctions=rep, assign_rounds=rep, reassigns=rep,
                            ca_ticks=rep, flood_stale_max=rep,
                            admm_iters=rep, admm_residual=rep)
-        if telemetry else None)
+        if telemetry else None,
+        cbaa_warm=CbaaTables(price=row, who=row) if cbaa_warm else None)
 
 
 def formation_sharding(mesh: Mesh) -> Formation:

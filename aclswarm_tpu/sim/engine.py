@@ -111,9 +111,12 @@ class SimConfig:
     # n ~ 1000 (4 GB dense), bit-identical results
     cbaa_task_block: int | None = struct.field(pytree_node=False,
                                                default=None)
-    # assignment hysteresis: accept a centralized auction/sinkhorn result
+    # assignment hysteresis: accept an auction/sinkhorn/CBAA result
     # only if it improves the total assignment cost by this relative
-    # margin. 0.0 = the reference's accept-any-different semantics
+    # margin (for CBAA the veto runs inside `cbaa.cbaa_assign` on the
+    # summed own-aligned distances — the decentralized analogue of the
+    # centralized cost test). 0.0 = the reference's accept-any-different
+    # semantics
     # (`shouldUseAssignment`, `auctioneer.cpp:310-321` — its only test is
     # "differs from current"). At n ~ 1000 the near-ties that semantics
     # tolerates become a self-sustaining churn: Sinkhorn's rounding
@@ -200,6 +203,14 @@ class SimState:
     # churn, staleness, CA activity) per trial; it checkpoints with the
     # state and its per-tick snapshot rides the existing chunk syncs.
     tel: ChunkTelemetry | None = None
+    # CBAA warm-start carry (`assignment.cbaa.CbaaTables`; ROADMAP open
+    # item 1): None = the stateless-auction engine (structurally
+    # identical program to every pre-warm rollout — the zero-cost-off
+    # mode). Tables re-seed each cadenced CBAA auction from the last
+    # one's fixed point and persist across ticks/chunks/checkpoints as
+    # plain carry data; `cbaa.init_tables` (the cold start) is
+    # value-identical to None on every auction outcome.
+    cbaa_warm: "cbaa.CbaaTables | None" = None
 
 
 @struct.dataclass
@@ -239,7 +250,8 @@ def init_state(q0, v2f0=None, flying: bool = True,
                faults: FaultSchedule | None = None,
                checks: bool = False,
                telemetry: bool = False,
-               scenario: Scenario | None = None) -> SimState:
+               scenario: Scenario | None = None,
+               cbaa_warm: bool = False) -> SimState:
     """``flying=True`` starts airborne in FLYING (historical rollouts);
     ``flying=False`` starts NOT_FLYING on the ground — send CMD_GO via
     `ExternalInputs` to take off (requires ``cfg.flight_fsm``).
@@ -252,7 +264,11 @@ def init_state(q0, v2f0=None, flying: bool = True,
     ``telemetry=True`` allocates the swarmscope counter carry (required
     iff the rollout runs with ``cfg.telemetry='on'``).
     ``scenario`` attaches a scenario timeline (`aclswarm_tpu.scenarios`);
-    None keeps the scenario-free engine."""
+    None keeps the scenario-free engine.
+    ``cbaa_warm=True`` allocates the CBAA warm-start tables (cold-
+    initialized, `cbaa.init_tables`): each cadenced CBAA auction then
+    re-seeds from the previous one's fixed point; False keeps the
+    stateless-auction engine."""
     # explicit strong dtype: a dtype-less asarray would inherit whatever
     # the caller passed (list vs np array vs f32 array), and every distinct
     # aval retraces the whole rollout (jaxcheck JC003)
@@ -274,7 +290,9 @@ def init_state(q0, v2f0=None, flying: bool = True,
         faults=faults,
         scenario=scenario,
         inv=invlib.init_invariants() if checks else None,
-        tel=devtel.init_telemetry(dtype=q0.dtype) if telemetry else None)
+        tel=devtel.init_telemetry(dtype=q0.dtype) if telemetry else None,
+        cbaa_warm=cbaa.init_tables(n, dtype=q0.dtype) if cbaa_warm
+        else None)
 
 
 def assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
@@ -282,7 +300,8 @@ def assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
            first: jnp.ndarray | None = None,
            alive: jnp.ndarray | None = None,
            link_mask: jnp.ndarray | None = None,
-           check: bool = False, tel: bool = False):
+           check: bool = False, tel: bool = False,
+           warm: "cbaa.CbaaTables | None" = None):
     """One re-assignment: returns (new v2f, valid flag) — plus a ()
     int32 swarmcheck code (0 = clean) when ``check`` is set, carrying
     solver-level contract violations (currently the Sinkhorn marginal
@@ -319,7 +338,17 @@ def assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
     centralized auction/sinkhorn paths ignore it (the reference operator
     is a base station, `operator.py:221-246` — vehicle-to-vehicle link
     loss does not apply to it).
+
+    ``warm`` (CBAA mode only): the previous auction's `CbaaTables` to
+    re-seed from. When set, the updated tables are APPENDED LAST to the
+    flag-gated return — ``(v2f, valid[, code][, rounds][, tables])`` —
+    so the `step` carry threads them; None (the default, and every
+    non-CBAA solver) is Python-gated and leaves the return and HLO
+    unchanged.
     """
+    if warm is not None and cfg.assignment != "cbaa":
+        raise ValueError("warm CbaaTables only apply to the 'cbaa' "
+                         f"assignment mode, not {cfg.assignment!r}")
     if first is None:
         first = jnp.asarray(False)
 
@@ -391,9 +420,20 @@ def assign(swarm: SwarmState, formation: Formation, v2f: jnp.ndarray,
         res = cbaa.cbaa_from_state(swarm.q, formation.points,
                                    formation.adjmat, v2f, est=est,
                                    task_block=cfg.cbaa_task_block,
-                                   alive=alive, comm_extra=link_mask)
+                                   alive=alive, comm_extra=link_mask,
+                                   warm=warm,
+                                   assign_eps=cfg.assign_eps,
+                                   first=first)
         new_v2f = jnp.where(res.valid, res.v2f, v2f)
-        return _ret(new_v2f, res.valid, clean, res.rounds)
+        out = _ret(new_v2f, res.valid, clean, res.rounds)
+        if warm is not None:
+            # only a VALID auction's fixed point is worth carrying; an
+            # invalid outcome keeps the old seed (detect-and-skip, like
+            # the assignment itself)
+            out = out + (jax.tree.map(
+                lambda new, old: jnp.where(res.valid, new, old),
+                cbaa.CbaaTables(price=res.price, who=res.who), warm),)
+        return out
     elif cfg.assignment == "none":
         return _ret(v2f, jnp.asarray(True), clean, zero_rounds)
     raise ValueError(f"unknown assignment mode {cfg.assignment!r}")
@@ -571,6 +611,12 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
         # engine's own cadence bit-identically)
         gate = gate & scenlib.rematch_ok_at(scen, state.tick)
     cand_rounds = None
+    # CBAA warm-start tables (Python-gated on the carry's presence, the
+    # faults/scenario/inv/tel optional-field pattern: None = the
+    # stateless-auction program, HLO untouched). Tables only feed — and
+    # only update from — actual CBAA auctions.
+    warm = state.cbaa_warm if cfg.assignment == "cbaa" else None
+    new_warm = state.cbaa_warm
     if cfg.assignment == "none":
         new_v2f, valid = v2f, jnp.asarray(True)
         take = jnp.asarray(False)
@@ -597,7 +643,7 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
                     vel=s.vel)
             return assign(s, f, p, cfg, e, first=state.first_auction,
                           alive=alive, link_mask=link_mask,
-                          check=checks, tel=tel_on)
+                          check=checks, tel=tel_on, warm=warm)
 
         def _hold(s, f, p, e):
             out = (p, jnp.asarray(True))
@@ -605,6 +651,8 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
                 out = out + (jnp.zeros((), jnp.int32),)
             if tel_on:
                 out = out + (jnp.zeros((), jnp.int32),)
+            if warm is not None:
+                out = out + (warm,)
             return out
 
         outs = lax.cond(do_assign, _run, _hold, swarm, formation, v2f,
@@ -623,6 +671,12 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
             i += 1
         if tel_on:
             cand_rounds = outs[i]
+            i += 1
+        if warm is not None:
+            # a gated-off auction's tables are discarded like its v2f
+            new_warm = jax.tree.map(
+                lambda cand, old: jnp.where(take, cand, old),
+                outs[i], warm)
     reassigned = take & jnp.any(new_v2f != v2f)
     auctioned = take
     first_auction = state.first_auction & ~(auctioned & valid)
@@ -766,7 +820,8 @@ def step(state: SimState, formation: Formation, gains: ControlGains,
                          tick=state.tick + 1, flight=fs, loc=loc,
                          first_auction=first_auction,
                          assign_enabled=state.assign_enabled,
-                         faults=faults, scenario=scen, inv=inv, tel=tel)
+                         faults=faults, scenario=scen, inv=inv, tel=tel,
+                         cbaa_warm=new_warm)
     return new_state, StepMetrics(distcmd_norm=distcmd_norm, ca_active=ca,
                                   assign_valid=valid, reassigned=reassigned,
                                   auctioned=auctioned, q=swarm.q,

@@ -35,7 +35,7 @@ ROOT = Path(__file__).resolve().parent.parent
 # direction per unit: +1 = higher is better (rates), -1 = lower is
 # better (latencies); unknown units default to higher-better
 _DIRECTION = {"Hz": 1, "hz": 1, "s": -1, "ms": -1, "us": -1,
-              "ratio": -1}
+              "ratio": -1, "iters": -1, "frac": -1}
 
 
 def load_rounds(directory: Path) -> list[tuple[int, dict]]:
@@ -127,6 +127,46 @@ def slo_detection_rows(results_dir: Path | None = None) -> list[dict]:
              "n": r.get("n"), "backend": r.get("backend")}]
 
 
+def pipeline_rows(results_dir: Path | None = None) -> list[dict]:
+    """Trend-shaped rows from the committed pipeline_n1000 artifact
+    (benchmarks/pipeline_rate.py): ``pipeline_n1000_hz`` — the ROADMAP
+    item 1 headline rate (Hz, higher-better) per warm/cold mode;
+    ``admm_warm_iters`` — warm re-convergence iterations (lower-better:
+    a creeping iteration count is the warm start rotting); and
+    ``assign_churn_rate`` — reassignment fraction per hysteresis level
+    (lower-better). Joins the series map as the pseudo-round after the
+    newest capture, like the overload rows."""
+    results_dir = results_dir or (ROOT / "benchmarks" / "results")
+    path = results_dir / "pipeline_n1000.json"
+    if not path.exists():
+        return []
+    rows = []
+    for line in path.read_text().strip().splitlines():
+        try:
+            r = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(r, dict) or r.get("quick"):
+            continue
+        name = r.get("name")
+        if name == "pipeline_rate" and r.get("n") == 1000:
+            warm = "warm" if r.get("warm_gains") else "cold"
+            rows.append({"name": "pipeline_n1000_hz",
+                         "value": r.get("value"), "unit": "Hz",
+                         "n": r.get("n"), "backend": r.get("backend"),
+                         "level": f"{r.get('mode')}/{warm}"})
+        elif name == "admm_warm_start":
+            rows.append({"name": "admm_warm_iters",
+                         "value": r.get("warm_iters"), "unit": "iters",
+                         "n": r.get("n"), "backend": r.get("backend")})
+        elif name == "assign_churn" and r.get("warm_tables"):
+            rows.append({"name": "assign_churn_rate",
+                         "value": r.get("churn_rate"), "unit": "frac",
+                         "n": r.get("n"),
+                         "level": f"eps={r.get('assign_eps')}"})
+    return rows
+
+
 def _comparable(row: dict) -> bool:
     v = row.get("value")
     return (isinstance(v, (int, float)) and not isinstance(v, bool)
@@ -178,13 +218,15 @@ def trend(directory: Path, threshold: float) -> tuple[list[str], int]:
     res_dir = directory / "benchmarks" / "results"
     over = overload_rows(res_dir)
     slo = slo_detection_rows(res_dir)
+    pipe = pipeline_rows(res_dir)
     if directory.resolve() != ROOT.resolve():
         # PER-FAMILY fallback to this repo's committed results: a
         # capture dir carrying one artifact but not the other must not
         # silently drop the missing family's gate
         over = over or overload_rows()
         slo = slo or slo_detection_rows()
-    cur = over + slo
+        pipe = pipe or pipeline_rows()
+    cur = over + slo + pipe
     if cur:
         nxt = (rounds[-1][0] if rounds else 0) + 1
         rounds.extend((nxt, r) for r in cur)

@@ -146,6 +146,151 @@ _SCEN_KINDS = ("completion", "recovery")
 _SCEN_MIN_FAMILIES = 4
 
 
+# the warm-pipeline artifact (benchmarks/pipeline_rate.py; ROADMAP
+# open item 1): JSON-lines, three row kinds — warm-vs-cold ADMM across
+# dispatches, the CBAA churn/lag hysteresis curve, and composed/host
+# pipeline rates. The acceptance criteria ARE the schema: warm ADMM
+# must re-converge in >= 3x fewer iterations than cold, the
+# hysteresis-off run must be BITWISE identical to the default engine
+# (baseline_parity — the zero-cost-off proof at artifact level), and
+# the committed artifact owes the headline row: a warm-gains n=1000
+# pipeline rate >= 100 Hz.
+PIPELINE = "pipeline_n1000.json"
+_PIPE_ADMM_KEYS = {"name", "n", "backend", "cold_iters", "warm_iters",
+                   "iters_speedup", "cold_ms", "warm_ms", "time_speedup",
+                   "gains_maxdiff", "quick"}
+_PIPE_CHURN_KEYS = {"name", "n", "assignment", "warm_tables",
+                    "assign_eps", "assign_every", "rematch_every",
+                    "drift_speed", "ticks", "auctions", "reassigns",
+                    "churn_rate", "lag_rms_m", "baseline_parity",
+                    "quick"}
+_PIPE_RATE_KEYS = {"name", "n", "mode", "backend", "assignment",
+                   "assign_every", "redesign_every", "ticks",
+                   "warm_gains", "tick_ms", "stage_ms", "gains_source",
+                   "value", "unit", "quick"}
+_PIPE_STAGES = {"tick", "assign", "gains"}
+_PIPE_WARM_ITERS_BAR = 3.0
+_PIPE_HEADLINE_N = 1000
+_PIPE_HEADLINE_HZ = 100.0
+
+
+def check_pipeline_n1000(rows: list, where: str) -> list[str]:
+    """Validate pipeline_n1000 rows: exact key set per row kind, finite
+    values, the >= 3x warm-iteration bar, the bitwise hysteresis-off
+    parity row, and the n=1000 >= 100 Hz headline on committed
+    artifacts."""
+    probs = []
+    all_quick = True
+    saw_warm_bar = saw_parity = saw_headline = False
+    for i, row in enumerate(rows, 1):
+        at = f"{where}:{i}"
+        if not isinstance(row, dict):
+            probs.append(f"{at}: row is not a JSON object")
+            continue
+        name = row.get("name")
+        keys = {"admm_warm_start": _PIPE_ADMM_KEYS,
+                "assign_churn": _PIPE_CHURN_KEYS,
+                "pipeline_rate": _PIPE_RATE_KEYS}.get(name)
+        if keys is None:
+            probs.append(f"{at}: 'name' must be admm_warm_start, "
+                         f"assign_churn or pipeline_rate, got {name!r}")
+            continue
+        missing, unknown = keys - set(row), set(row) - keys
+        if missing:
+            probs.append(f"{at}: missing keys {sorted(missing)}")
+        if unknown:
+            probs.append(f"{at}: unknown keys {sorted(unknown)} "
+                         "(exact-key-set schema)")
+        if not (_is_count(row.get("n")) and row.get("n", 0) > 0):
+            probs.append(f"{at}: 'n' must be a positive int")
+        if not isinstance(row.get("quick"), bool):
+            probs.append(f"{at}: 'quick' must be a bool")
+        all_quick = all_quick and bool(row.get("quick"))
+        if name == "admm_warm_start":
+            for k in ("iters_speedup", "cold_ms", "warm_ms",
+                      "time_speedup", "gains_maxdiff"):
+                if k in row and not _finite_num(row[k]):
+                    probs.append(f"{at}: '{k}' must be a finite number, "
+                                 f"got {row[k]!r}")
+            for k in ("cold_iters", "warm_iters"):
+                if k in row and not (_is_count(row[k]) and row[k] > 0):
+                    probs.append(f"{at}: '{k}' must be a positive int")
+            sp = row.get("iters_speedup")
+            if _finite_num(sp) and sp >= _PIPE_WARM_ITERS_BAR:
+                saw_warm_bar = True
+        elif name == "assign_churn":
+            for k in ("assign_eps", "drift_speed", "churn_rate",
+                      "lag_rms_m"):
+                if k in row and not _finite_num(row[k]):
+                    probs.append(f"{at}: '{k}' must be a finite number, "
+                                 f"got {row[k]!r}")
+            cr = row.get("churn_rate")
+            if _finite_num(cr) and not 0.0 <= cr <= 1.0:
+                probs.append(f"{at}: 'churn_rate' must be within [0, 1], "
+                             f"got {cr!r}")
+            for k in ("auctions", "reassigns", "assign_every",
+                      "rematch_every", "ticks"):
+                if k in row and not _is_count(row[k]):
+                    probs.append(f"{at}: '{k}' must be a non-negative "
+                                 "int")
+            for k in ("warm_tables", "baseline_parity"):
+                if k in row and not isinstance(row[k], bool):
+                    probs.append(f"{at}: '{k}' must be a bool")
+            if (row.get("warm_tables") is False
+                    and row.get("assign_eps") == 0.0):
+                if row.get("baseline_parity") is True:
+                    saw_parity = True
+                elif not row.get("quick"):
+                    probs.append(
+                        f"{at}: the hysteresis-off row (warm_tables "
+                        "false, assign_eps 0) must be bitwise-identical "
+                        "to the default engine (baseline_parity true) — "
+                        "the off position IS today's engine")
+        else:  # pipeline_rate
+            if row.get("mode") not in ("host", "composed"):
+                probs.append(f"{at}: 'mode' must be 'host' or "
+                             f"'composed', got {row.get('mode')!r}")
+            if row.get("unit") != "Hz":
+                probs.append(f"{at}: 'unit' must be 'Hz'")
+            if not isinstance(row.get("warm_gains"), bool):
+                probs.append(f"{at}: 'warm_gains' must be a bool")
+            v = row.get("value")
+            if not (_finite_num(v) and v > 0):
+                probs.append(f"{at}: 'value' must be a finite positive "
+                             f"number, got {v!r}")
+            if not isinstance(row.get("gains_source"), str):
+                probs.append(f"{at}: 'gains_source' must name where the "
+                             "gain term came from")
+            sm = row.get("stage_ms")
+            if not (isinstance(sm, dict) and set(sm) == _PIPE_STAGES
+                    and all(_finite_num(x) and x >= 0
+                            for x in sm.values())):
+                probs.append(f"{at}: 'stage_ms' must map exactly "
+                             f"{sorted(_PIPE_STAGES)} to finite "
+                             "non-negative numbers")
+            if (row.get("n") == _PIPE_HEADLINE_N
+                    and row.get("warm_gains") is True
+                    and _finite_num(v) and v >= _PIPE_HEADLINE_HZ):
+                saw_headline = True
+    if rows and not all_quick:
+        if not saw_warm_bar:
+            probs.append(
+                f"{where}: no admm_warm_start row meets the "
+                f">= {_PIPE_WARM_ITERS_BAR}x warm-iteration speedup — "
+                "the warm start stopped paying for itself")
+        if not saw_parity:
+            probs.append(
+                f"{where}: no hysteresis-off bitwise-parity row "
+                "(warm_tables false, assign_eps 0, baseline_parity "
+                "true) — the zero-cost-off proof is owed")
+        if not saw_headline:
+            probs.append(
+                f"{where}: no warm-gains n={_PIPE_HEADLINE_N} "
+                f"pipeline_rate row >= {_PIPE_HEADLINE_HZ} Hz — the "
+                "ROADMAP item 1 headline is owed")
+    return probs
+
+
 def check_scenario_suite(rows: list, where: str) -> list[str]:
     """Validate scenario_suite rows: exact key set per kind, finite
     values (completion in [0, 1], recovery int >= -1 with a consistent
@@ -1049,7 +1194,8 @@ def check_file(path: Path) -> list[str]:
             return [f"{path.name}: unparseable slo-detection artifact"]
         return check_slo_detection(whole, path.name)
     if path.name in (SERVE_THROUGHPUT, TELEMETRY_OVERHEAD,
-                     SERVE_BREAKDOWN, SCENARIO_SUITE, SERVE_OVERLOAD):
+                     SERVE_BREAKDOWN, SCENARIO_SUITE, SERVE_OVERLOAD,
+                     PIPELINE):
         rows, probs = [], []
         for i, line in enumerate(lines, 1):
             try:
@@ -1060,7 +1206,8 @@ def check_file(path: Path) -> list[str]:
                    TELEMETRY_OVERHEAD: check_telemetry_overhead,
                    SERVE_BREAKDOWN: check_serve_latency_breakdown,
                    SCENARIO_SUITE: check_scenario_suite,
-                   SERVE_OVERLOAD: check_serve_overload}[
+                   SERVE_OVERLOAD: check_serve_overload,
+                   PIPELINE: check_pipeline_n1000}[
                        path.name]
         return probs + checker(rows, path.name)
     if isinstance(whole, dict) and (

@@ -59,7 +59,8 @@ from check_results import RESULTS, check_file  # noqa: E402
 for name in ("serve_throughput.json", "telemetry_overhead.json",
              "serve_multiworker_soak.json", "trace_soak.json",
              "serve_latency_breakdown.json", "scenario_suite.json",
-             "serve_overload.json", "slo_detection.json"):
+             "serve_overload.json", "slo_detection.json",
+             "pipeline_n1000.json"):
     path = RESULTS / name
     if not path.exists():
         print(f"FAIL: missing owed artifact benchmarks/results/{name}")

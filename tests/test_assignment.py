@@ -55,6 +55,7 @@ class TestLapjv:
 
 
 class TestAuction:
+    @pytest.mark.slow
     def test_optimal_cost_vs_lapjv(self):
         rng = np.random.default_rng(2)
         for trial in range(10):
@@ -377,3 +378,93 @@ class TestCBAAEarlyExit:
                                       np.asarray(full.price))
         np.testing.assert_array_equal(np.asarray(fast.who),
                                       np.asarray(full.who))
+
+
+class TestCBAAWarmTables:
+    """Warm auction tables + hysteresis (ROADMAP item 1's CBAA warm
+    start): seeding with `init_tables` is bit-identical to the cold
+    auction (warm off is free), a carried fixed point re-converges —
+    validly — in fewer rounds, release-at-seed keeps moved-geometry
+    re-auctions convergent, and `assign_eps=0` is bitwise today's
+    engine while larger eps never reassigns more."""
+
+    def _setup(self, n=10, seed=13):
+        rng = np.random.default_rng(seed)
+        p = rng.normal(size=(n, 3)) * 5
+        q = p + rng.normal(size=(n, 3)) * 0.3
+        adj = jnp.asarray(np.ones((n, n)) - np.eye(n))
+        paligned = jnp.broadcast_to(jnp.asarray(p), (n, n, 3))
+        return jnp.asarray(q), paligned, adj
+
+    def test_init_tables_seed_is_bitwise_cold(self):
+        q, paligned, adj = self._setup()
+        n = q.shape[0]
+        cold = cbaa_assign(q, paligned, adj, perm.identity(n))
+        warm = cbaa_assign(q, paligned, adj, perm.identity(n),
+                           warm=cbaa.init_tables(n, dtype=q.dtype))
+        for a, b in zip(cold, warm):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_carried_fixed_point_reconverges_fast(self):
+        q, paligned, adj = self._setup()
+        n = q.shape[0]
+        cold = cbaa_assign(q, paligned, adj, perm.identity(n))
+        tab = cbaa.CbaaTables(price=cold.price, who=cold.who)
+        warm = cbaa_assign(q, paligned, adj, perm.identity(n), warm=tab)
+        assert bool(warm.valid)
+        np.testing.assert_array_equal(np.asarray(warm.v2f),
+                                      np.asarray(cold.v2f))
+        assert int(warm.rounds) < int(cold.rounds)
+
+    def test_release_at_seed_survives_moved_geometry(self):
+        # the fleet moved enough that carried winners are stale: the
+        # re-auction must still converge to a valid permutation (a
+        # holder that no longer prefers its carried task releases it at
+        # seed time instead of orphaning it under the max-consensus
+        # ratchet)
+        q, paligned, adj = self._setup()
+        n = q.shape[0]
+        cold = cbaa_assign(q, paligned, adj, perm.identity(n))
+        tab = cbaa.CbaaTables(price=cold.price, who=cold.who)
+        rng = np.random.default_rng(14)
+        q2 = q + jnp.asarray(rng.normal(size=(n, 3)) * 2.0)
+        ref = cbaa_assign(q2, paligned, adj, perm.identity(n))
+        warm = cbaa_assign(q2, paligned, adj, perm.identity(n), warm=tab)
+        assert bool(warm.valid)
+        np.testing.assert_array_equal(np.asarray(warm.v2f),
+                                      np.asarray(ref.v2f))
+
+    def test_eps_zero_is_bitwise_default(self):
+        q, paligned, adj = self._setup()
+        n = q.shape[0]
+        base = cbaa_assign(q, paligned, adj, perm.identity(n))
+        eps0 = cbaa_assign(q, paligned, adj, perm.identity(n),
+                           assign_eps=0.0)
+        for a, b in zip(base, eps0):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_hysteresis_monotone_in_eps(self):
+        # drift the swarm through a sequence of auctions; a larger veto
+        # threshold can only reassign at (weakly) fewer steps
+        n = 8
+        rng = np.random.default_rng(15)
+        p = rng.normal(size=(n, 3)) * 5
+        adj = jnp.asarray(np.ones((n, n)) - np.eye(n))
+        paligned = jnp.broadcast_to(jnp.asarray(p), (n, n, 3))
+        drift = rng.normal(size=(n, 3)) * 0.8
+        changes = {}
+        for eps in (0.0, 0.3):
+            v2f = perm.identity(n)
+            moved = 0
+            for k in range(5):
+                q = jnp.asarray(p + drift * k)
+                res = cbaa_assign(q, paligned, adj, v2f,
+                                  assign_eps=eps,
+                                  first=jnp.asarray(k == 0))
+                if bool(res.valid):
+                    moved += int(np.any(np.asarray(res.v2f)
+                                        != np.asarray(v2f)))
+                    v2f = res.v2f
+            changes[eps] = moved
+        assert changes[0.3] <= changes[0.0]
+        assert changes[0.0] >= 1    # the drift actually forced churn

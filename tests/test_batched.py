@@ -300,6 +300,7 @@ def _fsm_outcomes(fsm):
             list(fsm.assignments), [round(t, 6) for t in fsm.time_avoidance])
 
 
+@pytest.mark.slow
 def test_batched_driver_matches_serial(tmp_path):
     """Two simform8 trials through `run_trial_batch` reach the same FSM
     outcomes (states, convergence times, assignment counts, gridlock

@@ -218,6 +218,7 @@ class TestColAvoid:
         # heading moved off the direct bearing
         assert abs(math.atan2(float(out[0, 1]), float(out[0, 0]))) > 0.1
 
+    @pytest.mark.slow
     def test_keepout_repulse_escapes_pair_trap(self):
         """Two vehicles locked INSIDE each other's keep-out cylinders:
         with the reference semantics (repulse off) the degenerate

@@ -147,6 +147,7 @@ def test_doubleint_matches_matlab_loop_exactly():
     np.testing.assert_allclose(ours, golden, atol=1e-9)
 
 
+@pytest.mark.slow
 def test_doubleint_tracks_continuous_ode_and_converges():
     """The 100 Hz semi-implicit Euler stays within discretization error of
     the fine-step RK4 solution of the MATLAB ODE, and both reach the

@@ -104,6 +104,7 @@ class TestDeviceSolverGoldenParity:
         A_ref = reference.solve_gains(p, adj)
         np.testing.assert_allclose(A_dev, A_ref, atol=1e-9)
 
+    @pytest.mark.slow
     def test_matches_oracle_random_sparse(self):
         rng = np.random.default_rng(7)
         n = 12
@@ -279,5 +280,65 @@ class TestSparseGraphsAtScale:
             pts, adj, reference.AdmmParams(psd_method="newton")))
         assert np.abs(An - Ae).max() < 1e-5
         v = gainslib.validate_gains(An, pts)
+        assert v["no_positive"] and v["kernel_ok"] \
+            and v["strictly_negative_rest"]
+
+
+class TestWarmStart:
+    """The dispatch-loop carry (ROADMAP item 1): `solve_gains(carry=...)`
+    re-seeds the next formation's ADMM from the previous fixed point.
+    The contract: seeding with the COLD carry (`init_carry`) is
+    bit-identical to the carry-free path (warm start off is free), a
+    carried fixed point re-converges in a fraction of the cold
+    iterations, and both land on the same answer to the solver's own
+    stopping tolerance."""
+
+    def _pair(self, n=12, seeds=(11, 12)):
+        rng_a = np.random.default_rng(seeds[0])
+        rng_b = np.random.default_rng(seeds[1])
+        pts_a = rng_a.normal(size=(n, 3)) * 5
+        pts_b = pts_a + rng_b.normal(size=(n, 3)) * 0.5
+        return pts_a, pts_b, fc_adj(n)
+
+    def test_cold_carry_is_bitwise_cold(self):
+        pts_a, _, adj = self._pair()
+        cold = np.asarray(gainslib.solve_gains(pts_a, adj))
+        carry0 = gainslib.init_carry(len(pts_a),
+                                     gainslib.planar_of(pts_a))
+        warm, new_carry = gainslib.solve_gains(pts_a, adj, carry=carry0)
+        assert np.array_equal(np.asarray(warm), cold)
+        assert isinstance(new_carry, gainslib.AdmmCarry)
+
+    def test_warm_reconverges_faster_same_fixed_point(self):
+        pts_a, pts_b, adj = self._pair()
+        cold_b, st_cold = gainslib.solve_gains(pts_b, adj, telemetry=True)
+        carry0 = gainslib.init_carry(len(pts_a),
+                                     gainslib.planar_of(pts_a))
+        _, carry_a = gainslib.solve_gains(pts_a, adj, carry=carry0)
+        warm_b, _, st_warm = gainslib.solve_gains(pts_b, adj,
+                                                  carry=carry_a,
+                                                  telemetry=True)
+        assert int(st_warm.iters) < int(st_cold.iters)
+        np.testing.assert_allclose(np.asarray(warm_b),
+                                   np.asarray(cold_b), atol=5e-3)
+
+    def test_batch_bit_parity_with_serial(self):
+        n, B = 10, 3
+        rng = np.random.default_rng(4)
+        pts = rng.normal(size=(B, n, 3)) * 4
+        adjs = np.stack([fc_adj(n)] * B)
+        adjs[1, 0, 3] = adjs[1, 3, 0] = 0     # distinct graphs, one bucket
+        batched = np.asarray(gainslib.solve_gains_batch(
+            pts, adjs, max_nonedges=2))
+        for b in range(B):
+            serial = np.asarray(gainslib.solve_gains(
+                pts[b], adjs[b], max_nonedges=2))
+            assert np.array_equal(batched[b], serial), b
+
+    def test_f32_gate_validates_or_falls_back(self):
+        pts, adj = nine_agent_case()
+        g, report = gainslib.solve_gains_f32(pts, adj)
+        assert isinstance(report["f32_ok"], bool)
+        v = gainslib.validate_gains(np.asarray(g), pts, tol=1e-4)
         assert v["no_positive"] and v["kernel_ok"] \
             and v["strictly_negative_rest"]

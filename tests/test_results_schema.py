@@ -559,3 +559,112 @@ def test_serve_overload_schema_flags_drift():
     rows[0] = dict(rows[0], bogus=1)
     assert any("unknown keys" in p
                for p in check_serve_overload(rows, "x"))
+
+
+# ------------------------------------------ warm-pipeline artifact (PR 16)
+
+def _pipe_rows():
+    return [
+        {"name": "admm_warm_start", "n": 100, "backend": "cpu",
+         "cold_iters": 12, "warm_iters": 2, "iters_speedup": 6.0,
+         "cold_ms": 2300.0, "warm_ms": 420.0, "time_speedup": 5.5,
+         "gains_maxdiff": 0.0012, "quick": False},
+        {"name": "assign_churn", "n": 24, "assignment": "cbaa",
+         "warm_tables": False, "assign_eps": 0.0, "assign_every": 30,
+         "rematch_every": 60, "drift_speed": 0.08, "ticks": 2400,
+         "auctions": 40, "reassigns": 16, "churn_rate": 0.4,
+         "lag_rms_m": 1.93, "baseline_parity": True, "quick": False},
+        {"name": "assign_churn", "n": 24, "assignment": "cbaa",
+         "warm_tables": True, "assign_eps": 0.1, "assign_every": 30,
+         "rematch_every": 60, "drift_speed": 0.08, "ticks": 2400,
+         "auctions": 40, "reassigns": 4, "churn_rate": 0.1,
+         "lag_rms_m": 1.51, "baseline_parity": False, "quick": False},
+        {"name": "pipeline_rate", "n": 1000, "mode": "composed",
+         "backend": "tpu", "assignment": "sinkhorn", "assign_every": 120,
+         "redesign_every": 120, "ticks": 0, "warm_gains": True,
+         "tick_ms": 6.13, "stage_ms": {"tick": 6.13, "assign": 1.012,
+                                       "gains": 75.33},
+         "gains_source": "scale_tpu.json", "value": 147.79,
+         "unit": "Hz", "quick": False},
+    ]
+
+
+def test_pipeline_schema_accepts_valid_rows(tmp_path):
+    from check_results import check_pipeline_n1000
+    assert check_pipeline_n1000(_pipe_rows(), "x") == []
+    p = tmp_path / "pipeline_n1000.json"
+    p.write_text("\n".join(json.dumps(r) for r in _pipe_rows()) + "\n")
+    assert check_file(p) == []
+
+
+def test_pipeline_schema_flags_drift():
+    """Exact key set + the acceptance bars AS schema: the >= 3x warm
+    iteration speedup, the bitwise hysteresis-off parity row, and the
+    n=1000 >= 100 Hz warm headline are owed by the committed artifact."""
+    from check_results import check_pipeline_n1000
+
+    def drop(rows, i, key):
+        rows[i] = {k: v for k, v in rows[i].items() if k != key}
+        return rows
+
+    assert any("missing keys" in p for p in check_pipeline_n1000(
+        drop(_pipe_rows(), 0, "warm_iters"), "x"))
+    rows = _pipe_rows()
+    rows[3] = dict(rows[3], extra=1)
+    assert any("unknown keys" in p
+               for p in check_pipeline_n1000(rows, "x"))
+    rows = _pipe_rows()
+    rows[3] = dict(rows[3], value=float("nan"))
+    probs = check_pipeline_n1000(rows, "x")
+    assert any("finite" in p for p in probs)
+    # NaN kills the headline too
+    assert any("headline" in p for p in probs)
+    # warm start must keep paying: speedup below the 3x bar on every
+    # admm row fails the committed artifact
+    rows = _pipe_rows()
+    rows[0] = dict(rows[0], warm_iters=10, iters_speedup=1.2)
+    assert any("speedup" in p for p in check_pipeline_n1000(rows, "x"))
+    # the zero-cost-off proof: the hysteresis-off row must be bitwise
+    # parity, and its absence is itself a failure
+    rows = _pipe_rows()
+    rows[1] = dict(rows[1], baseline_parity=False)
+    assert any("bitwise" in p for p in check_pipeline_n1000(rows, "x"))
+    # headline: no warm n=1000 row >= 100 Hz fails
+    rows = _pipe_rows()
+    rows[3] = dict(rows[3], value=80.0)
+    assert any("headline" in p for p in check_pipeline_n1000(rows, "x"))
+    # churn_rate is a fraction
+    rows = _pipe_rows()
+    rows[2] = dict(rows[2], churn_rate=1.4)
+    assert any("[0, 1]" in p for p in check_pipeline_n1000(rows, "x"))
+    # stage_ms is an exact-key nested dict
+    rows = _pipe_rows()
+    rows[3] = dict(rows[3], stage_ms={"tick": 6.13})
+    assert any("stage_ms" in p for p in check_pipeline_n1000(rows, "x"))
+    # a QUICK artifact is exempt from the bars, not from the schema
+    rows = [dict(r, quick=True) for r in _pipe_rows()]
+    rows[0] = dict(rows[0], warm_iters=10, iters_speedup=1.2)
+    rows[1] = dict(rows[1], baseline_parity=False)
+    rows[3] = dict(rows[3], value=80.0)
+    assert check_pipeline_n1000(rows, "x") == []
+
+
+def test_pipeline_artifact_committed():
+    """The ROADMAP item 1 headline evidence: warm-vs-cold ADMM >= 3x,
+    the churn/lag hysteresis curve with its bitwise off-parity row, and
+    a sustained warm n=1000 pipeline row >= 100 Hz."""
+    from check_results import check_pipeline_n1000
+    path = RESULTS / "pipeline_n1000.json"
+    assert path.exists(), "benchmarks/results/pipeline_n1000.json " \
+                          "missing (python benchmarks/pipeline_rate.py " \
+                          "--out benchmarks/results/pipeline_n1000.json)"
+    assert check_file(path) == []
+    rows = [json.loads(ln) for ln in path.read_text().strip().splitlines()]
+    admm = [r for r in rows if r["name"] == "admm_warm_start"]
+    assert any(r["iters_speedup"] >= 3.0 for r in admm)
+    churn = [r for r in rows if r["name"] == "assign_churn"]
+    assert any(r["baseline_parity"] for r in churn
+               if not r["warm_tables"] and r["assign_eps"] == 0.0)
+    heads = [r for r in rows if r["name"] == "pipeline_rate"
+             and r["n"] == 1000 and r["warm_gains"]]
+    assert any(r["value"] >= 100.0 for r in heads)

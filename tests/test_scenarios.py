@@ -477,6 +477,7 @@ def test_fuzz_full_sweep_zero_violations():
     assert bad == []
 
 
+@pytest.mark.slow
 def test_serve_scenario_requests_end_to_end(tmp_path):
     """Acceptance: a scenario request flows admission -> staged round ->
     journal -> postmortem; it shares the bucket (one compiled program)

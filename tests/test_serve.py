@@ -485,6 +485,31 @@ class TestRecovery:
         assert svc2.stats["accepted"] == 0     # replayed, not re-run
 
 
+# ----------------------------------------------- warm gains over the wire
+
+class TestGainsWarmCarry:
+    """ADMM warm start riding the request (ROADMAP item 1): ``warm``
+    bootstraps carry threading with gains BITWISE equal to the legacy
+    path (cold seed == cold solve), the returned carry is codec-plain
+    numpy, and re-submitting it re-seeds the next design."""
+
+    def test_warm_bootstrap_bitwise_legacy_then_reseed(self, svc):
+        legacy = svc.submit("gains", {"n": 5, "seed": 3}, tenant="a") \
+            .result(240)
+        warm = svc.submit("gains", {"n": 5, "seed": 3, "warm": True},
+                          tenant="a").result(240)
+        assert legacy.ok and warm.ok
+        assert "carry" not in legacy.value
+        assert np.array_equal(warm.value["gains"], legacy.value["gains"])
+        carry = warm.value["carry"]
+        assert all(isinstance(v, np.ndarray) for v in carry.values())
+        re = svc.submit("gains", {"n": 5, "seed": 3, "carry": carry},
+                        tenant="a").result(240)
+        assert re.ok and "carry" in re.value
+        np.testing.assert_allclose(re.value["gains"],
+                                   legacy.value["gains"], atol=5e-3)
+
+
 # ------------------------------------------------- fairness + shutdown
 
 class TestFairnessAndShutdown:

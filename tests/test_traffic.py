@@ -66,6 +66,7 @@ class TestSchedule:
 
 
 class TestFleetEndToEnd:
+    @pytest.mark.slow
     def test_small_fleet_ledger_reconciles(self):
         """A polite mini-fleet over TCP: every arrival reaches a
         terminal outcome (nothing unresolved), accepted == completed,

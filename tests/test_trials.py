@@ -392,3 +392,61 @@ def test_flooded_localization_trial_completes(tmp_path):
                              out=str(out), verbose=False)
     stats = trials.run_trials(cfg)
     assert stats["trials_completed"] == 1
+
+
+def test_admm_carry_payload_roundtrips_codec(tmp_path):
+    """The dispatch carry crosses the trials checkpoint as codec-plain
+    numpy (`_carry_payload`/`_carry_restore`): bit-exact round-trip
+    through the resilience checkpoint file, None staying None (a trial
+    that never dispatched), and the restored carry re-seeding
+    `solve_gains` bitwise-identically to the original."""
+    import jax.numpy as jnp
+
+    from aclswarm_tpu import gains as gainslib
+    from aclswarm_tpu.resilience import checkpoint as ckptlib
+
+    rng = np.random.default_rng(2)
+    n = 8
+    pts = rng.normal(size=(n, 3)) * 4
+    adj = np.ones((n, n)) - np.eye(n)
+    carry0 = gainslib.init_carry(n, gainslib.planar_of(pts))
+    g, carry = gainslib.solve_gains(pts, adj, carry=carry0)
+
+    assert trials._carry_payload(None) is None
+    assert trials._carry_restore(None) is None
+    payload = {"admm_carry": trials._carry_payload(carry),
+               "none_carry": trials._carry_payload(None)}
+    path = ckptlib.write_checkpoint(
+        tmp_path, "t", payload, ckptlib.make_manifest("t", "h", chunk=0))
+    loaded, _ = ckptlib.load_checkpoint(path)
+    assert loaded["none_carry"] is None
+    back = trials._carry_restore(loaded["admm_carry"])
+    for a, b in zip(back, carry):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # the restored carry seeds the next dispatch bitwise like the live one
+    g_live, _ = gainslib.solve_gains(pts, adj, carry=carry)
+    g_back, _ = gainslib.solve_gains(pts, adj, carry=back)
+    assert np.array_equal(np.asarray(g_live), np.asarray(g_back))
+
+
+def test_cbaa_tables_roundtrip_codec(tmp_path):
+    """`CbaaTables` (the engine's cross-auction warm state) round-trips
+    the checkpoint codec bit-exactly — it rides `SimState` through
+    resilience saves and serve preemption exactly like FaultSchedule."""
+    import jax.numpy as jnp
+
+    from aclswarm_tpu.assignment import cbaa
+    from aclswarm_tpu.resilience import checkpoint as ckptlib
+
+    tab = cbaa.CbaaTables(
+        price=jnp.asarray(np.random.default_rng(4).random((6, 6))),
+        who=jnp.asarray(np.arange(36, dtype=np.int32).reshape(6, 6) % 6))
+    payload = {k: np.asarray(v) for k, v in tab._asdict().items()}
+    path = ckptlib.write_checkpoint(
+        tmp_path, "t", payload, ckptlib.make_manifest("t", "h", chunk=1))
+    loaded, _ = ckptlib.load_checkpoint(path)
+    back = cbaa.CbaaTables(**{k: jnp.asarray(v)
+                              for k, v in loaded.items()})
+    for a, b in zip(back, tab):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+        assert np.asarray(a).dtype == np.asarray(b).dtype
