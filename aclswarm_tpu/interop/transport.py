@@ -453,17 +453,28 @@ def connect_when_ready(host: str, port: int, grace_s: float = 5.0,
     seen_listener = [False]
 
     def _try() -> bool:
+        # close-on-every-failed-exit is STRUCTURAL (try/finally), not
+        # per-branch: a refused-then-retried connect storm runs this
+        # dozens of times, and any exit path that skipped the close —
+        # a settimeout error, a failed SocketChannel wrap — would leak
+        # one fd per attempt until the process hits its rlimit
+        # (regression: tests/test_interop.py fd-count over 50 refusals)
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        s.settimeout(max(poll_s, 0.05))
+        handed_off = False
         try:
-            s.connect((host, port))
-        except OSError as e:
-            s.close()
-            if e.errno != errno.ECONNREFUSED:
-                seen_listener[0] = True
-            return False
-        out.append(SocketChannel(s, f"tcp:{host}:{port}"))
-        return True
+            s.settimeout(max(poll_s, 0.05))
+            try:
+                s.connect((host, port))
+            except OSError as e:
+                if e.errno != errno.ECONNREFUSED:
+                    seen_listener[0] = True
+                return False
+            out.append(SocketChannel(s, f"tcp:{host}:{port}"))
+            handed_off = True
+            return True
+        finally:
+            if not handed_off:
+                s.close()
 
     if not poll_until(_try, grace_s=grace_s, poll_s=poll_s):
         if seen_listener[0]:

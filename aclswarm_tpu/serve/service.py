@@ -168,6 +168,16 @@ class ServiceConfig:
     # SLO catalog override (tuple of telemetry.slo.SloSpec); None =
     # telemetry.slo.default_slos(max_queue_total=cfg.max_queue_total)
     slos: Optional[tuple] = None
+    # ---- process mode (serve.procworker / serve.router;
+    # docs/SERVICE.md §process mode): this service's journal
+    # incarnation. Thread mode leaves it 0; a procworker hosting one
+    # router slot carries the slot's spawn generation, so every req/
+    # done frame and lifecycle event is stamped with the PROCESS
+    # generation that wrote it, and a FENCE frame in the shared
+    # per-slot journal dir (written by the successor incarnation
+    # before it recovers) turns a zombie predecessor's journal writes
+    # into loud no-ops instead of corruption.
+    incarnation: int = 0
 
 
 @dataclasses.dataclass
@@ -500,6 +510,41 @@ def _read_frame(path: Path):
     return ckptlib.loads(path.read_bytes(), path)
 
 
+# incarnation fence (process mode, docs/SERVICE.md §process mode): one
+# codec frame in the journal dir naming the minimum incarnation allowed
+# to write there
+FENCE_NAME = "FENCE"
+
+
+def write_fence(journal_dir, incarnation: int) -> None:
+    """Stamp ``journal_dir`` as owned by ``incarnation`` (atomic codec
+    frame). The SUCCESSOR writes this before it recovers the journal:
+    a predecessor process that missed its lease but is still running
+    observes the fence within `SwarmService.FENCE_CHECK_S` and every
+    later journal write from it becomes a loud no-op — the
+    declare-dead→respawn sequence never waits on the zombie actually
+    exiting."""
+    _write_frame(Path(journal_dir) / FENCE_NAME, {},
+                 ckptlib.make_manifest("serve_fence", "-", chunk=0,
+                                       incarnation=int(incarnation)))
+
+
+def read_fence(journal_dir) -> Optional[int]:
+    """The incarnation currently fencing ``journal_dir`` (None when
+    unfenced or unreadable — an unreadable fence fails OPEN: refusing
+    writes on a torn fence would turn a crash mid-`write_fence` into a
+    permanently wedged slot)."""
+    path = Path(journal_dir) / FENCE_NAME
+    try:
+        if not path.is_file():
+            return None
+        _, man = _read_frame(path)
+    except (OSError, ckptlib.CheckpointError):
+        return None
+    inc = man.get("incarnation")
+    return int(inc) if inc is not None else None
+
+
 class SwarmService:
     """The in-process serving front end + device worker (docs/SERVICE.md).
 
@@ -555,8 +600,26 @@ class SwarmService:
         # crash (`telemetry.spans.install_crash_dump`)
         self._trace: Optional[LifecycleLog] = None
         self._span_dump = None
+        # incarnation fence (process mode): checked before every journal
+        # write, cached between FENCE_CHECK_S re-stats so the hot path
+        # pays one monotonic read, not a stat per frame
+        self._fence_path = (self._journal / FENCE_NAME
+                            if self._journal is not None else None)
+        self._fence_next = 0.0
+        self._fence_lost = False
+        # the scrape surface reports the process identity alongside the
+        # fleet gauges — `watch --follow` tells a RESPAWNED worker
+        # process (new pid + incarnation) from a reconnect of the old
+        # one (same pid + incarnation) by exactly these two
+        self.telemetry.gauge("serve_pid").set(os.getpid())
+        self.telemetry.gauge("serve_incarnation").set(cfg.incarnation)
         if self._journal is not None:
             self._journal.mkdir(parents=True, exist_ok=True)
+            if not self._fence_ok():
+                raise RuntimeError(
+                    f"journal {self._journal} is fenced by a newer "
+                    f"incarnation than {cfg.incarnation} — refusing to "
+                    "recover a journal this process no longer owns")
             self._trace = LifecycleLog(self._journal / "events.log",
                                        log=self.log)
             self._span_dump = install_crash_dump(
@@ -656,12 +719,19 @@ class SwarmService:
             # construction (serve.staging; docs/SERVICE.md)
             self._adm.admit(job, hold=True)
             if self._journal is not None:
+                if not self._fence_ok():
+                    # a fenced process must not take NEW acceptance
+                    # promises: its journal frames would be invisible
+                    # to the incarnation that owns the dir now, which
+                    # is exactly a silent loss
+                    raise RejectedError(E_SHUTDOWN, 0.0)
                 _write_frame(
                     self._req_path(rid), {"params": params},
                     ckptlib.make_manifest(
                         "serve_req", ckptlib.config_hash(params), chunk=0,
                         request_id=rid, tenant=tenant, req_kind=kind,
                         deadline_s=deadline_s, t_submit=req.t_submit,
+                        incarnation=self.cfg.incarnation,
                         trace_id=req.trace_id))
                 # the acceptance events land BEFORE the job becomes
                 # pickable: a fast worker's `batched` record must never
@@ -699,7 +769,10 @@ class SwarmService:
                     e.retry_after_s)
             self._adm.cancel(job)
             self._sample_queue()
-            if self._journal is not None:
+            if self._journal is not None and not self._fence_lost:
+                # fenced submits raised BEFORE writing their frame —
+                # unlinking here would delete a frame the successor
+                # incarnation may have journaled under the same rid
                 self._req_path(rid).unlink(missing_ok=True)
             # a duplicate submit that attached during the reservation
             # window holds this ticket: resolve it so it can never
@@ -840,6 +913,40 @@ class SwarmService:
     def _done_path(self, rid: str) -> Path:
         assert self._journal is not None
         return self._journal / f"req_{rid}.done"
+
+    # ------------------------------------------------- incarnation fence
+
+    FENCE_CHECK_S = 0.05    # max fence-observation latency (re-stat gap)
+
+    def _fence_ok(self) -> bool:
+        """True while this process still owns its journal. Process mode:
+        a successor incarnation fences the shared per-slot journal dir
+        (`write_fence`) before recovering it; this predecessor — a
+        zombie that missed its lease but never exited — sees the fence
+        within ``FENCE_CHECK_S`` and every subsequent journal write
+        no-ops LOUDLY. Stamped frames plus this check are what make
+        "declare dead on connection death" safe without waiting for
+        the process to actually die. Thread mode never writes a fence,
+        so the check stays a cached no-op."""
+        if self._fence_path is None:
+            return True
+        if self._fence_lost:
+            return False
+        now = time.monotonic()
+        if now < self._fence_next:
+            return True
+        self._fence_next = now + self.FENCE_CHECK_S
+        fence = read_fence(self._journal)
+        if fence is not None and fence > int(self.cfg.incarnation):
+            self._fence_lost = True
+            self.telemetry.counter("serve_fenced_total").inc()
+            self.log.error(
+                "journal FENCE: incarnation %d owns %s now (this "
+                "process is incarnation %d) — every further journal "
+                "write from this process is a no-op",
+                fence, self._journal, self.cfg.incarnation)
+            return False
+        return True
 
     # ------------------------------------------------------- worker rounds
     #
@@ -1001,8 +1108,14 @@ class SwarmService:
             trace_id=job.req.trace_id)
         if to_disk:
             assert self._ckpt_dir is not None
-            ckptlib.write_checkpoint(self._ckpt_dir, self._stem(job),
-                                     payload, man)
+            if not self._fence_ok():
+                # a zombie's checkpoint would race the successor's
+                # resume of the same request — skip the disk write
+                # (the in-memory copy below is process-local and safe)
+                self.telemetry.counter("serve_fenced_writes_total").inc()
+            else:
+                ckptlib.write_checkpoint(self._ckpt_dir, self._stem(job),
+                                         payload, man)
         else:
             job._ckpt_bytes = ckptlib.dumps(payload, man)
         self._journal_event("checkpointed", job, chunk=job.chunks_done,
@@ -1734,8 +1847,13 @@ class SwarmService:
         if fmt == "snapshot":
             with self._lock:
                 counters = {k: v for k, v in self.stats.items()}
+            # pid + incarnation name the PROCESS generation serving
+            # this scrape: `watch --follow` tells a respawned worker
+            # process (both change) from a reconnect of the old one
+            # (neither does)
             return {"format": fmt, "snapshot": self.telemetry.snapshot(),
-                    "serve": counters}
+                    "serve": counters, "pid": os.getpid(),
+                    "incarnation": int(self.cfg.incarnation)}
         raise ValueError(f"unknown stats format {fmt!r} "
                          "(expected 'prometheus' or 'snapshot')")
 
@@ -1761,6 +1879,10 @@ class SwarmService:
         out = {
             "t_wall": time.time(),
             "alive": bool(self.alive),
+            # process identity (see _do_stats): respawn vs reconnect
+            # are distinguishable from the scrape alone
+            "pid": os.getpid(),
+            "incarnation": int(self.cfg.incarnation),
             "watch_enabled": self.watch is not None,
             "watch": (self.watch.health()
                       if self.watch is not None else None),
@@ -1971,10 +2093,14 @@ class SwarmService:
             return
         if not self.cfg.trace and event not in _LEDGER_EVENTS:
             return
+        if not self._fence_ok():
+            self.telemetry.counter("serve_fenced_writes_total").inc()
+            return
         self._trace.emit(
             event,
             request_id=job.req.request_id if job is not None else None,
             trace_id=job.req.trace_id if job is not None else "",
+            incarnation=self.cfg.incarnation,
             **fields)
 
     def _flush_spans(self, reason: str) -> None:
@@ -2008,18 +2134,26 @@ class SwarmService:
         # client can observe the result, so "resolved but not journaled"
         # is impossible and recovery never re-runs finished work
         if journal and self._journal is not None:
-            _write_frame(
-                self._done_path(job.req.request_id),
-                {"value": value,
-                 "error": error.to_row() if error else None},
-                ckptlib.make_manifest(
-                    "serve_done", "-", chunk=job.chunks_done,
-                    request_id=job.req.request_id, status=status,
-                    latency_s=res.latency_s, queued_s=res.queued_s,
-                    preemptions=job.preemptions, resumed=job.resumed,
-                    failovers=job.failovers,
-                    tenant=job.req.tenant, req_kind=job.req.kind,
-                    t_done=t_done, trace_id=job.req.trace_id))
+            if not self._fence_ok():
+                # zombie write no-op: the successor incarnation owns
+                # this journal — ITS recovery already re-admitted the
+                # request, and a done-frame from us would overwrite the
+                # live incarnation's ledger
+                self.telemetry.counter("serve_fenced_writes_total").inc()
+            else:
+                _write_frame(
+                    self._done_path(job.req.request_id),
+                    {"value": value,
+                     "error": error.to_row() if error else None},
+                    ckptlib.make_manifest(
+                        "serve_done", "-", chunk=job.chunks_done,
+                        request_id=job.req.request_id, status=status,
+                        latency_s=res.latency_s, queued_s=res.queued_s,
+                        preemptions=job.preemptions, resumed=job.resumed,
+                        failovers=job.failovers,
+                        tenant=job.req.tenant, req_kind=job.req.kind,
+                        incarnation=self.cfg.incarnation,
+                        t_done=t_done, trace_id=job.req.trace_id))
         # the terminal trace record: journaled whether or not the
         # done-frame was (a close()-raced submit resolves its ticket
         # with journal=False, but the timeline still owes its ending)

@@ -79,6 +79,7 @@ from __future__ import annotations
 
 import contextlib
 import fcntl
+import os
 import queue as queuelib
 import threading
 import time
@@ -363,14 +364,24 @@ class WireServer:
         self._conns[cid] = conn
         if prior is not None:
             self._close_conn(prior)    # successor owns the cid now
-        self._send_conn(conn, _frame(K_HELLO_ACK, {
-            "server": self.base or "tcp",
-            "workers": int(self.svc.stats.get("workers", 1))}),
-            what="hello-ack")
+        self._send_conn(conn, _frame(K_HELLO_ACK, self._hello_ack()),
+                        what="hello-ack")
         self.svc.telemetry.gauge("wire_connections").set(
             sum(1 for c in self._conns.values() if not c.dead))
         self.log.info("wire: client %s connected over tcp (%s)",
                       cid, chan.name)
+
+    def _hello_ack(self) -> dict:
+        """The HELLO reply payload. ``pid`` + ``incarnation`` name the
+        server PROCESS generation: a reconnect to the same process
+        echoes the same pair, a respawned procworker (serve.procworker)
+        presents a new one — `telemetry/watch.py --follow` and the
+        router's supervision tier both key on exactly this."""
+        return {"server": self.base or "tcp",
+                "pid": os.getpid(),
+                "incarnation": int(getattr(
+                    getattr(self.svc, "cfg", None), "incarnation", 0)),
+                "workers": int(self.svc.stats.get("workers", 1))}
 
     def _decode(self, raw: bytes, where: str):
         """Codec-framed decode with CRC rejection: a corrupt frame is
@@ -421,10 +432,8 @@ class WireServer:
                 continue
             conn = _Conn(cid, c2s, s2c)
             self._conns[cid] = conn
-            self._send_conn(conn, _frame(K_HELLO_ACK, {
-                "server": self.base,
-                "workers": int(self.svc.stats.get("workers", 1))}),
-                what="hello-ack")
+            self._send_conn(conn, _frame(K_HELLO_ACK, self._hello_ack()),
+                            what="hello-ack")
             self.svc.telemetry.gauge("wire_connections").set(
                 sum(1 for c in self._conns.values() if not c.dead))
             self.log.info("wire: client %s connected", cid)
@@ -730,6 +739,10 @@ class WireClient:
             self._ctl = transport.open_when_ready(
                 f"{base}.ctl", grace_s=hello_timeout_s)
         self._tickets: dict[str, Ticket] = {}
+        # the HELLO-ack payload: server identity (pid, incarnation,
+        # workers) — callers distinguishing a RESPAWNED server process
+        # from a reconnect of the old one read it here
+        self.server_info: dict = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._connected = threading.Event()
@@ -915,6 +928,7 @@ class WireClient:
 
     def _handle(self, payload: dict, kind: Optional[str]) -> None:
         if kind == K_HELLO_ACK:
+            self.server_info = dict(payload)
             self._connected.set()
             return
         rid = str(payload.get("request_id", ""))

@@ -107,13 +107,18 @@ def place_slot(bucket, candidates: List[int],
     worker death cheap. Deterministic (crc32, no `random`).
     ``key`` is the precomputed ``repr(bucket).encode()`` — the hot
     eligibility path caches it per job (buckets are immutable) so
-    queue scans don't re-encode on every poll."""
+    queue scans don't re-encode on every poll.
+
+    Candidates may be ints (thread slots) or strings (the process
+    fleet's ``slot.gen`` uids — hashing over the INCARNATION set is
+    what makes a respawn re-place only the dead incarnation's
+    buckets); ties break to the smallest candidate either way."""
     if not candidates:
         return None
     if key is None:
         key = repr(bucket).encode()
-    return max(candidates,
-               key=lambda s: (zlib.crc32(key + f":{s}".encode()), -s))
+    return min(candidates,
+               key=lambda s: (-zlib.crc32(key + f":{s}".encode()), s))
 
 
 class WorkerPool:

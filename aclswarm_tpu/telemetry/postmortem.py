@@ -47,7 +47,7 @@ from aclswarm_tpu.telemetry.lifecycle import (EVENTS, TERMINAL_EVENTS,
                                               LifecycleLog)
 
 __all__ = ["load_journal", "analyze_request", "reconstruct",
-           "fleet_summary", "main"]
+           "fleet_summary", "fleet_reconstruct", "main"]
 
 EVENTS_LOG = "events.log"
 
@@ -281,6 +281,70 @@ def reconstruct(journal_dir, request_id: Optional[str] = None,
     }
 
 
+def fleet_reconstruct(journal_dirs, timelines: bool = False) -> dict:
+    """Reconstruct across a PROCESS FLEET's per-slot journals (the
+    router tier's `journal_dirs()`): one request may have frames in
+    several journals — journaled on the process that first accepted
+    it, SIGKILLed, then re-journaled and finished on the survivor the
+    router migrated it to. The merge rule is the promise rule:
+
+    - a request is **resolved** iff SOME journal holds its terminal;
+      its verdict (complete / gap-free / stages) is taken from that
+      RESOLVING journal — the predecessor's truncated timeline is not
+      a gap, it is a migration (counted, listed per-request);
+    - a request journaled somewhere but terminal NOWHERE is a
+      **loss** — the number the zero-loss drills assert is empty;
+    - a request terminal in MORE THAN ONE journal is counted in
+      ``duplicate_terminals``: the fleet is at-least-once across
+      slots (the router re-places a dead slot's work onto a survivor
+      while the dead slot's successor independently recovers its
+      journal and honors the same promise) — bounded duplicate
+      compute, never a lost or corrupted result. WITHIN a journal the
+      fence makes zombie duplicates structurally impossible.
+    """
+    reports = [reconstruct(d, timelines=timelines)
+               for d in journal_dirs]
+    requests: dict = {}
+    dup_terminals: list = []
+    for rep in reports:
+        for rid, r in rep["requests"].items():
+            entry = dict(r)
+            entry["journal"] = rep["journal"]
+            prior = requests.get(rid)
+            if prior is None:
+                entry["migrated"] = False
+                requests[rid] = entry
+                continue
+            if r["complete"] and prior["complete"]:
+                dup_terminals.append(rid)
+                continue
+            if r["complete"]:
+                # terminal wins; the earlier journal is the migration
+                # source
+                entry["migrated"] = True
+                requests[rid] = entry
+            else:
+                prior["migrated"] = True
+    resolved = sum(1 for r in requests.values() if r["complete"])
+    gap_free = sum(1 for r in requests.values()
+                   if r["complete"] and r["gap_free"])
+    losses = sorted(rid for rid, r in requests.items()
+                    if not r["complete"])
+    return {
+        "journals": [rep["journal"] for rep in reports],
+        "torn_tail": any(rep["torn_tail"] for rep in reports),
+        "accepted": len(requests),
+        "resolved": resolved,
+        "gap_free": gap_free,
+        "migrated": sum(1 for r in requests.values()
+                        if r.get("migrated")),
+        "losses": losses,
+        "duplicate_terminals": sorted(dup_terminals),
+        "events": sum(rep["events"] for rep in reports),
+        "requests": requests,
+    }
+
+
 def fleet_summary(report: dict) -> dict:
     """One-pass fleet rollup over a `reconstruct` report: verdict
     counts, terminal-status census, chaos counters, and the AGGREGATE
@@ -359,7 +423,12 @@ def _fmt_event(r: dict, t0: float) -> str:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("journal", help="serve journal directory")
+    ap.add_argument("journal", nargs="+",
+                    help="serve journal directory — pass SEVERAL "
+                         "(one per process-fleet slot) for the "
+                         "cross-journal merge: migrated requests "
+                         "resolve wherever their terminal landed, "
+                         "and the exit code asserts zero losses")
     ap.add_argument("--request-id", default=None,
                     help="reconstruct one request (default: all)")
     ap.add_argument("--all", action="store_true", dest="fleet",
@@ -369,7 +438,25 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the full machine-readable report")
     args = ap.parse_args(argv)
-    report = reconstruct(args.journal, request_id=args.request_id,
+    if len(args.journal) > 1:
+        rep = fleet_reconstruct(args.journal)
+        if args.json:
+            print(json.dumps(rep, indent=1, sort_keys=True,
+                             default=str))
+        else:
+            print(f"fleet merge of {len(rep['journals'])} journals: "
+                  f"{rep['accepted']} accepted, {rep['resolved']} "
+                  f"resolved, {rep['gap_free']} gap-free, "
+                  f"{rep['migrated']} migrated, "
+                  f"{len(rep['duplicate_terminals'])} duplicate "
+                  f"terminal(s), {rep['events']} events"
+                  + (" [torn tail dropped]" if rep["torn_tail"]
+                     else ""))
+            for rid in rep["losses"]:
+                print(f"  LOSS: {rid} journaled but terminal in NO "
+                      f"journal")
+        return 0 if not rep["losses"] else 1
+    report = reconstruct(args.journal[0], request_id=args.request_id,
                          timelines=not args.fleet)
     if args.fleet:
         summary = fleet_summary(report)

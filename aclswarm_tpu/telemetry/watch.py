@@ -32,7 +32,8 @@ import json
 import sys
 import time
 
-__all__ = ["render_health", "replay_log", "main"]
+__all__ = ["render_health", "replay_log", "identities",
+           "identity_delta", "main"]
 
 
 def _fmt_val(v) -> str:
@@ -43,17 +44,69 @@ def _fmt_val(v) -> str:
     return str(v)
 
 
+def identities(h: dict) -> dict:
+    """Extract the PROCESS identity map from a ``health`` payload:
+    ``{name: (pid, incarnation)}``. The serving process itself is
+    ``"service"``; a router aggregate adds one entry per worker SLOT
+    (``w0``, ``w1``, ...) so the same slot compares across
+    incarnations. Identity is what tells a RESPAWN (new pid, bumped
+    incarnation — the old process is gone, its journal was fenced and
+    recovered) from a RECONNECT (same pid + incarnation — only the
+    watcher's connection blinked)."""
+    out = {"service": (h.get("pid"), h.get("incarnation"))}
+    for uid, row in (h.get("processes") or {}).items():
+        slot = str(uid).split(".")[0]
+        out[f"w{slot}"] = (row.get("pid"), row.get("incarnation"))
+    return out
+
+
+def identity_delta(prev: dict, cur: dict) -> list:
+    """Human-readable identity transitions between two consecutive
+    `identities` maps (pure — unit-testable without a fleet). Silent
+    on steady state; loud on every generation change."""
+    lines = []
+    for name in sorted(set(prev) | set(cur)):
+        p, c = prev.get(name), cur.get(name)
+        if p == c or c is None:
+            continue
+        if p is None:
+            lines.append(f"{name}: appeared (pid {c[0]}, "
+                         f"incarnation {c[1]})")
+        elif p[0] != c[0] or (c[1] or 0) > (p[1] or 0):
+            lines.append(
+                f"{name}: RESPAWN pid {p[0]}->{c[0]} "
+                f"incarnation {p[1]}->{c[1]} (old process is gone — "
+                "journal fenced + recovered by the successor)")
+        else:
+            lines.append(f"{name}: identity changed {p}->{c}")
+    return lines
+
+
 def render_health(h: dict, origin: str = "") -> str:
     """One human-readable block for a ``health`` payload (the wire
     kind's value dict)."""
     lines = []
     w = h.get("workers") or {}
+    ident = ""
+    if h.get("pid") is not None:
+        ident = (f"pid {h.get('pid')} gen {h.get('incarnation', '?')}"
+                 f"   ")
     lines.append(
         f"swarmwatch{' @ ' + origin if origin else ''}   "
+        f"{ident}"
         f"workers {w.get('up', '?')}/{w.get('total', '?')} up   "
         f"queue {h.get('queue_depth', '?')}   "
         f"inflight {h.get('inflight', '?')}   "
         f"alive {h.get('alive', '?')}")
+    procs = h.get("processes")
+    if isinstance(procs, dict) and procs:
+        # router aggregate: one line per worker PROCESS, identity first
+        lines.append(f"  {'worker':<8} {'pid':<8} {'gen':<5} up")
+        for uid in sorted(procs):
+            row = procs[uid]
+            lines.append(f"  w{uid:<7} {str(row.get('pid', '?')):<8} "
+                         f"{str(row.get('incarnation', '?')):<5} "
+                         f"{row.get('up', '?')}")
     watch = h.get("watch")
     if not h.get("watch_enabled") or not isinstance(watch, dict):
         lines.append("  (swarmwatch disabled on this service — liveness "
@@ -216,20 +269,57 @@ def main(argv=None) -> int:
             print(f"swarmwatch: cannot connect to {args.tcp}: {e}",
                   file=sys.stderr)
             return 2
+        prev_ident = None
         while True:
             try:
                 h = _scrape(client, args.timeout)
             except KeyboardInterrupt:
                 raise
             except Exception as e:      # noqa: BLE001 — CLI boundary
-                print(f"swarmwatch: scrape of {args.tcp} failed: {e}",
-                      file=sys.stderr)
-                return 2
+                if not args.follow:
+                    print(f"swarmwatch: scrape of {args.tcp} failed: "
+                          f"{e}", file=sys.stderr)
+                    return 2
+                # --follow rides through server churn: rebuild the
+                # connection, then let the HELLO-ack identity say
+                # WHICH kind of churn — same (pid, incarnation) means
+                # only our connection blinked (reconnect); a new one
+                # means the server process itself was replaced
+                old_info = dict(client.server_info)
+                try:
+                    client.close(bye=False)
+                except Exception:   # noqa: BLE001 — already broken
+                    pass
+                try:
+                    client = WireClient(tcp=(host, port),
+                                        tenant="swarmwatch")
+                except Exception as e2:  # noqa: BLE001 — CLI boundary
+                    print(f"swarmwatch: scrape failed ({e}) and "
+                          f"reconnect failed ({e2})", file=sys.stderr)
+                    return 2
+                old = (old_info.get("pid"), old_info.get("incarnation"))
+                new = (client.server_info.get("pid"),
+                       client.server_info.get("incarnation"))
+                if old == new:
+                    print(f"swarmwatch: RECONNECT to the same server "
+                          f"process (pid {new[0]}, incarnation "
+                          f"{new[1]}) — only the connection blinked",
+                          file=sys.stderr)
+                else:
+                    print(f"swarmwatch: server RESPAWN detected — pid "
+                          f"{old[0]}->{new[0]}, incarnation "
+                          f"{old[1]}->{new[1]}", file=sys.stderr)
+                continue
             if args.json:
                 print(json.dumps(h, indent=1, sort_keys=True,
                                  default=str))
             else:
                 print(render_health(h, origin=args.tcp))
+                cur_ident = identities(h)
+                if prev_ident is not None:
+                    for line in identity_delta(prev_ident, cur_ident):
+                        print(f"  !! {line}")
+                prev_ident = cur_ident
             firing = ((h.get("watch") or {}).get("firing")
                       if h.get("watch_enabled") else None)
             if not args.follow:

@@ -167,6 +167,39 @@ def pipeline_rows(results_dir: Path | None = None) -> list[dict]:
     return rows
 
 
+def router_rows(results_dir: Path | None = None) -> list[dict]:
+    """Trend-shaped rows from the committed router_fleet artifact
+    (benchmarks/router_fleet.py): ``router_goodput_hz`` (Hz,
+    higher-better) and ``router_p99_ms`` (ms, lower-better) per
+    offered-load level — the cross-process serving surface that must
+    not silently rot. Drill rows are excluded: kills are a chaos
+    count, not a trendable rate. Joins the series map as the
+    pseudo-round after the newest capture, like the overload rows."""
+    results_dir = results_dir or (ROOT / "benchmarks" / "results")
+    path = results_dir / "router_fleet.json"
+    if not path.exists():
+        return []
+    rows = []
+    for line in path.read_text().strip().splitlines():
+        try:
+            r = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(r, dict) or r.get("quick") \
+                or r.get("level") == "drill" \
+                or r.get("name") != "router_fleet":
+            continue
+        common = {"level": r.get("level"), "n": r.get("n"),
+                  "backend": r.get("backend")}
+        rows.append(dict(common, name="router_goodput_hz",
+                         value=r.get("value"), unit="Hz"))
+        p99 = r.get("p99_s")
+        if isinstance(p99, (int, float)) and p99 > 0:
+            rows.append(dict(common, name="router_p99_ms",
+                             value=p99 * 1e3, unit="ms"))
+    return rows
+
+
 def _comparable(row: dict) -> bool:
     v = row.get("value")
     return (isinstance(v, (int, float)) and not isinstance(v, bool)
@@ -219,6 +252,7 @@ def trend(directory: Path, threshold: float) -> tuple[list[str], int]:
     over = overload_rows(res_dir)
     slo = slo_detection_rows(res_dir)
     pipe = pipeline_rows(res_dir)
+    rout = router_rows(res_dir)
     if directory.resolve() != ROOT.resolve():
         # PER-FAMILY fallback to this repo's committed results: a
         # capture dir carrying one artifact but not the other must not
@@ -226,7 +260,8 @@ def trend(directory: Path, threshold: float) -> tuple[list[str], int]:
         over = over or overload_rows()
         slo = slo or slo_detection_rows()
         pipe = pipe or pipeline_rows()
-    cur = over + slo + pipe
+        rout = rout or router_rows()
+    cur = over + slo + pipe + rout
     if cur:
         nxt = (rounds[-1][0] if rounds else 0) + 1
         rounds.extend((nxt, r) for r in cur)

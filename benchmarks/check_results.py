@@ -506,6 +506,156 @@ def check_serve_overload(rows: list, where: str) -> list[str]:
     return probs
 
 
+# the swarmrouter cross-process fleet artifact
+# (benchmarks/router_fleet.py; docs/SERVICE.md §process mode): a
+# p99-vs-offered-load curve measured from a client in its OWN OS
+# process against a router supervising >= 2 procworker processes,
+# plus exactly one rolling-restart drill row. The bars ride as
+# schema: pairwise-distinct pids on every row (the separation is
+# provenance, not prose), a reconciling client ledger with zero
+# unresolved tickets, and a drill with >= 2 kills, >= 1 migration,
+# sub-2 s detection, a bit-identical migrated probe, and ZERO
+# journaled losses across the merged per-slot journals.
+ROUTER_FLEET = "router_fleet.json"
+_ROUTER_COUNTS = ("offered", "completed", "timed_out", "shed",
+                  "cancelled", "wire_lost", "failed_other",
+                  "unresolved", "client_pid", "router_pid")
+_ROUTER_SHARED = set(_ROUTER_COUNTS) | {
+    "name", "level", "multiplier", "n", "backend", "workers",
+    "capacity_hz", "offered_hz", "value", "unit", "worker_pids",
+    "separate_client_process", "wall_s", "quick"}
+_ROUTER_LEVEL_KEYS = _ROUTER_SHARED | {
+    "p50_s", "p99_s", "retry_submits"}
+_ROUTER_DRILL_KEYS = _ROUTER_SHARED | {
+    "kills", "migrations", "detection_ms_max", "readmitted",
+    "restarts", "restart_drained", "restart_readmitted",
+    "bit_identical", "probe_status", "probe_failovers",
+    "journaled_losses", "duplicate_terminals", "pm_resolved",
+    "pm_gap_free"}
+_ROUTER_MIN_LEVELS = 3
+_ROUTER_MIN_KILLS = 2
+_ROUTER_DETECT_MS = 2000.0
+
+
+def check_router_fleet(rows: list, where: str) -> list[str]:
+    """Validate router_fleet rows: exact key sets (level vs drill
+    shape), pid provenance, reconciling ledgers, and the drill
+    acceptance bars AS schema."""
+    probs = []
+    levels: dict = {}
+    drills: list = []
+    any_committed = False
+    for i, row in enumerate(rows, 1):
+        at = f"{where}:{i}"
+        if not isinstance(row, dict):
+            probs.append(f"{at}: row is not a JSON object")
+            continue
+        is_drill = row.get("level") == "drill"
+        want = _ROUTER_DRILL_KEYS if is_drill else _ROUTER_LEVEL_KEYS
+        missing, unknown = want - set(row), set(row) - want
+        if missing:
+            probs.append(f"{at}: missing keys {sorted(missing)}")
+        if unknown:
+            probs.append(f"{at}: unknown keys {sorted(unknown)} "
+                         "(exact-key-set schema)")
+        if row.get("name") != "router_fleet":
+            probs.append(f"{at}: 'name' must be 'router_fleet'")
+        if row.get("unit") != ("kills" if is_drill else "Hz"):
+            probs.append(f"{at}: 'unit' must be "
+                         f"{'kills' if is_drill else 'Hz'!r}")
+        for k in _ROUTER_COUNTS:
+            if k in row and not _is_count(row[k]):
+                probs.append(f"{at}: '{k}' must be a non-negative int, "
+                             f"got {row[k]!r}")
+        for k in ("multiplier", "capacity_hz", "offered_hz", "wall_s"):
+            if k in row and not (_finite_num(row[k]) and row[k] >= 0):
+                probs.append(f"{at}: '{k}' must be a finite "
+                             f"non-negative number, got {row[k]!r}")
+        if "quick" in row and not isinstance(row["quick"], bool):
+            probs.append(f"{at}: 'quick' must be a bool")
+        # pid provenance: the whole point of the artifact is that the
+        # client, the router, and every worker are DIFFERENT processes
+        pids = [row.get("client_pid"), row.get("router_pid"),
+                *(row.get("worker_pids") or [])]
+        if not isinstance(row.get("worker_pids"), list) \
+                or len(row.get("worker_pids") or []) < 2:
+            probs.append(f"{at}: 'worker_pids' must list >= 2 worker "
+                         "processes")
+        elif all(_is_count(p) for p in pids) \
+                and len(set(pids)) != len(pids):
+            probs.append(f"{at}: client/router/worker pids must be "
+                         f"pairwise distinct, got {pids}")
+        if row.get("separate_client_process") is not True:
+            probs.append(f"{at}: 'separate_client_process' must be "
+                         "true — the client fleet must run in its own "
+                         "OS process")
+        # the client ledger must reconcile
+        if all(_is_count(row.get(k)) for k in
+               ("offered", "completed", "timed_out", "shed",
+                "cancelled", "wire_lost", "failed_other",
+                "unresolved")):
+            total = (row["completed"] + row["timed_out"] + row["shed"]
+                     + row["cancelled"] + row["wire_lost"]
+                     + row["failed_other"] + row["unresolved"])
+            if total != row["offered"]:
+                probs.append(
+                    f"{at}: offered ({row['offered']}) != completed + "
+                    f"timed_out + shed + cancelled + wire_lost + "
+                    f"failed_other + unresolved ({total}) — the client "
+                    "ledger must reconcile")
+        if row.get("unresolved") not in (0, None):
+            probs.append(f"{at}: unresolved must be 0 (got "
+                         f"{row.get('unresolved')!r})")
+        if is_drill:
+            drills.append((at, row))
+        elif _finite_num(row.get("multiplier")):
+            levels[row["multiplier"]] = row
+        any_committed = any_committed or not row.get("quick")
+    for at, d in drills:
+        if _is_count(d.get("kills")) \
+                and d["kills"] < _ROUTER_MIN_KILLS:
+            probs.append(f"{at}: drill killed {d['kills']} worker(s); "
+                         f"the bar is >= {_ROUTER_MIN_KILLS} (one per "
+                         "slot, staggered)")
+        if _is_count(d.get("migrations")) and d["migrations"] < 1:
+            probs.append(f"{at}: drill migrated 0 in-flight routes — "
+                         "a kill that lands on an idle process proves "
+                         "nothing about failover")
+        det = d.get("detection_ms_max")
+        if det is not None and _finite_num(det) \
+                and det >= _ROUTER_DETECT_MS:
+            probs.append(f"{at}: worst kill->declared-dead detection "
+                         f"{det:g} ms breaches the "
+                         f"{_ROUTER_DETECT_MS:g} ms bar")
+        if d.get("journaled_losses") != 0:
+            probs.append(f"{at}: journaled_losses must be 0 — an "
+                         "accepted request terminal in NO slot journal "
+                         "is the one forbidden outcome (got "
+                         f"{d.get('journaled_losses')!r})")
+        if d.get("bit_identical") is not True:
+            probs.append(f"{at}: the migrated probe must resume "
+                         "bit-identical (probe_status="
+                         f"{d.get('probe_status')!r})")
+        for k in ("readmitted", "restart_drained",
+                  "restart_readmitted"):
+            if d.get(k) is not True:
+                probs.append(f"{at}: '{k}' must be true — the rolling "
+                             "restart must re-admit every slot")
+    if rows and any_committed:
+        committed = {m: r for m, r in levels.items()
+                     if not r.get("quick")}
+        if len(committed) < _ROUTER_MIN_LEVELS:
+            probs.append(
+                f"{where}: only {len(committed)} committed offered-"
+                f"load level(s); the curve owes >= "
+                f"{_ROUTER_MIN_LEVELS}")
+        n_drill = sum(1 for _, d in drills if not d.get("quick"))
+        if n_drill != 1:
+            probs.append(f"{where}: exactly one committed drill row "
+                         f"required, found {n_drill}")
+    return probs
+
+
 # the swarmwatch SLO-detection artifact (benchmarks/slo_soak.py;
 # docs/OBSERVABILITY.md §swarmwatch): summary-shaped, exact key set,
 # and the ISSUE-15 acceptance bars baked in AS schema — every scripted
@@ -1195,7 +1345,7 @@ def check_file(path: Path) -> list[str]:
         return check_slo_detection(whole, path.name)
     if path.name in (SERVE_THROUGHPUT, TELEMETRY_OVERHEAD,
                      SERVE_BREAKDOWN, SCENARIO_SUITE, SERVE_OVERLOAD,
-                     PIPELINE):
+                     ROUTER_FLEET, PIPELINE):
         rows, probs = [], []
         for i, line in enumerate(lines, 1):
             try:
@@ -1207,6 +1357,7 @@ def check_file(path: Path) -> list[str]:
                    SERVE_BREAKDOWN: check_serve_latency_breakdown,
                    SCENARIO_SUITE: check_scenario_suite,
                    SERVE_OVERLOAD: check_serve_overload,
+                   ROUTER_FLEET: check_router_fleet,
                    PIPELINE: check_pipeline_n1000}[
                        path.name]
         return probs + checker(rows, path.name)

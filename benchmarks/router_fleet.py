@@ -1,0 +1,450 @@
+"""router_fleet — the cross-process p99-vs-offered-load surface for
+the swarmrouter tier (ROADMAP open item 1; docs/SERVICE.md §process
+mode, docs/SCALING.md §cross-process capacity).
+
+Three kinds of OS process, no shared memory between them:
+
+- the CLIENT fleet: one `serve.traffic` open-loop fleet per level,
+  running in its OWN subprocess (``--client-child``) — the p99 it
+  reports crossed two real process boundaries;
+- the ROUTER: this process hosts `serve.router.SwarmRouter`, the
+  stateless wire front door + supervisor;
+- the WORKERS: 2 `serve.procworker` processes, each its own jax
+  runtime + journal, spawned and leased by the router.
+
+Per level the row reports goodput, client-observed p50/p99, the full
+client outcome ledger, and the pid provenance proving the separation
+(client pid != router pid != worker pids). The DRILL row runs the
+rolling-restart chaos sequence under 1x load: two staggered SIGKILLs
+(hard process death mid-flight, in-flight work migrated through the
+per-slot journals), then a graceful drain -> fence -> respawn ->
+re-admit pass per slot, a bit-identical probe (a fixed-seed rollout
+killed mid-run must resume to the SAME digest an uncontended run
+produces), and the fleet-journal audit: `postmortem.fleet_reconstruct`
+across every slot journal must attribute every accepted request with
+ZERO losses.
+
+Acceptance bars, enforced AS SCHEMA by
+`benchmarks/check_results.py::check_router_fleet`:
+
+- >= 3 committed offered-load levels + exactly one drill row;
+- client/router/worker pids pairwise distinct on every row;
+- drill: kills >= 2, migrations >= 1, detection < 2000 ms,
+  ``journaled_losses == 0``, ``bit_identical`` true.
+
+Run:
+
+    JAX_PLATFORMS=cpu python benchmarks/router_fleet.py [--quick] \
+        [--out benchmarks/results/router_fleet.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+RESULTS = Path(__file__).resolve().parent / "results"
+
+MULTIPLIERS = (0.5, 1.0, 2.0, 4.0)
+MULTIPLIERS_QUICK = (0.5, 2.0)
+DURATION_S = 6.0
+DURATION_S_QUICK = 2.5
+N = 5
+SLOTS = 2
+
+# each worker cell: the serve_overload single-process posture (modest
+# bounded queues, 4-slot batches) so the cross-process capacity is
+# comparable to the committed single-process ~7.5 req/s anchor
+WORKER_SERVICE = dict(max_batch=4, quantum_chunks=4,
+                      max_queue_per_tenant=16, max_queue_total=48,
+                      idle_poll_s=0.01)
+# pre-READY warm per worker, in PACKING GROUPS: each group is
+# co-submitted so the scheduler forms one batch of exactly that size —
+# rollout batches 4, 3, 2, 1 and assign batches 2, 1 are every
+# composition traffic can reach. (One big warm burst only compiles the
+# sizes it happens to pack into; the first mid-run batch of an
+# uncovered size then stalls the whole queue behind a ~5 s compile —
+# measured as a cliff where every queued request resolves at once.)
+def _warm_rolls(count: int, base: int) -> list:
+    return [["rollout", {"n": N, "ticks": 60, "chunk_ticks": 20,
+                         "seed": base + i}] for i in range(count)]
+
+
+WARM_GROUPS = ([_warm_rolls(k, 900 + 10 * k) for k in (4, 3, 2, 1)]
+               + [[["assign", {"n": N, "seed": s}] for s in (1, 2)],
+                  [["assign", {"n": N, "seed": 3}]]])
+
+# the traffic mix: two placement buckets (the rollout shape bucket and
+# the assign single bucket) so BOTH worker processes carry load —
+# rendezvous placement is per-bucket, not per-request
+MIX = (("rollout", 0.6), ("assign", 0.4))
+
+PROBE = {"n": N, "ticks": 60, "chunk_ticks": 20, "seed": 424242}
+
+
+# --------------------------------------------------------------- child
+
+def run_client_child(args) -> int:
+    """The client fleet, in its own process: run one open-loop
+    `TrafficFleet` against the router's TCP front door and print the
+    ledger as the last stdout line. The parent never constructs a
+    client — the p99 in the artifact is measured from OUTSIDE the
+    router's process."""
+    from aclswarm_tpu.serve.traffic import TrafficConfig, TrafficFleet
+
+    host, port = args.tcp.rsplit(":", 1)
+    cfg = TrafficConfig(
+        seed=args.seed, duration_s=args.duration,
+        offered_hz=args.offered_hz, mix=MIX, n=N,
+        reject_retries=args.reject_retries, max_retry_wait_s=8.0,
+        slowloris_clients=0, corrupt_clients=0,
+        reconnect_storms=args.storms,
+        storm_period_s=max(1.0, args.duration / 3.0),
+        drain_timeout_s=300.0)
+    rep = TrafficFleet(cfg, host, int(port)).run()
+    print("CLIENT_REPORT " + json.dumps(
+        {"pid": os.getpid(), "report": rep}), flush=True)
+    return 0
+
+
+def _spawn_client(tcp: tuple, offered_hz: float, duration_s: float,
+                  seed: int, storms: int = 0,
+                  reject_retries: int = 2) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, str(Path(__file__).resolve()),
+         "--client-child", "--tcp", f"{tcp[0]}:{tcp[1]}",
+         "--offered-hz", f"{offered_hz}", "--duration",
+         f"{duration_s}", "--seed", str(seed), "--storms", str(storms),
+         "--reject-retries", str(reject_retries)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def _client_report(proc: subprocess.Popen, timeout_s: float) -> dict:
+    out, _ = proc.communicate(timeout=timeout_s)
+    for line in reversed(out.splitlines()):
+        if line.startswith("CLIENT_REPORT "):
+            return json.loads(line[len("CLIENT_REPORT "):])
+    raise RuntimeError(f"client child exited {proc.returncode} without "
+                       f"a report:\n{out[-2000:]}")
+
+
+# -------------------------------------------------------------- parent
+
+def _fleet(journal_root: str):
+    from aclswarm_tpu.serve.router import RouterConfig, SwarmRouter
+
+    router = SwarmRouter(RouterConfig(
+        journal_root=journal_root, slots=SLOTS,
+        spawn_timeout_s=420.0, drain_timeout_s=120.0,
+        # admission cap = the fleet's true queue capacity: overload is
+        # shed at the front door with a K_REJECT + retry hint instead
+        # of soaking a backlog whose only future is a slow queue_full
+        max_inflight=SLOTS * int(WORKER_SERVICE["max_queue_total"]),
+        worker={"service": WORKER_SERVICE,
+                "warm_groups": WARM_GROUPS}))
+    router.start()
+    if not router.wait_ready(420.0):
+        router.close()
+        raise RuntimeError(f"worker fleet never came up: "
+                           f"{router.fleet()}")
+    return router
+
+
+def _pids(router) -> dict:
+    return {"router_pid": os.getpid(),
+            "worker_pids": sorted(f["pid"] for f in router.fleet()
+                                  if f["pid"] is not None)}
+
+
+def _run_level(router, offered_hz: float, duration_s: float,
+               seed: int, storms: int = 0,
+               reject_retries: int = 2) -> dict:
+    t0 = time.perf_counter()
+    child = _spawn_client(router.tcp_address, offered_hz, duration_s,
+                          seed, storms, reject_retries)
+    got = _client_report(child, duration_s + 360.0)
+    rep = got["report"]
+    rep.update(offered_hz=offered_hz, client_pid=got["pid"],
+               level_wall_s=time.perf_counter() - t0)
+    return rep
+
+
+def calibrate(router, duration_s: float = 4.0) -> float:
+    """Measured fleet capacity: drain rate under polite saturation
+    (no hint-honoring retries — the retry tail would stretch the wall
+    and undersell it), from a separate client process like every
+    level. Saturation is ~20x the fleet drain rate, NOT the 1200 Hz
+    the single-process bench uses: past the point where every queue
+    is pinned full, extra offered load only adds reject-frame chew
+    time to the wall and the 'capacity' would measure the codec front
+    door, not the fleet."""
+    rep = _run_level(router, 120.0, duration_s, seed=99,
+                     reject_retries=0)
+    cap = rep["completed"] / rep["wall_s"]
+    print(f"calibrated fleet capacity: {cap:.1f} req/s "
+          f"({rep['completed']} completed / {rep['wall_s']:.1f} s, "
+          f"{SLOTS} worker processes)", flush=True)
+    return cap
+
+
+def _row(rep: dict, mult: float, capacity_hz: float, backend: str,
+         prov: dict, quick: bool) -> dict:
+    goodput = (rep["completed"] / rep["wall_s"]) if rep["wall_s"] \
+        else 0.0
+    pids = [rep["client_pid"], prov["router_pid"],
+            *prov["worker_pids"]]
+    return {
+        "name": "router_fleet",
+        "level": f"{mult:g}x",
+        "multiplier": mult,
+        "n": N,
+        "backend": backend,
+        "workers": SLOTS,
+        "capacity_hz": round(capacity_hz, 3),
+        "offered_hz": round(rep["offered_hz"], 3),
+        "value": round(goodput, 3),
+        "unit": "Hz",
+        "p50_s": round(rep["latency_p50_s"], 4),
+        "p99_s": round(rep["latency_p99_s"], 4),
+        "offered": rep["offered"],
+        "completed": rep["completed"],
+        "timed_out": rep["timed_out"],
+        "shed": rep["rejected_final"],
+        "cancelled": rep["cancelled"],
+        "wire_lost": rep["wire_lost"],
+        "failed_other": rep["failed_other"],
+        "unresolved": rep["unresolved"],
+        "retry_submits": rep["retry_submits"],
+        "client_pid": rep["client_pid"],
+        "router_pid": prov["router_pid"],
+        "worker_pids": prov["worker_pids"],
+        "separate_client_process": len(set(pids)) == len(pids),
+        "wall_s": round(rep["wall_s"], 2),
+        "quick": quick,
+    }
+
+
+def _busiest_slot(router, timeout_s: float,
+                  prefer_rid: str = "") -> int:
+    """Block until SOME live slot is carrying in-flight work (the
+    client child pays a jax-import startup tax before its first
+    arrival, so 'wait until traffic flows' needs a real timeout) and
+    return that slot — a SIGKILL that lands on an idle process proves
+    nothing about migration. With ``prefer_rid``, aim at the process
+    carrying that request so the kill provably lands mid-flight."""
+    from aclswarm_tpu.serve.router import UP
+
+    t_end = time.monotonic() + timeout_s
+    pick = 0
+    while time.monotonic() < t_end:
+        if prefer_rid:
+            uid = router.route_uid(prefer_rid)
+            if uid and router.inflight_on(uid) > 0:
+                return int(uid.split(".")[0])
+        loads = {f["slot"]: router.inflight_on(f["uid"])
+                 for f in router.fleet() if f["state"] == UP}
+        if loads:
+            pick = max(loads, key=lambda s: loads[s])
+            if loads[pick] > 0:
+                return pick
+        time.sleep(0.02)
+    return pick
+
+
+def _run_drill(router, capacity_hz: float, backend: str,
+               duration_s: float, seed: int, quick: bool) -> dict:
+    """The rolling-restart drill under 1x load: staggered SIGKILL of
+    every slot mid-traffic (hard failover, work migrated through the
+    journals), a bit-identical probe, then the graceful
+    drain->fence->respawn->re-admit pass."""
+    from aclswarm_tpu.serve import ServiceConfig, SwarmService
+    from aclswarm_tpu.serve.wire import WireClient
+
+    # the bit-parity oracle, computed in-parent: deterministic rollout
+    ref = SwarmService(ServiceConfig(max_batch=1))
+    want = ref.submit("rollout", PROBE).result(600)
+    ref.close()
+    assert want.ok
+
+    prov = _pids(router)
+    t0 = time.perf_counter()
+    # a longer window than the levels: both staggered kills plus the
+    # respawn gap must land inside live traffic
+    drill_dur = max(duration_s * 2.0, 10.0)
+    child = _spawn_client(router.tcp_address, capacity_hz, drill_dur,
+                          seed, storms=1)
+    # hold until the child's open loop is actually offering (its jax
+    # import + fleet construction precede the first arrival)
+    _busiest_slot(router, 120.0)
+    # the probe rides the same front door from THIS process's client,
+    # submitted only once traffic queues exist for it to sit behind —
+    # the first kill aims at ITS slot, so the bit-parity check
+    # exercises the migrated-resume path, not an uncontended run
+    probe_client = WireClient(tcp=router.tcp_address,
+                              client_id="drill-probe", tenant="probe")
+    probe = probe_client.submit("rollout", PROBE,
+                                request_id="drill-probe-roll")
+    failovers_pre = router.telemetry.counter(
+        "router_failovers_total").value
+    kills = []
+    for n_kill in range(SLOTS):
+        victim = _busiest_slot(
+            router, 30.0,
+            prefer_rid="drill-probe-roll" if n_kill == 0 else "")
+        kills.append(router.kill_slot(victim))
+        time.sleep(max(0.5, duration_s / 4.0))
+    probe_res = probe.result(timeout=600)
+    # migrations = every route requeued onto a survivor because its
+    # process died under it, whichever of the router's three requeue
+    # paths caught it (declare-dead bulk, the dispatch-vs-death safety
+    # net, or a worker-loss terminal) — the death-ledger `migrated`
+    # field alone undercounts when the data-plane error outraces the
+    # supervision-channel death
+    migrations = (router.telemetry.counter(
+        "router_failovers_total").value - failovers_pre)
+    got = _client_report(child, duration_s + 360.0)
+    rep = got["report"]
+    restart = router.rolling_restart()
+    probe_client.close()
+
+    detect = [k["detect_s"] for k in kills
+              if k["detect_s"] is not None]
+    return {
+        "name": "router_fleet",
+        "level": "drill",
+        "multiplier": 1.0,
+        "n": N,
+        "backend": backend,
+        "workers": SLOTS,
+        "capacity_hz": round(capacity_hz, 3),
+        "offered_hz": round(capacity_hz, 3),
+        "value": len(kills),
+        "unit": "kills",
+        "kills": len(kills),
+        "migrations": int(migrations),
+        "detection_ms_max": round(max(detect) * 1e3, 1) if detect
+        else None,
+        "readmitted": all(k["readmitted"] for k in kills),
+        "restarts": len(restart),
+        "restart_drained": all(r["drained"] for r in restart),
+        "restart_readmitted": all(r["readmitted"] for r in restart),
+        "bit_identical": bool(
+            probe_res.ok
+            and probe_res.value["digest"] == want.value["digest"]),
+        "probe_status": probe_res.status,
+        "probe_failovers": probe_res.failovers,
+        "offered": rep["offered"],
+        "completed": rep["completed"],
+        "timed_out": rep["timed_out"],
+        "shed": rep["rejected_final"],
+        "cancelled": rep["cancelled"],
+        "wire_lost": rep["wire_lost"],
+        "failed_other": rep["failed_other"],
+        "unresolved": rep["unresolved"],
+        "client_pid": got["pid"],
+        "router_pid": prov["router_pid"],
+        "worker_pids": prov["worker_pids"],
+        "separate_client_process": got["pid"] != os.getpid(),
+        # the journal audit lands after the fleet closes (main fills
+        # these in — the journals must be quiescent to be the whole
+        # story)
+        "journaled_losses": None,
+        "duplicate_terminals": None,
+        "pm_resolved": None,
+        "pm_gap_free": None,
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "quick": quick,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="2 short levels + drill (CI smoke; artifact "
+                         "not committed)")
+    ap.add_argument("--seed", type=int, default=30)
+    ap.add_argument("--out", default=None,
+                    help="artifact path ('' to skip; default: the "
+                         "committed artifact for full runs, NO write "
+                         "for --quick)")
+    ap.add_argument("--client-child", action="store_true",
+                    help="(internal) run the traffic fleet in this "
+                         "process and print its ledger")
+    ap.add_argument("--tcp", default=None)
+    ap.add_argument("--offered-hz", type=float, default=10.0)
+    ap.add_argument("--duration", type=float, default=6.0)
+    ap.add_argument("--storms", type=int, default=0)
+    ap.add_argument("--reject-retries", type=int, default=2)
+    args = ap.parse_args(argv)
+    if args.client_child:
+        return run_client_child(args)
+    if args.out is None:
+        args.out = "" if args.quick \
+            else str(RESULTS / "router_fleet.json")
+
+    import jax
+    backend = jax.default_backend()
+    mults = MULTIPLIERS_QUICK if args.quick else MULTIPLIERS
+    dur = DURATION_S_QUICK if args.quick else DURATION_S
+
+    with tempfile.TemporaryDirectory(
+            prefix="aclswarm_router_fleet_") as root:
+        router = _fleet(root)
+        try:
+            cap = calibrate(router, 2.5 if args.quick else 4.0)
+            prov = _pids(router)
+            rows = []
+            for k, mult in enumerate(mults):
+                rep = _run_level(router, mult * cap, dur,
+                                 seed=args.seed + k)
+                row = _row(rep, mult, cap, backend, prov,
+                           bool(args.quick))
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+            drill = _run_drill(router, cap, backend, dur,
+                               seed=args.seed + 50,
+                               quick=bool(args.quick))
+            jdirs = [str(p) for p in router.journal_dirs()]
+        finally:
+            router.close()
+
+        # the fleet is dead; the journals are the whole story now
+        from aclswarm_tpu.telemetry import postmortem
+        fleet_pm = postmortem.fleet_reconstruct(jdirs)
+        drill.update(
+            journaled_losses=len(fleet_pm["losses"]),
+            duplicate_terminals=len(fleet_pm["duplicate_terminals"]),
+            pm_resolved=fleet_pm["resolved"],
+            pm_gap_free=fleet_pm["gap_free"])
+        rows.append(drill)
+        print(json.dumps(drill), flush=True)
+
+    bad = []
+    if fleet_pm["losses"]:
+        bad.append(f"journaled losses: {fleet_pm['losses'][:8]}")
+    if not drill["bit_identical"]:
+        bad.append(f"probe not bit-identical "
+                   f"(status {drill['probe_status']})")
+    if sum(r["unresolved"] for r in rows):
+        bad.append("client-side unresolved tickets")
+    if bad:
+        print("FAIL: " + "; ".join(bad))
+        return 1
+    if args.out:
+        p = Path(args.out)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        print(f"wrote {p}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,11 @@
 #                               swarmtrace smoke: kill a worker, then
 #                               reconstruct the migrated request's
 #                               gap-free timeline from the journal alone
+#   JAX_PLATFORMS=cpu python -m aclswarm_tpu.serve.smoke --procs
+#                               swarmrouter smoke: SIGKILL one of two
+#                               procworker OS processes mid-flight —
+#                               the router's promise survives, zero
+#                               journaled losses, fenced predecessor
 #   pytest tests/test_analysis.py tests/test_invariants.py \
 #          tests/test_results_schema.py tests/test_resilience.py \
 #          tests/test_serve.py                      guard self-tests
@@ -60,7 +65,7 @@ for name in ("serve_throughput.json", "telemetry_overhead.json",
              "serve_multiworker_soak.json", "trace_soak.json",
              "serve_latency_breakdown.json", "scenario_suite.json",
              "serve_overload.json", "slo_detection.json",
-             "pipeline_n1000.json"):
+             "pipeline_n1000.json", "router_fleet.json"):
     path = RESULTS / name
     if not path.exists():
         print(f"FAIL: missing owed artifact benchmarks/results/{name}")
@@ -101,6 +106,13 @@ echo "== alone — complete, causally ordered, gap-free =="
 echo "== (docs/OBSERVABILITY.md §swarmtrace) =="
 JAX_PLATFORMS=cpu python -m aclswarm_tpu.serve.smoke --postmortem
 
+echo "== swarmrouter process-mode smoke: router + two procworker OS =="
+echo "== processes, SIGKILL one with a rollout mid-flight — the =="
+echo "== router's promise survives (bit-identical migrated resume), =="
+echo "== zero journaled losses, predecessor fenced, rolling restart =="
+echo "== drains + re-admits (docs/SERVICE.md §process mode) =="
+JAX_PLATFORMS=cpu python -m aclswarm_tpu.serve.smoke --procs
+
 echo "== overload smoke: TCP clients at 10x measured capacity (the =="
 echo "== adversarial open-loop fleet — slow-loris, corrupt frames, =="
 echo "== reconnect storms) against a journaled service; assert ZERO =="
@@ -113,10 +125,13 @@ echo "== exits nonzero standalone on a >10% regression) =="
 python benchmarks/bench_trend.py --soft
 
 # tier-1 duration guard: the verify command (ROADMAP.md) runs under a
-# hard 870 s timeout and tees its log to /tmp/_t1.log; fail loudly once
-# the suite burns >80% of that budget (407 s at PR 4 and climbing) so
-# the timeout is re-planned BEFORE it starts killing runs mid-suite.
-echo "== tier-1 duration guard (last run must be < 80% of 870 s) =="
+# hard 1080 s timeout and tees its log to /tmp/_t1.log; fail loudly once
+# the suite burns >80% of that budget (407 s at PR 4; re-planned 870 ->
+# 1080 at PR 17 after the suite hit 848 s with +-10% host wall noise —
+# 23 redundantly-covered heavy tests were ALSO re-marked slow, landing
+# ~720-750 s) so the timeout is re-planned BEFORE it kills runs
+# mid-suite.
+echo "== tier-1 duration guard (last run must be < 80% of 1080 s) =="
 T1_LOG=${T1_LOG:-/tmp/_t1.log}
 if [ -f "$T1_LOG" ]; then
     secs=$(grep -aoE 'in [0-9]+\.[0-9]+s' "$T1_LOG" | tail -1 \
@@ -124,7 +139,7 @@ if [ -f "$T1_LOG" ]; then
     if [ -n "${secs:-}" ]; then
         python - "$secs" <<'EOF'
 import sys
-secs, budget = float(sys.argv[1]), 870.0
+secs, budget = float(sys.argv[1]), 1080.0
 frac = secs / budget
 print(f"last tier-1 run: {secs:.0f}s = {100 * frac:.0f}% of the "
       f"{budget:.0f}s timeout budget (guard: 80%)")
@@ -141,11 +156,12 @@ else
     echo "no tier-1 log at $T1_LOG — skipping (run tier-1 first)"
 fi
 
-echo "== guard self-tests (lint fixtures, audit grid, invariant contracts, resilience, serve, wire, traffic, telemetry, trace, watch, scenarios) =="
+echo "== guard self-tests (lint fixtures, audit grid, invariant contracts, resilience, serve, wire, router, traffic, telemetry, trace, watch, scenarios) =="
 exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_analysis.py tests/test_invariants.py \
     tests/test_results_schema.py tests/test_resilience.py \
     tests/test_serve.py tests/test_serve_wire.py \
+    tests/test_router.py \
     tests/test_traffic.py \
     tests/test_telemetry.py tests/test_trace.py \
     tests/test_watch.py \

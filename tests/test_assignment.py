@@ -88,6 +88,7 @@ class TestAuction:
 
 
 class TestSinkhorn:
+    @pytest.mark.slow
     def test_valid_permutation_always(self):
         rng = np.random.default_rng(5)
         for _ in range(5):

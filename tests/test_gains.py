@@ -184,6 +184,7 @@ class TestInvariants:
 
 
 class TestClosedLoopWithDesignedGains:
+    @pytest.mark.slow
     def test_swarm6_pyramid_flies(self):
         """End of the gain-design story: our own gains fly the demo."""
         import jax

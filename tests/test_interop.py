@@ -5,6 +5,7 @@ The 'done' criterion from the round-1 review: a test drives the planner
 purely through the message-shaped API (no framework internals), so the
 final ROS plugin is a transport swap.
 """
+import os
 import subprocess
 import sys
 import uuid
@@ -408,6 +409,7 @@ class TestPlanner:
                 m.Formation(header=m.Header(), name="x", points=pts,
                             adjmat=adj))
 
+    @pytest.mark.slow
     def test_large_swarm_assignment_is_exact_int32(self):
         """n > 255 must publish an int32 permutation — a uint8 payload
         would silently wrap indices >= 256 into a corrupt non-permutation
@@ -872,6 +874,7 @@ class TestBridgeLifecycle:
 
 
 class TestCentralAssignmentWire:
+    @pytest.mark.slow
     def test_operator_pushed_assignment_over_wire(self):
         """Centralized-vs-decentralized comparison end-to-end over the
         wire: a bridge in --central-assignment mode adopts the operator's
@@ -1057,3 +1060,44 @@ class TestBridgeEndToEnd:
                 child.wait(timeout=30)
             for ch in chans.values():
                 ch.close()
+
+
+class TestConnectFdHygiene:
+    def test_refused_connect_storm_leaks_no_fds(self):
+        """`connect_when_ready` against a port nothing listens on: the
+        per-attempt socket close is structural (try/finally), so 50+
+        refused attempts leave the process fd table exactly where it
+        started. A leak here is one fd per retry until the rlimit —
+        the router's respawn loop reconnects exactly this way."""
+        import socket as _socket
+
+        from aclswarm_tpu.interop.transport import connect_when_ready
+
+        # grab a port the OS just proved free, then close the listener
+        # so every connect is refused
+        probe = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+
+        fd_dir = "/proc/self/fd"
+        if not os.path.isdir(fd_dir):
+            pytest.skip("no /proc fd table on this platform")
+
+        def _fds():
+            return len(os.listdir(fd_dir))
+
+        # one throwaway round first: lazy imports inside the helper
+        # (utils.retry) may open fds of their own on first use
+        with pytest.raises(OSError):
+            connect_when_ready("127.0.0.1", port, grace_s=0.05,
+                               poll_s=0.01)
+        before = _fds()
+        attempts = 0
+        while attempts < 50:
+            with pytest.raises(OSError, match="refused|never"):
+                connect_when_ready("127.0.0.1", port, grace_s=0.05,
+                                   poll_s=0.01)
+            attempts += 5   # >= 5 internal attempts per 0.05 s grace
+        assert _fds() == before, \
+            f"fd table grew {before} -> {_fds()} over refused connects"

@@ -414,6 +414,7 @@ class TestSolverContracts:
 # driver integration (serial trials loop with the sanitizer compiled in)
 
 class TestDriverIntegration:
+    @pytest.mark.slow
     def test_run_trial_checked_happy_path(self):
         """A short checked trial completes its chunk loop without a
         violation: the driver wiring (init_state(checks=True), per-chunk

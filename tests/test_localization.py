@@ -104,6 +104,7 @@ class TestFlood:
             np.testing.assert_array_equal(np.asarray(dense.age),
                                           np.asarray(blocked.age), err_msg=str(B))
 
+    @pytest.mark.slow
     def test_blocked_merge_large_n_smoke(self):
         """n=500 flood round through the blocked merge: the scale mode
         runs without the dense (n, n, n) broadcast (500 MB here, 4 GB at

@@ -187,6 +187,7 @@ class TestShardedAssignment:
 
 
 class TestShardedFloodedLocalization:
+    @pytest.mark.slow
     def test_sharded_flooded_matches_single_device(self):
         """The flooded information model under the agent-axis sharding:
         bit-parity with the unsharded rollout (the estimate tables shard

@@ -844,6 +844,7 @@ class TestTrialDriverResume:
         # finished: interim checkpoints pruned (bounded retention)
         assert ckptlib.latest_checkpoint(tmp_path, "trial00000") is None
 
+    @pytest.mark.slow
     def test_batch_crash_resume_bit_identical(self, tmp_path):
         from aclswarm_tpu.harness import trials as triallib
         base = dict(self.CFG, trials=2, batch=2, chunk_ticks=120)
@@ -911,6 +912,7 @@ class TestTrialDriverResume:
 
 # ------------------------------------------------- SIGKILL subprocess proof
 
+@pytest.mark.slow
 def test_sigkill_smoke_subprocess():
     """The scripts/check.sh smoke, exercised from tier-1: a child run is
     SIGKILL'd (env-armed crash plan) at chunk boundary 1, the parent

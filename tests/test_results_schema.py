@@ -668,3 +668,129 @@ def test_pipeline_artifact_committed():
     heads = [r for r in rows if r["name"] == "pipeline_rate"
              and r["n"] == 1000 and r["warm_gains"]]
     assert any(r["value"] >= 100.0 for r in heads)
+
+
+# ----------------------------------------------------- router_fleet
+
+def _router_level_row(mult=1.0, **over):
+    row = {
+        "name": "router_fleet", "level": f"{mult:g}x",
+        "multiplier": mult, "n": 5, "backend": "cpu", "workers": 2,
+        "capacity_hz": 3.0, "offered_hz": 3.0 * mult, "value": 2.8,
+        "unit": "Hz", "p50_s": 1.0, "p99_s": 5.0, "offered": 20,
+        "completed": 18, "timed_out": 1, "shed": 1, "cancelled": 0,
+        "wire_lost": 0, "failed_other": 0, "unresolved": 0,
+        "retry_submits": 2, "client_pid": 100, "router_pid": 200,
+        "worker_pids": [300, 301], "separate_client_process": True,
+        "wall_s": 10.0, "quick": False,
+    }
+    row.update(over)
+    return row
+
+
+def _router_drill_row(**over):
+    row = {
+        "name": "router_fleet", "level": "drill", "multiplier": 1.0,
+        "n": 5, "backend": "cpu", "workers": 2, "capacity_hz": 3.0,
+        "offered_hz": 3.0, "value": 2, "unit": "kills", "kills": 2,
+        "migrations": 3, "detection_ms_max": 40.0, "readmitted": True,
+        "restarts": 2, "restart_drained": True,
+        "restart_readmitted": True, "bit_identical": True,
+        "probe_status": "completed", "probe_failovers": 1,
+        "offered": 15, "completed": 14, "timed_out": 0, "shed": 1,
+        "cancelled": 0, "wire_lost": 0, "failed_other": 0,
+        "unresolved": 0, "client_pid": 101, "router_pid": 200,
+        "worker_pids": [300, 301], "separate_client_process": True,
+        "journaled_losses": 0, "duplicate_terminals": 1,
+        "pm_resolved": 40, "pm_gap_free": 40, "wall_s": 25.0,
+        "quick": False,
+    }
+    row.update(over)
+    return row
+
+
+def _router_rows():
+    return [_router_level_row(0.5, offered=10, completed=10,
+                              timed_out=0, shed=0),
+            _router_level_row(1.0),
+            _router_level_row(2.0, offered=40, completed=30,
+                              timed_out=2, shed=8),
+            _router_drill_row()]
+
+
+def test_router_fleet_artifact_committed():
+    """The ISSUE-17 acceptance artifact: committed, on schema, >= 3
+    offered-load levels measured from a separate client process, and
+    one drill row with zero journaled losses."""
+    path = RESULTS / "router_fleet.json"
+    assert path.exists(), \
+        "benchmarks/results/router_fleet.json missing (run " \
+        "benchmarks/router_fleet.py)"
+    assert check_file(path) == []
+    rows = [json.loads(ln) for ln in
+            path.read_text().strip().splitlines()]
+    drill = [r for r in rows if r["level"] == "drill"
+             and not r.get("quick")]
+    assert len(drill) == 1
+    assert drill[0]["journaled_losses"] == 0
+    assert drill[0]["bit_identical"] is True
+    assert drill[0]["kills"] >= 2 and drill[0]["migrations"] >= 1
+    # provenance: three kinds of OS process, pairwise distinct
+    for r in rows:
+        pids = [r["client_pid"], r["router_pid"], *r["worker_pids"]]
+        assert len(set(pids)) == len(pids) >= 4
+        assert r["separate_client_process"] is True
+
+
+def test_router_fleet_schema_flags_drift():
+    from check_results import check_router_fleet
+
+    assert check_router_fleet(_router_rows(), "x") == []
+    # a journaled loss is the one forbidden outcome
+    rows = _router_rows()
+    rows[3] = dict(rows[3], journaled_losses=1)
+    assert any("journaled_losses must be 0" in p
+               for p in check_router_fleet(rows, "x"))
+    # a drill whose kills landed on idle processes proves nothing
+    rows = _router_rows()
+    rows[3] = dict(rows[3], migrations=0)
+    assert any("migrated 0" in p
+               for p in check_router_fleet(rows, "x"))
+    # the migrated probe must resume bit-identical
+    rows = _router_rows()
+    rows[3] = dict(rows[3], bit_identical=False)
+    assert any("bit-identical" in p
+               for p in check_router_fleet(rows, "x"))
+    # detection latency bar
+    rows = _router_rows()
+    rows[3] = dict(rows[3], detection_ms_max=5000.0)
+    assert any("detection" in p
+               for p in check_router_fleet(rows, "x"))
+    # pid provenance: collisions and an in-process client both fail
+    rows = _router_rows()
+    rows[0] = dict(rows[0], client_pid=200)
+    assert any("pairwise distinct" in p
+               for p in check_router_fleet(rows, "x"))
+    rows = _router_rows()
+    rows[0] = dict(rows[0], separate_client_process=False)
+    assert any("own OS process" in p
+               for p in check_router_fleet(rows, "x"))
+    # the curve owes >= 3 committed levels and exactly one drill
+    assert any(">= 3" in p
+               for p in check_router_fleet(_router_rows()[2:], "x"))
+    assert any("exactly one committed drill" in p
+               for p in check_router_fleet(_router_rows()[:3], "x"))
+    # the client ledger must reconcile
+    rows = _router_rows()
+    rows[1] = dict(rows[1], completed=5)
+    assert any("must reconcile" in p
+               for p in check_router_fleet(rows, "x"))
+    # exact key sets, per row shape
+    rows = _router_rows()
+    rows[0] = dict(rows[0], bogus=1)
+    assert any("unknown keys" in p
+               for p in check_router_fleet(rows, "x"))
+    rows = _router_rows()
+    rows[3] = {k: v for k, v in rows[3].items() if k != "migrations"}
+    assert any("missing keys" in p
+               for p in check_router_fleet(rows, "x"))

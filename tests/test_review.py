@@ -81,6 +81,7 @@ class TestRecordReplay:
         assert replay.times == live.times
         np.testing.assert_allclose(replay.csv_row(0), live.csv_row(0))
 
+    @pytest.mark.slow
     def test_trial_records_reviewable_bag(self, tmp_path):
         """End-to-end: a trial with record_dir writes a bag whose replay
         reproduces the trial's own outcome (the review.launch workflow)."""

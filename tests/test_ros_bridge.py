@@ -164,6 +164,7 @@ class TestLoopback:
         assert len(ros.timers) == 1      # the control timer owns step()
         return ros, node, vehs
 
+    @pytest.mark.slow
     def test_formation_to_convergence_over_ros_graph(self):
         """The full SIL shape on a fake graph: operator publishes
         /formation, localization publishes vehicle_estimates, the TPU

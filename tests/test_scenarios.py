@@ -175,6 +175,7 @@ def test_no_scenario_bit_parity_resumed_from_checkpoint():
 # 2. heterogeneous batched scenarios == serial
 # --------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_heterogeneous_scenarios_batched_matches_serial():
     """The tentpole claim one axis up from faults: trials carrying
     DIFFERENT scenario compositions run in ONE compiled vmapped scan,
@@ -236,6 +237,7 @@ def test_obstacle_pops_up_moves_and_vanishes():
         assert bool(np.asarray(tl.scenario_event_at(scen, t))) is want, t
 
 
+@pytest.mark.slow
 def test_obstacle_casts_sector_for_head_on_vehicle():
     from aclswarm_tpu import control
 
@@ -281,6 +283,7 @@ def test_wind_displaces_but_dead_vehicles_stay_frozen():
     np.testing.assert_array_equal(q[-1][dead_rows], q[25][dead_rows])
 
 
+@pytest.mark.slow
 def test_sensor_noise_perturbs_only_flooded_estimates():
     dt = _dt()
     noisy = scn.no_scenario(N, dtype=dt).replace(
@@ -397,6 +400,7 @@ def test_scen_points_contract_trips_on_corrupt_table():
 # 4. recovery clock, registry, fuzzer, serve
 # --------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_scenario_events_feed_recovery_clock():
     dt = _dt()
     B = 2
@@ -524,6 +528,7 @@ def test_serve_scenario_requests_end_to_end(tmp_path):
     assert rep["complete"] == rep["gap_free"] == 3, rep
 
 
+@pytest.mark.slow
 def test_sharded_scenario_rollout_bit_parity():
     """Agent-axis GSPMD sharding (virtual 8-device mesh): a
     scenario-carrying state placed by `mesh.shard_problem` (byz mask

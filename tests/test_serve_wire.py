@@ -149,6 +149,7 @@ class TestWireRoundTrip:
         # the connection survives: the next valid frame is served
         assert cli.submit("assign", {"n": 6}).result(120).ok
 
+    @pytest.mark.slow
     def test_concurrent_client_connections_serialize_on_ctl(self):
         """The shm ring is single-producer, but every client HELLOs on
         the one shared control ring: the cross-process writer lock must
@@ -480,6 +481,7 @@ class TestTcpWire:
         srv.close()
         svc.close(drain=False)
 
+    @pytest.mark.slow
     def test_accept_rate_bounding_defers_not_denies(self):
         """The token bucket defers accepts past the rate (counted) but
         every well-behaved client still connects — the storm waits in

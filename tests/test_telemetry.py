@@ -246,6 +246,7 @@ def _problem(n=5, dtype=None):
 
 
 class TestChunkTelemetry:
+    @pytest.mark.slow
     def test_counters_per_solver_and_off_absence(self):
         from aclswarm_tpu import sim
         from aclswarm_tpu.telemetry import device as devtel

@@ -95,6 +95,7 @@ class TestFleetEndToEnd:
         assert rep["completed"] >= 1
         assert svc.stats["completed"] == rep["completed"]
 
+    @pytest.mark.slow
     def test_adversaries_do_not_break_honest_traffic(self):
         """Slow-loris + corrupt-frame clients riding along: the honest
         arrivals still all terminate, the corrupt frames are all

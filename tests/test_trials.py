@@ -238,6 +238,7 @@ def test_trial_fsm_gridlock_episode_logged():
 
 # ------------------------------------------------------------- end-to-end
 
+@pytest.mark.slow
 def test_monte_carlo_simform_trial(tmp_path):
     """Seeded simformN trial completes, writes the reference CSV schema,
     and the analysis reduces it (`analyze_simtrials.m:38-59`)."""
