@@ -989,16 +989,24 @@ def lint_paths(paths: list[str | Path]) -> list[Violation]:
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         description="jaxcheck: JAX-specific AST lint (JC001-JC005)")
-    ap.add_argument("paths", nargs="*",
-                    default=[str(Path(__file__).resolve().parents[1])],
-                    help="files or directories (default: aclswarm_tpu/)")
+    ap.add_argument("paths", nargs="*", default=[],
+                    help="files or directories (default: aclswarm_tpu/; "
+                         "with --concurrency: the host-side dirs)")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="run the host-side concurrency tier "
+                         "(JC101-JC103) instead of the JAX rules")
     args = ap.parse_args(argv)
-    violations = lint_paths(args.paths)
+    if args.concurrency:
+        # lazy import: the concurrency module imports from this one
+        from . import concurrency
+        return concurrency.main(args.paths)
+    paths = args.paths or [str(Path(__file__).resolve().parents[1])]
+    violations = lint_paths(paths)
     for v in violations:
         print(v)
     n = len(violations)
     print(f"jaxcheck: {n} violation{'s' if n != 1 else ''} "
-          f"in {len(args.paths)} path(s)")
+          f"in {len(paths)} path(s)")
     return 1 if violations else 0
 
 

@@ -62,6 +62,7 @@ from aclswarm_tpu.serve.api import (COMPLETED, E_CANCELLED, E_DEADLINE,
                                     Ticket)
 from aclswarm_tpu.serve.service import bucket_of, write_fence
 from aclswarm_tpu.serve.workers import place_slot
+from aclswarm_tpu.utils.locks import OrderedLock
 from aclswarm_tpu.telemetry import MetricsRegistry
 from aclswarm_tpu.utils import get_logger
 
@@ -161,14 +162,21 @@ class SwarmRouter:
         self.stats = {"workers": int(cfg.slots)}
         self.root = Path(cfg.journal_root)
         self.root.mkdir(parents=True, exist_ok=True)
+        # key set fixed at construction (slots never add/remove), so
+        # len()/iteration are lock-free; the _ProcSlot FIELDS are
+        # mutated under _lock
         self._slots: Dict[int, _ProcSlot] = {
             i: _ProcSlot(slot=i) for i in range(max(1, cfg.slots))}
-        self._routes: Dict[str, _Route] = {}
-        self._lock = threading.Lock()
+        self._routes: Dict[str, _Route] = {}        # guarded-by: _lock
+        self._lock = OrderedLock("serve.router", registry=self.telemetry)
         self._closing = False
         self._stop = threading.Event()
         # death ledger: every declared death, with wall + monotonic
-        # stamps so drills measure detection latency from the kill
+        # stamps so drills measure detection latency from the kill.
+        # APPEND-only, appended under _lock; drills and status
+        # snapshots read len()/[-1] lock-free (atomic in CPython,
+        # staleness tolerated by design — polling loops must not
+        # contend with the supervision path)
         self.deaths: List[dict] = []
         self._sup = transport.SocketListener(cfg.host, 0)
         self._pending_socks: List[tuple] = []
@@ -485,6 +493,10 @@ class SwarmRouter:
         pid = int(payload.get("pid", 0))
 
         def _refuse(err: str, **extra) -> None:
+            # called with _lock RELEASED: the loser's socket may be
+            # wedged, and a blocking send under the router lock would
+            # stall the sweep/respawn path for the whole fleet (the
+            # ack send below already follows the same rule)
             self.telemetry.counter("router_hello_refused_total").inc()
             self.log.warning("HELLO w%d.%d pid %d REFUSED: %s",
                              slot_id, inc, pid, err)
@@ -497,33 +509,35 @@ class SwarmRouter:
                 pass
             chan.close()
 
+        refusal = None      # (err, extra) decided under the lock
         with self._lock:
             sl = self._slots.get(slot_id)
             if sl is None:
-                _refuse(f"unknown slot {slot_id}")
-                return
-            if sl.chan is not None and sl.state in (SPAWNING, UP,
-                                                    DRAINING):
-                _refuse("slot_taken", owner=sl.uid, owner_pid=sl.pid)
-                return
-            if inc < sl.gen:
-                _refuse("stale_incarnation", current=sl.gen)
-                return
-            if sl.proc is not None and sl.state == SPAWNING \
+                refusal = (f"unknown slot {slot_id}", {})
+            elif sl.chan is not None and sl.state in (SPAWNING, UP,
+                                                      DRAINING):
+                refusal = ("slot_taken",
+                           {"owner": sl.uid, "owner_pid": sl.pid})
+            elif inc < sl.gen:
+                refusal = ("stale_incarnation", {"current": sl.gen})
+            elif sl.proc is not None and sl.state == SPAWNING \
                     and pid != sl.proc.pid:
-                _refuse("slot_reserved", owner_pid=sl.proc.pid)
-                return
-            sl.gen = inc
-            sl.pid = pid
-            sl.chan = chan
-            sl.state = SPAWNING     # READY promotes to UP
-            sl.last_beat = time.monotonic()
-            if sl.proc is None:
-                # externally-launched claimant (spawn=False mode): its
-                # boot budget starts at admission — an unstamped
-                # t_spawn would read as an expired spawn window and
-                # insta-declare the winner dead
-                sl.t_spawn = time.monotonic()
+                refusal = ("slot_reserved", {"owner_pid": sl.proc.pid})
+            else:
+                sl.gen = inc
+                sl.pid = pid
+                sl.chan = chan
+                sl.state = SPAWNING     # READY promotes to UP
+                sl.last_beat = time.monotonic()
+                if sl.proc is None:
+                    # externally-launched claimant (spawn=False mode):
+                    # its boot budget starts at admission — an
+                    # unstamped t_spawn would read as an expired spawn
+                    # window and insta-declare the winner dead
+                    sl.t_spawn = time.monotonic()
+        if refusal is not None:
+            _refuse(refusal[0], **refusal[1])
+            return
         try:
             chan.send_bytes(wire._frame(wire.K_HELLO_ACK, {
                 "server": "router", "accepted": True,
@@ -1047,6 +1061,8 @@ class SwarmRouter:
             sl = self._slots[slot]
             old_uid, old_pid = sl.uid, sl.pid
         n_deaths = len(self.deaths)
+        n_failovers = self.telemetry.counter(
+            "router_failovers_total").value
         t_kill = time.monotonic()
         self.stop_slot(slot, kill=True)
         detect_s = None
@@ -1066,10 +1082,19 @@ class SwarmRouter:
         with self._lock:
             sl = self._slots[slot]
             new_uid, new_pid = sl.uid, sl.pid
+        # migrated: the failover-counter DELTA, not death["requeued"]
+        # alone — when the data-plane client notices the dead socket
+        # before _declare_dead runs, it resolves the backend tickets
+        # with wire_error and the PUMP's worker-loss path does the
+        # requeue (death["requeued"] reads 0 for a real migration).
+        # Both paths increment router_failovers_total.
+        migrated = int(self.telemetry.counter(
+            "router_failovers_total").value - n_failovers)
         return {"slot": slot, "old_uid": old_uid, "old_pid": old_pid,
                 "new_uid": new_uid, "new_pid": new_pid,
                 "detect_s": detect_s,
-                "migrated": death["requeued"] if death else 0,
+                "migrated": max(migrated,
+                                death["requeued"] if death else 0),
                 "readmitted": bool(up)}
 
     # ------------------------------------------------------- inspection

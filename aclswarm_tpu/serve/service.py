@@ -78,6 +78,7 @@ from aclswarm_tpu.serve.stats import ServeStats
 from aclswarm_tpu.telemetry import (LifecycleLog, MetricsRegistry,
                                     install_crash_dump, mint_trace_id)
 from aclswarm_tpu.utils import get_logger
+from aclswarm_tpu.utils.locks import OrderedLock
 from aclswarm_tpu.utils.retry import RetryPolicy
 
 BUILTIN_KINDS = ("rollout", "assign", "gains", "stats", "scenario",
@@ -572,24 +573,25 @@ class SwarmService:
                                max_s=5.0),
             cpu_fallback=cfg.cpu_fallback, log=self.log)
         self._kinds: dict[str, Callable[[dict], Any]] = {}
-        self._jobs: dict[str, _Job] = {}
-        self._done_prior: dict[str, Result] = {}   # journal done-cache
-        self._lock = threading.Lock()
+        # swarmscope (docs/OBSERVABILITY.md): a PRIVATE registry per
+        # service — the soak runs a crashed service and its reference
+        # oracle in one process, and their ledgers must not mix.
+        # Created before _recover(): recovery re-admissions and replayed
+        # terminal results count like live traffic. (And before _lock,
+        # which feeds its hold/wait histograms into it.)
+        self.telemetry = MetricsRegistry()
+        self._jobs: dict[str, _Job] = {}           # guarded-by: _lock
+        self._done_prior: dict[str, Result] = {}   # guarded-by: _lock
+        self._lock = OrderedLock("serve.service", registry=self.telemetry)
         self._stop = threading.Event()
         self._draining = threading.Event()
-        self._closed = False          # close()'s sweep ran (under _lock)
+        self._closed = False          # guarded-by: _lock
         self._round = 0
         self.stats = {"accepted": 0, "completed": 0, "rejected": 0,
                       "preempted": 0, "timed_out": 0, "failed": 0,
                       "resumed": 0, "chunks": 0, "rounds": 0,
                       "workers": max(1, cfg.workers), "failovers": 0,
                       "requeued": 0, "poisoned": 0, "cancelled": 0}
-        # swarmscope (docs/OBSERVABILITY.md): a PRIVATE registry per
-        # service — the soak runs a crashed service and its reference
-        # oracle in one process, and their ledgers must not mix.
-        # Created before _recover(): recovery re-admissions and replayed
-        # terminal results count like live traffic.
-        self.telemetry = MetricsRegistry()
         self._journal = Path(cfg.journal_dir) if cfg.journal_dir else None
         self._ckpt_dir = (self._journal / "ckpt"
                           if self._journal is not None else None)
@@ -2217,7 +2219,9 @@ class SwarmService:
                        "migrated": "requeued",
                        "poisoned": "poisoned"}.get(man.get("event"))
                 if key is not None:
-                    self.stats[key] += 1
+                    # construction-time replay: _recover() runs from
+                    # __init__ before any worker thread exists
+                    self.stats[key] += 1   # jaxcheck: disable=JC101
             if torn:
                 self.log.warning(
                     "events.log ends in a torn record (crash "
@@ -2226,7 +2230,7 @@ class SwarmService:
         for done in sorted(self._journal.glob("req_*.done")):
             payload, man = _read_frame(done)
             err = payload.get("error")
-            self._done_prior[man["request_id"]] = Result(
+            prior = Result(
                 request_id=man["request_id"], status=man["status"],
                 value=payload.get("value"),
                 error=ServeError(**err) if err else None,
@@ -2236,10 +2240,14 @@ class SwarmService:
                 resumed=bool(man.get("resumed", False)),
                 failovers=int(man.get("failovers", 0)),
                 trace_id=str(man.get("trace_id", "")))
+            with self._lock:
+                self._done_prior[man["request_id"]] = prior
         for reqf in sorted(self._journal.glob("req_*.req")):
             payload, man = _read_frame(reqf)
             rid = man["request_id"]
-            if rid in self._done_prior:
+            with self._lock:
+                already_done = rid in self._done_prior
+            if already_done:
                 continue
             # the acceptance frame carries the ORIGINAL trace_id: a
             # request's causal identity survives the process that
@@ -2253,7 +2261,8 @@ class SwarmService:
                 job = self._make_job(req)
             except ValueError as e:     # journaled garbage: loud error
                 job = _Job(req=req, ticket=Ticket(rid), bucket=("?",))
-                self._jobs[rid] = job
+                with self._lock:
+                    self._jobs[rid] = job
                 self._finish(job, FAILED,
                              error=ServeError(E_EXECUTION,
                                               f"unrecoverable params: {e}"))
@@ -2264,7 +2273,8 @@ class SwarmService:
                 with self._lock:
                     self.stats["resumed"] += 1
                 self.telemetry.counter("serve_resumed_total").inc()
-            self._jobs[rid] = job
+            with self._lock:
+                self._jobs[rid] = job
             # the recovery re-queue is itself a trace event: the
             # postmortem reads the crash gap as queued(recovery) ->
             # batched on whichever incarnation picks the job up
@@ -2273,11 +2283,13 @@ class SwarmService:
             with self._lock:
                 self.stats["accepted"] += 1
             self.telemetry.counter("serve_accepted_total").inc()
-        if self._jobs:
+        with self._lock:
+            n_jobs, n_prior = len(self._jobs), len(self._done_prior)
+        if n_jobs:
             self.log.warning(
                 "serve recovery: re-admitted %d unfinished request(s) "
-                "from %s (%d already terminal)", len(self._jobs),
-                self._journal, len(self._done_prior))
+                "from %s (%d already terminal)", n_jobs,
+                self._journal, n_prior)
 
     # --------------------------------------------------------- telemetry
 

@@ -97,6 +97,7 @@ from aclswarm_tpu.serve.api import (E_QUEUE_FULL, E_SHUTDOWN, FAILED,
 from aclswarm_tpu.serve.api import _SENTINEL as _TICKET_SENTINEL
 from aclswarm_tpu.telemetry import mint_trace_id
 from aclswarm_tpu.utils import get_logger
+from aclswarm_tpu.utils.locks import OrderedLock
 from aclswarm_tpu.utils.retry import retry_after_delay
 
 WIRE_VERSION = 1
@@ -738,12 +739,12 @@ class WireClient:
                                           capacity=RING_CAPACITY)
             self._ctl = transport.open_when_ready(
                 f"{base}.ctl", grace_s=hello_timeout_s)
-        self._tickets: dict[str, Ticket] = {}
+        self._tickets: dict[str, Ticket] = {}       # guarded-by: _lock
         # the HELLO-ack payload: server identity (pid, incarnation,
         # workers) — callers distinguishing a RESPAWNED server process
         # from a reconnect of the old one read it here
         self.server_info: dict = {}
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("serve.wire")
         self._stop = threading.Event()
         self._connected = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -932,7 +933,8 @@ class WireClient:
             self._connected.set()
             return
         rid = str(payload.get("request_id", ""))
-        ticket = self._tickets.get(rid)
+        with self._lock:
+            ticket = self._tickets.get(rid)
         if kind == K_EVENT and ticket is not None:
             ticket._push(ChunkEvent(rid, int(payload.get("seq", 0)),
                                     dict(payload.get("payload") or {})))

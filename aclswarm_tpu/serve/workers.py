@@ -45,10 +45,14 @@ import dataclasses
 import threading
 import time
 import zlib
-from typing import List, Optional, Tuple
+from typing import TYPE_CHECKING, List, Optional, Tuple
 
 from aclswarm_tpu.resilience import InjectedCrash
+from aclswarm_tpu.utils.locks import OrderedLock
 from aclswarm_tpu.utils.retry import RetryPolicy, delay_for
+
+if TYPE_CHECKING:                                   # pragma: no cover
+    from aclswarm_tpu.serve.service import SwarmService
 
 # worker-targeted crash sites: `serve.w{slot}` consulted with the
 # SLOT's cumulative round count (stable across respawns, so one drill
@@ -130,11 +134,12 @@ class WorkerPool:
     ordering simple: admission's queue lock may nest the pool lock
     (``on_take``), the pool lock never nests admission's."""
 
-    def __init__(self, service, cfg):
+    def __init__(self, service: "SwarmService", cfg):
         self.svc = service
         self.cfg = cfg
         self.log = service.log
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("serve.pool",
+                                 registry=service.telemetry)
         self._slots = [Worker(slot=i) for i in range(max(1, cfg.workers))]
         self._supervisor: Optional[threading.Thread] = None
         self._started = False

@@ -41,6 +41,7 @@ import time
 from typing import Iterable, Optional
 
 from aclswarm_tpu.telemetry.spans import FlightRecorder, Span
+from aclswarm_tpu.utils.locks import OrderedLock
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "get_registry", "reset_registry"]
@@ -94,7 +95,9 @@ class _Metric:
         self.name = name
         self.labels = dict(labels or {})
         self.help = help
-        self._lock = threading.Lock()
+        # registry=None on purpose: a metric lock observing its own
+        # hold time into a histogram guarded by a metric lock recurses
+        self._lock = OrderedLock("telemetry.metric")
 
     def _ident(self) -> dict:
         d = {"name": self.name, "kind": self.kind}
@@ -230,8 +233,8 @@ class MetricsRegistry:
     """
 
     def __init__(self, spans: int = 1024):
-        self._lock = threading.Lock()
-        self._metrics: dict[tuple, _Metric] = {}
+        self._lock = OrderedLock("telemetry.registry")
+        self._metrics: dict[tuple, _Metric] = {}    # guarded-by: _lock
         self.recorder = FlightRecorder(capacity=spans)
 
     # ------------------------------------------------------------ create

@@ -932,6 +932,57 @@ def check_telemetry_overhead(rows: list, where: str) -> list[str]:
     return probs
 
 
+LOCK_OVERHEAD = "lock_overhead.json"
+_LOCK_KEYS = {
+    "lock_overhead_frac_serve": {"name", "n", "value", "unit",
+                                 "wall_plain_s", "wall_ordered_s",
+                                 "reps", "note"},
+    "lock_pair_ns": {"name", "n", "value", "unit", "plain_pair_ns",
+                     "armed_pair_ns", "note"},
+}
+_LOCK_OVERHEAD_BAR = 0.02
+
+
+def check_lock_overhead(rows: list, where: str) -> list[str]:
+    """Validate parsed lock_overhead rows (exact key set per named row;
+    the <2% swarmguard acceptance bar on the serve-round fraction —
+    the lock DISCIPLINE must be free in production, only the armed
+    debug mode is allowed to cost)."""
+    probs = []
+    seen = set()
+    for i, row in enumerate(rows, 1):
+        at = f"{where}:{i}"
+        if not isinstance(row, dict):
+            probs.append(f"{at}: row is not a JSON object")
+            continue
+        name = row.get("name")
+        keys = _LOCK_KEYS.get(name)
+        if keys is None:
+            probs.append(f"{at}: unknown row name {name!r} (expected "
+                         f"{sorted(_LOCK_KEYS)})")
+            continue
+        seen.add(name)
+        missing, unknown = keys - set(row), set(row) - keys
+        if missing:
+            probs.append(f"{at}: missing keys {sorted(missing)}")
+        if unknown:
+            probs.append(f"{at}: unknown keys {sorted(unknown)} "
+                         "(exact-key-set schema)")
+        if not (_finite_num(row.get("value")) and row.get("value") >= 0):
+            probs.append(f"{at}: 'value' must be a finite non-negative "
+                         f"number, got {row.get('value')!r}")
+        elif name == "lock_overhead_frac_serve" \
+                and row["value"] >= _LOCK_OVERHEAD_BAR:
+            probs.append(
+                f"{at}: lock-tier serve overhead {row['value']} "
+                f"breaches the < {_LOCK_OVERHEAD_BAR} acceptance bar "
+                "(docs/OBSERVABILITY.md)")
+    for name in _LOCK_KEYS:
+        if name not in seen:
+            probs.append(f"{where}: missing required row {name!r}")
+    return probs
+
+
 def _is_count(v) -> bool:
     return isinstance(v, int) and not isinstance(v, bool) and v >= 0
 
@@ -1344,8 +1395,8 @@ def check_file(path: Path) -> list[str]:
             return [f"{path.name}: unparseable slo-detection artifact"]
         return check_slo_detection(whole, path.name)
     if path.name in (SERVE_THROUGHPUT, TELEMETRY_OVERHEAD,
-                     SERVE_BREAKDOWN, SCENARIO_SUITE, SERVE_OVERLOAD,
-                     ROUTER_FLEET, PIPELINE):
+                     LOCK_OVERHEAD, SERVE_BREAKDOWN, SCENARIO_SUITE,
+                     SERVE_OVERLOAD, ROUTER_FLEET, PIPELINE):
         rows, probs = [], []
         for i, line in enumerate(lines, 1):
             try:
@@ -1354,6 +1405,7 @@ def check_file(path: Path) -> list[str]:
                 probs.append(f"{path.name}:{i}: unparseable row ({e})")
         checker = {SERVE_THROUGHPUT: check_serve_throughput,
                    TELEMETRY_OVERHEAD: check_telemetry_overhead,
+                   LOCK_OVERHEAD: check_lock_overhead,
                    SERVE_BREAKDOWN: check_serve_latency_breakdown,
                    SCENARIO_SUITE: check_scenario_suite,
                    SERVE_OVERLOAD: check_serve_overload,

@@ -39,6 +39,9 @@ cd "$(dirname "$0")/.."
 echo "== jaxcheck layer 1: AST lint (JC001-JC006) =="
 scripts/lint.sh
 
+echo "== jaxcheck concurrency tier: lock discipline (JC101-JC103) =="
+python -m aclswarm_tpu.analysis.lint --concurrency
+
 echo "== jaxcheck layer 2: trace audit + swarmcheck zero-cost-off proof =="
 JAX_PLATFORMS=cpu python -m aclswarm_tpu.analysis.trace_audit
 
@@ -65,7 +68,8 @@ for name in ("serve_throughput.json", "telemetry_overhead.json",
              "serve_multiworker_soak.json", "trace_soak.json",
              "serve_latency_breakdown.json", "scenario_suite.json",
              "serve_overload.json", "slo_detection.json",
-             "pipeline_n1000.json", "router_fleet.json"):
+             "pipeline_n1000.json", "router_fleet.json",
+             "lock_overhead.json"):
     path = RESULTS / name
     if not path.exists():
         print(f"FAIL: missing owed artifact benchmarks/results/{name}")
@@ -97,8 +101,12 @@ echo "== mid-batch — zero loss, bit-identical migrated resume, the =="
 echo "== service keeps serving (docs/SERVICE.md §multi-worker). =="
 echo "== Doubles as the swarmwatch smoke: the kill must fire a =="
 echo "== worker_up alert on the live 'health' surface AND land as a =="
-echo "== journaled alert record (docs/OBSERVABILITY.md §swarmwatch) =="
-JAX_PLATFORMS=cpu python -m aclswarm_tpu.serve.smoke --multiworker
+echo "== journaled alert record (docs/OBSERVABILITY.md §swarmwatch). =="
+echo "== Runs with the swarmguard runtime detector ARMED: any rank =="
+echo "== inversion or lock-order cycle on the OrderedLock tier raises =="
+echo "== LockOrderViolation and fails the smoke =="
+JAX_PLATFORMS=cpu ACLSWARM_LOCK_DEBUG=1 \
+    python -m aclswarm_tpu.serve.smoke --multiworker
 
 echo "== swarmtrace postmortem smoke: kill a worker mid-rollout, =="
 echo "== reconstruct the migrated request's timeline from the journal =="
@@ -110,8 +118,11 @@ echo "== swarmrouter process-mode smoke: router + two procworker OS =="
 echo "== processes, SIGKILL one with a rollout mid-flight — the =="
 echo "== router's promise survives (bit-identical migrated resume), =="
 echo "== zero journaled losses, predecessor fenced, rolling restart =="
-echo "== drains + re-admits (docs/SERVICE.md §process mode) =="
-JAX_PLATFORMS=cpu python -m aclswarm_tpu.serve.smoke --procs
+echo "== drains + re-admits (docs/SERVICE.md §process mode). Armed: =="
+echo "== ACLSWARM_LOCK_DEBUG=1 inherits into the procworker children, =="
+echo "== so lock-order discipline is enforced across every process =="
+JAX_PLATFORMS=cpu ACLSWARM_LOCK_DEBUG=1 \
+    python -m aclswarm_tpu.serve.smoke --procs
 
 echo "== overload smoke: TCP clients at 10x measured capacity (the =="
 echo "== adversarial open-loop fleet — slow-loris, corrupt frames, =="

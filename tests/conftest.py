@@ -83,6 +83,14 @@ def pytest_configure(config):
         "additionally carries `slow`; tier-1 runs a quick-seed subset")
     config.addinivalue_line(
         "markers",
+        "locks: swarmguard host-side concurrency tier — OrderedLock/"
+        "OrderedRLock rank enforcement, two-thread inversion/cycle "
+        "detection under ACLSWARM_LOCK_DEBUG=1, and the lock hold/wait "
+        "histogram contract (aclswarm_tpu.utils.locks + "
+        "aclswarm_tpu.analysis.concurrency; docs/STATIC_ANALYSIS.md "
+        "§host-side concurrency)")
+    config.addinivalue_line(
+        "markers",
         "invariants: swarmcheck runtime sanitizer — compiled-in "
         "invariant contracts (aclswarm_tpu.analysis.invariants; "
         "docs/STATIC_ANALYSIS.md runtime tier): clean-system positives, "

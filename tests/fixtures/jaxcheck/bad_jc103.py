@@ -1,0 +1,67 @@
+"""JC103 fixture: blocking calls under a service-tier lock.
+
+The lock's OrderedLock family starts with ``serve.`` which marks it
+service-tier regardless of module path. `_flush` has one locked and
+one unlocked call site, so the report lands on the locked CALL SITE
+(the entry-held intersection is empty); `_fsync_always_locked` is
+entry-held, so the report lands on the primitive itself. ``cv.wait()``
+on the condition you hold is the CV protocol and stays quiet.
+"""
+import os
+import threading
+import time
+
+from aclswarm_tpu.utils.locks import OrderedLock
+
+
+class Service:
+    def __init__(self, sock, fd):
+        self._lock = OrderedLock("serve.fixture")
+        self._cv = threading.Condition()
+        self._evt = threading.Event()
+        self._sock = sock
+        self._fd = fd
+
+    def bad_sleep(self):
+        with self._lock:
+            time.sleep(0.1)                 # JC103 (sleep under lock)
+
+    def bad_send(self, payload):
+        with self._lock:
+            self._sock.sendall(payload)     # JC103 (socket under lock)
+
+    def bad_wait(self):
+        with self._lock:
+            self._evt.wait(1.0)             # JC103 (event wait under lock)
+
+    def bad_transitive(self):
+        with self._lock:
+            self._flush()                   # JC103 (call into fsync path)
+
+    def flush_unlocked(self):
+        self._flush()                       # clean: no lock held
+
+    def _flush(self):
+        os.fsync(self._fd)
+
+    def always_locked_sync(self):
+        with self._lock:
+            self._fsync_always_locked()
+
+    def _fsync_always_locked(self):
+        # entry-held under the service lock: the primitive reports
+        os.fsync(self._fd)                  # JC103 (fsync entry-held)
+
+    def good_outside(self, payload):
+        with self._lock:
+            data = payload
+        self._sock.sendall(data)            # clean: lock released first
+
+    def cv_wait_ok(self):
+        with self._cv:
+            self._cv.wait(0.5)              # clean: waiting releases cv
+
+    def suppressed_send(self, payload):
+        # justified: single-writer socket with a bounded frame size
+        with self._lock:
+            self._sock.sendall(payload)  # jaxcheck: disable=JC103

@@ -17,6 +17,7 @@ import pytest
 
 import jax
 
+from aclswarm_tpu.analysis import concurrency as concmod
 from aclswarm_tpu.analysis import lint as lintmod
 from aclswarm_tpu.analysis import trace_audit as ta
 
@@ -79,6 +80,81 @@ class TestLintFixtures:
             src = (FIXTURES / fname).read_text().splitlines()
             for v in fired[fname]:
                 assert "host_only" not in src[v.line - 1]
+
+
+class TestConcurrencyFixtures:
+    """The host-side concurrency tier (JC101-JC103) fires on known-bad
+    code — and the entry-contract / suppression / CV-wait subtleties
+    stay quiet where annotated clean."""
+
+    @pytest.fixture(scope="class")
+    def fired(self):
+        return _by_file(concmod.check_paths(
+            [FIXTURES / f for f in ("bad_jc101.py", "bad_jc102.py",
+                                    "bad_jc103.py")]))
+
+    @pytest.mark.parametrize("fixture,rule,count", [
+        ("bad_jc101.py", "JC101", 3),
+        ("bad_jc102.py", "JC102", 4),
+        ("bad_jc103.py", "JC103", 5),
+    ])
+    def test_rule_fires(self, fired, fixture, rule, count):
+        vs = fired.get(fixture, [])
+        assert [v.rule for v in vs] == [rule] * count, \
+            f"{fixture}: expected {count}x{rule}, got {vs}"
+
+    def test_fixture_lines_match_annotations(self, fired):
+        for fname, vs in fired.items():
+            src = (FIXTURES / fname).read_text().splitlines()
+            for v in vs:
+                assert v.rule in src[v.line - 1], \
+                    f"{fname}:{v.line} fired {v.rule} on an " \
+                    f"unannotated line: {src[v.line - 1]!r}"
+
+    def test_entry_contract_helper_clean(self, fired):
+        """`_locked_helper` accesses a guarded field bare, but every
+        call site holds the lock: the intersection propagation must
+        keep it quiet."""
+        src = (FIXTURES / "bad_jc101.py").read_text().splitlines()
+        for v in fired["bad_jc101.py"]:
+            assert "_locked_helper" not in src[v.line - 1]
+
+    def test_suppression_dissolves_cycle(self, fired):
+        """A JC102 pragma removes the EDGE: the partner nesting in
+        `Suppressed.pq` must not keep reporting the waived cycle."""
+        src = (FIXTURES / "bad_jc102.py").read_text().splitlines()
+        flagged = {src[v.line - 1] for v in fired["bad_jc102.py"]}
+        assert not any("partner edge waived" in s for s in flagged)
+
+    def test_inferred_guard_reports_writes_only(self, fired):
+        """The Tally class has no annotations: only the unlocked WRITE
+        reports (line annotated `inferred guarded-by`)."""
+        vs = [v for v in fired["bad_jc101.py"] if v.line > 40]
+        src = (FIXTURES / "bad_jc101.py").read_text().splitlines()
+        assert len(vs) == 1 and "inferred" in src[vs[0].line - 1]
+
+
+class TestConcurrencyRepo:
+    def test_host_dirs_are_clean(self):
+        """The acceptance bar: zero unsuppressed JC101-JC103 across
+        serve/, telemetry/, resilience/, interop/."""
+        violations = concmod.check_paths(concmod.default_paths())
+        assert violations == [], "\n".join(str(v) for v in violations)
+
+    def test_cli_exit_codes(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import threading, time\n"
+            "from aclswarm_tpu.utils.locks import OrderedLock\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = OrderedLock('serve.x')\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            time.sleep(1)\n")
+        assert concmod.main([str(bad)]) == 1
+        assert lintmod.main(["--concurrency", str(PACKAGE / "serve")]) \
+            == 0
 
 
 class TestLintErgonomics:

@@ -1,0 +1,252 @@
+"""swarmguard runtime tier: OrderedLock/OrderedRLock contract
+(docs/STATIC_ANALYSIS.md §host-side concurrency).
+
+Covers: rank enforcement (increasing order legal, inversion raises,
+same-family nesting raises), a genuine two-thread deliberate inversion
+detected via the first-seen nesting graph BEFORE either thread blocks,
+re-entrancy (OrderedRLock legal, OrderedLock self-deadlock reported),
+the hold/wait histogram contract into MetricsRegistry, cross-thread
+held-set reporting, and the disarmed fast path staying check-free.
+"""
+import threading
+
+import pytest
+
+from aclswarm_tpu.telemetry import MetricsRegistry
+from aclswarm_tpu.utils import locks as lockmod
+from aclswarm_tpu.utils.locks import (LockOrderViolation, OrderedLock,
+                                      OrderedRLock, register_rank)
+
+pytestmark = pytest.mark.locks
+
+
+@pytest.fixture(autouse=True)
+def armed_detector():
+    """Each test runs armed with a CLEAN nesting graph and held-set
+    table (the first-seen edge graph is process-global on purpose —
+    tests must not inherit each other's history)."""
+    lockmod.arm()
+    with lockmod._EDGES_GUARD:
+        saved = {k: set(v) for k, v in lockmod._EDGES.items()}
+        lockmod._EDGES.clear()
+    try:
+        yield
+    finally:
+        with lockmod._EDGES_GUARD:
+            lockmod._EDGES.clear()
+            lockmod._EDGES.update(saved)
+        lockmod.disarm()
+
+
+class TestRankEnforcement:
+    def test_increasing_order_legal(self):
+        a = OrderedLock("serve.service")        # rank 20
+        b = OrderedLock("telemetry.registry")   # rank 80
+        with a:
+            with b:
+                assert lockmod.held_families() == (
+                    "serve.service", "telemetry.registry")
+        assert lockmod.held_families() == ()
+
+    def test_inversion_raises_structured(self):
+        a = OrderedLock("serve.service")        # rank 20
+        b = OrderedLock("telemetry.registry")   # rank 80
+        with b:
+            with pytest.raises(LockOrderViolation) as ei:
+                a.acquire()
+        v = ei.value
+        assert v.kind == "rank"
+        assert v.family == "serve.service"
+        assert v.rank == 20
+        assert v.held == ("telemetry.registry",)
+        # the offender never acquired: the fleet is not wedged
+        assert not a.locked()
+
+    def test_same_family_nesting_raises(self):
+        """Two per-metric locks (one family, one rank) have no defined
+        mutual order — nesting them is the classic AB/BA deadlock."""
+        m1 = OrderedLock("telemetry.metric")
+        m2 = OrderedLock("telemetry.metric")
+        with m1:
+            with pytest.raises(LockOrderViolation) as ei:
+                m2.acquire()
+        assert ei.value.kind == "rank"
+
+    def test_rank_registry_conflict_raises(self):
+        register_rank("test.family.x", 33)
+        register_rank("test.family.x", 33)      # idempotent re-pin
+        with pytest.raises(ValueError):
+            register_rank("test.family.x", 44)
+
+    def test_unranked_families_skip_rank_test(self):
+        a = OrderedLock("test.unranked.a")
+        b = OrderedLock("test.unranked.b")
+        with a:
+            with b:
+                pass            # first nesting: records the edge only
+
+
+class TestCycleDetection:
+    def test_two_thread_deliberate_inversion(self):
+        """Thread 1 nests A->B (recording the edge); thread 2 then
+        tries B->A. The detector must refuse thread 2's inner acquire
+        — catching the deadlock pattern even though no rank was ever
+        declared for either family, and WITHOUT needing the two
+        threads to actually collide."""
+        a = OrderedLock("test.cyc.a")
+        b = OrderedLock("test.cyc.b")
+        t1_done = threading.Event()
+        caught: list = []
+
+        def t1():
+            with a:
+                with b:
+                    pass
+            t1_done.set()
+
+        def t2():
+            t1_done.wait(5.0)
+            try:
+                with b:
+                    with a:         # closes the a->b cycle
+                        pass
+            except LockOrderViolation as e:
+                caught.append(e)
+
+        th1 = threading.Thread(target=t1)
+        th2 = threading.Thread(target=t2)
+        th1.start(); th1.join(5.0)
+        th2.start(); th2.join(5.0)
+        assert len(caught) == 1
+        assert caught[0].kind == "cycle"
+        assert caught[0].family == "test.cyc.a"
+
+    def test_peer_held_sets_in_report(self):
+        """The violation snapshot names what OTHER threads hold — the
+        would-be deadlock peer is in the report, not just the
+        offender."""
+        a = OrderedLock("serve.service")
+        b = OrderedLock("telemetry.registry")
+        peer_in = threading.Event()
+        release = threading.Event()
+
+        def peer():
+            with a:
+                peer_in.set()
+                release.wait(5.0)
+
+        th = threading.Thread(target=peer, name="peer-thread")
+        th.start()
+        assert peer_in.wait(5.0)
+        try:
+            with b:
+                with pytest.raises(LockOrderViolation) as ei:
+                    OrderedLock("serve.service").acquire()
+            assert any("serve.service" in fams
+                       for fams in ei.value.peers.values())
+        finally:
+            release.set()
+            th.join(5.0)
+
+
+class TestReentrancy:
+    def test_rlock_reenters(self):
+        r = OrderedRLock("serve.service")
+        with r:
+            with r:                 # legal re-entry, no violation
+                assert lockmod.held_families() == ("serve.service",)
+            assert r.locked()
+        assert not r.locked()
+
+    def test_plain_lock_self_deadlock_reported(self):
+        lk = OrderedLock("serve.service")
+        with lk:
+            with pytest.raises(LockOrderViolation) as ei:
+                lk.acquire()
+        assert ei.value.kind == "self"
+
+    def test_rlock_release_order(self):
+        """Held-set entry survives until the OUTERMOST release."""
+        r = OrderedRLock("serve.pool")
+        inner = OrderedLock("telemetry.metric")
+        with r:
+            r.acquire()
+            r.release()
+            with inner:             # rank 90 > 40: still legal
+                pass
+            assert lockmod.held_families() == ("serve.pool",)
+        assert lockmod.held_families() == ()
+
+
+class TestHistogramContract:
+    def test_hold_and_wait_observed(self):
+        reg = MetricsRegistry()
+        lk = OrderedLock("test.metrics", registry=reg)
+        with lk:
+            pass
+        with lk:
+            pass
+        snap = reg.snapshot()["metrics"]
+        hold = snap["lock_hold_s{name=test.metrics}"]
+        wait = snap["lock_wait_s{name=test.metrics}"]
+        # one wait + one hold observation per completed acquire/release
+        assert hold["count"] == 2 and wait["count"] == 2
+        assert hold["sum"] >= 0 and wait["sum"] >= 0
+
+    def test_wait_measures_contention(self):
+        reg = MetricsRegistry()
+        lk = OrderedLock("test.contend", registry=reg)
+        entered = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with lk:
+                entered.set()
+                release.wait(5.0)
+
+        th = threading.Thread(target=holder)
+        th.start()
+        assert entered.wait(5.0)
+        t = threading.Timer(0.05, release.set)
+        t.start()
+        with lk:                    # blocks ~50 ms behind the holder
+            pass
+        th.join(5.0)
+        row = reg.snapshot()["metrics"]["lock_wait_s{name=test.contend}"]
+        assert row["count"] == 2
+        assert row["max"] >= 0.03   # the contended acquire showed up
+
+    def test_no_registry_no_histograms(self):
+        lk = OrderedLock("test.bare")
+        with lk:
+            pass                    # simply must not blow up
+
+    def test_rlock_holds_once_per_outermost(self):
+        reg = MetricsRegistry()
+        r = OrderedRLock("test.rehold", registry=reg)
+        with r:
+            with r:
+                pass
+        row = reg.snapshot()["metrics"]["lock_hold_s{name=test.rehold}"]
+        assert row["count"] == 1    # hold time = outermost span only
+
+
+class TestDisarmedFastPath:
+    def test_disarmed_inversion_not_checked(self):
+        """Disarmed = production fast path: no rank check runs (the
+        static tier + armed smokes own correctness; production pays
+        only the histogram feed)."""
+        lockmod.disarm()
+        a = OrderedLock("serve.service")
+        b = OrderedLock("telemetry.registry")
+        with b:
+            with a:                 # inverted — but not checked
+                pass
+
+    def test_env_arming(self, monkeypatch):
+        monkeypatch.setenv("ACLSWARM_LOCK_DEBUG", "1")
+        assert lockmod._env_armed()
+        monkeypatch.setenv("ACLSWARM_LOCK_DEBUG", "0")
+        assert not lockmod._env_armed()
+        monkeypatch.delenv("ACLSWARM_LOCK_DEBUG")
+        assert not lockmod._env_armed()

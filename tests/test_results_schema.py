@@ -400,6 +400,46 @@ def test_telemetry_overhead_schema_flags_drift(tmp_path):
                    [frac, pub, {"name": "mystery", "value": 1.0}], "x"))
 
 
+def test_lock_overhead_artifact_committed():
+    """The swarmguard lock-tier tax evidence (acceptance: shipped
+    OrderedLock < 2% of serve-round wall vs plain threading.Lock;
+    docs/OBSERVABILITY.md) is committed and on schema."""
+    path = RESULTS / "lock_overhead.json"
+    assert path.exists(), "benchmarks/results/lock_overhead.json " \
+                          "missing (python benchmarks/lock_overhead.py)"
+    assert check_file(path) == []
+    rows = [json.loads(ln) for ln in path.read_text().strip().splitlines()]
+    by_name = {r["name"]: r for r in rows}
+    assert by_name["lock_overhead_frac_serve"]["value"] < 0.02
+    # the microbench row carries all three price points
+    pair = by_name["lock_pair_ns"]
+    assert pair["value"] > 0 and pair["armed_pair_ns"] > 0
+
+
+def test_lock_overhead_schema_flags_drift(tmp_path):
+    from check_results import check_lock_overhead
+
+    frac = {"name": "lock_overhead_frac_serve", "n": 6, "value": 0.005,
+            "unit": "ratio", "wall_plain_s": 1.0, "wall_ordered_s": 1.0,
+            "reps": 5, "note": "x"}
+    pair = {"name": "lock_pair_ns", "n": 200000, "value": 900.0,
+            "unit": "ns", "plain_pair_ns": 200.0,
+            "armed_pair_ns": 4000.0, "note": "x"}
+    assert check_lock_overhead([frac, pair], "x") == []
+    # the acceptance bar IS schema: a regressed fraction fails loudly
+    assert any("acceptance bar" in p
+               for p in check_lock_overhead(
+                   [dict(frac, value=0.05), pair], "x"))
+    assert any("missing required row" in p
+               for p in check_lock_overhead([frac], "x"))
+    assert any("unknown keys" in p
+               for p in check_lock_overhead(
+                   [dict(frac, bogus=1), pair], "x"))
+    assert any("unknown row name" in p
+               for p in check_lock_overhead(
+                   [frac, pair, {"name": "mystery", "value": 1.0}], "x"))
+
+
 def _scen_row(kind="completion", **kw):
     base = {"name": f"scenario_wind_gust_{kind}", "kind": kind,
             "n": 10, "family": "wind_gust", "trials": 4, "seed": 1,

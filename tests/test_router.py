@@ -451,3 +451,52 @@ class TestDuplicateHelloRace:
                     if p.is_file()] == []
         finally:
             router.close(timeout=10)
+
+
+class TestRefusalOutsideLock:
+    """Regression: `_admit` used to send the structured refusal while
+    HOLDING the router lock — a loser with a wedged socket stalled the
+    sweep/respawn path for the whole fleet. The refusal decision is
+    made under the lock; the send must happen after release."""
+
+    def test_wedged_loser_does_not_stall_router_lock(self, tmp_path):
+        router = SwarmRouter(RouterConfig(journal_root=str(tmp_path),
+                                          slots=1, respawn=False))
+        in_send = threading.Event()
+        release = threading.Event()
+
+        class _WedgedChan:
+            name = "wedged-refusal-chan"
+
+            def send_bytes(self, raw):
+                in_send.set()
+                assert release.wait(10.0), "never released"
+
+            def flush(self):
+                pass
+
+            def close(self):
+                pass
+
+        # unknown slot -> guaranteed refusal path
+        raw = wire._frame(wire.K_HELLO, {
+            "client": "proc.w999.0", "role": "procworker",
+            "slot": 999, "incarnation": 0, "pid": 1})
+        t = threading.Thread(target=router._admit,
+                             args=(_WedgedChan(), raw), daemon=True)
+        try:
+            t.start()
+            assert in_send.wait(5.0), "refusal send never started"
+            # the refusal send is wedged mid-flight: the router lock
+            # must be FREE (pre-fix, this acquire deadlocked until
+            # the send timed out)
+            assert router._lock.acquire(timeout=2.0), \
+                "router lock held across the refusal send"
+            router._lock.release()
+        finally:
+            release.set()
+            t.join(5.0)
+            router._sup.close()
+        assert not t.is_alive()
+        snap = router.telemetry.snapshot()["metrics"]
+        assert snap["router_hello_refused_total"]["value"] == 1

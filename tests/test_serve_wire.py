@@ -901,3 +901,37 @@ class TestSocketTransportBounds:
             assert got == 100
             cli.close()
             srv.close()
+
+
+class TestClientHandleLocking:
+    """Regression: `WireClient._handle` used to read `_tickets`
+    without the client lock, racing `submit`'s insert from the caller
+    thread (a ticket registered between the reader thread's lookup and
+    the dict resize could be missed or corrupt the dict)."""
+
+    def test_ticket_lookup_holds_client_lock(self):
+        import threading
+
+        from aclswarm_tpu.serve import wire
+        from aclswarm_tpu.utils import get_logger
+        from aclswarm_tpu.utils.locks import OrderedLock
+
+        # a bare client: exactly the attributes _handle touches, no
+        # transport — the lock discipline is what's under test
+        client = wire.WireClient.__new__(wire.WireClient)
+        client.log = get_logger("test.wire.client")
+        client.server_info = {}
+        client._connected = threading.Event()
+        client._lock = OrderedLock("serve.wire")
+        depths = []
+
+        class _Guarded(dict):
+            def get(_self, key, default=None):
+                depths.append(client._lock._depth)
+                return dict.get(_self, key, default)
+
+        client._tickets = _Guarded()
+        client._handle({"request_id": "ghost", "seq": 0,
+                        "payload": {}}, wire.K_EVENT)
+        assert depths == [1], \
+            "ticket lookup must run under the client lock"
