@@ -94,6 +94,18 @@ _BLOCKING_METHODS = {
     "result",
 }
 
+# stdlib queue constructors: a local built from one of these is a
+# blocking channel, and `.get()` / `.get(timeout=...)` on it parks the
+# calling thread. Bare "get" can NOT live in _BLOCKING_METHODS (every
+# dict read would match), so queue receivers are typed explicitly and
+# checked by receiver type instead.
+_QUEUE_CTOR_FQ = {
+    "queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+    "queue.SimpleQueue",
+}
+_STDLIB_QUEUE = "<stdlib>.queue.Queue"     # pseudo-classkey (never a
+#                                            repo class: see _by_fq)
+
 # mutating method names that count as WRITES of `self.attr` for the
 # guarded-by inference (``self._jobs.pop(rid)`` mutates `_jobs`)
 _MUTATORS = {
@@ -426,11 +438,56 @@ class ConcurrencyChecker(Linter):
                 t = self._class_from_call(mod, node.value, info)
                 if t:
                     types.setdefault(name, t)
+                elif self._call_fq(mod, node.value, info) \
+                        in _QUEUE_CTOR_FQ:
+                    types.setdefault(name, _STDLIB_QUEUE)
             elif self._self_attr(node.value) and ci is not None:
                 t = ci.attr_types.get(node.value.attr)
                 if t:
                     types.setdefault(name, t)
         return types
+
+    def _blocking_aliases(self, info: FuncInfo, clskey: str | None,
+                          types: dict[str, str]
+                          ) -> dict[str, tuple[str, str | None]]:
+        """Local names bound to a blocking callable WITHOUT calling it
+        (`w = ev.wait`, `f = os.fsync`): the later bare `w(1.0)` /
+        `f(fd)` call sites carry no attribute to match, so the binding
+        site is where the blocking identity is learned. Maps
+        name -> (description, excluded-lockid) with the same cv.wait
+        exclusion as the direct-attribute matcher."""
+        mod = info.module
+        out: dict[str, tuple[str, str | None]] = {}
+        assigns = [node for node in self._iter_own_body(info)
+                   if isinstance(node, ast.Assign)
+                   and len(node.targets) == 1
+                   and isinstance(node.targets[0], ast.Name)]
+        # _iter_own_body is a LIFO walk: re-establish source order so a
+        # later rebind of the name clears the earlier blocking binding
+        for node in sorted(assigns, key=lambda n: n.lineno):
+            name = node.targets[0].id
+            if isinstance(node.value, ast.Call):
+                out.pop(name, None)     # rebound to a call result
+                continue
+            parts = _dotted(node.value)
+            if not parts:
+                out.pop(name, None)
+                continue
+            fq = self._resolve(mod, parts, info)
+            if isinstance(fq, str) and fq in _BLOCKING_FQ:
+                out[name] = (fq, None)
+                continue
+            if len(parts) >= 2 and parts[-1] in _BLOCKING_METHODS \
+                    and isinstance(node.value, ast.Attribute):
+                excl = None
+                if parts[-1] == "wait":
+                    # aliased cv.wait still releases cv when called
+                    excl = self._lock_node(node.value.value, mod,
+                                           clskey, types)
+                out[name] = (f".{parts[-1]}()", excl)
+                continue
+            out.pop(name, None)
+        return out
 
     def _lock_node(self, expr: ast.AST, mod: ModuleInfo,
                    clskey: str | None,
@@ -513,6 +570,7 @@ class ConcurrencyChecker(Linter):
         if isinstance(info.node, ast.Lambda):
             return
         types = self._local_types(info, facts.clskey)
+        aliases = self._blocking_aliases(info, facts.clskey, types)
         lock_attrs = set()
         ci = self.classes.get(facts.clskey) if facts.clskey else None
         if ci is not None:
@@ -530,7 +588,7 @@ class ConcurrencyChecker(Linter):
                     facts.calls.append((node, callee, frozenset(held)))
                 else:
                     self._check_blocking(node, facts, held, mod,
-                                         facts.clskey, types)
+                                         facts.clskey, types, aliases)
                     if isinstance(func, ast.Attribute) \
                             and func.attr in _MUTATORS \
                             and self._self_attr(func.value) \
@@ -649,14 +707,26 @@ class ConcurrencyChecker(Linter):
 
     def _check_blocking(self, call: ast.Call, facts: _Facts,
                         held: tuple, mod: ModuleInfo,
-                        clskey: str | None,
-                        types: dict[str, str]) -> None:
+                        clskey: str | None, types: dict[str, str],
+                        aliases: dict[str, tuple[str, str | None]]
+                        ) -> None:
         fq = self._call_fq(mod, call, facts.info)
         if isinstance(fq, str) and fq in _BLOCKING_FQ:
             facts.blocking.append(
                 (fq, frozenset(held), call, None))
             return
         func = call.func
+        if isinstance(func, ast.Name) and func.id in aliases:
+            desc, excl = aliases[func.id]
+            facts.blocking.append(
+                (desc, frozenset(held), call, excl))
+            return
+        if isinstance(func, ast.Attribute) and func.attr == "get" \
+                and self._queue_get(func, facts, types) \
+                and not self._nonblocking_get(call):
+            facts.blocking.append(
+                (".get()", frozenset(held), call, None))
+            return
         if not isinstance(func, ast.Attribute) \
                 or func.attr not in _BLOCKING_METHODS:
             return
@@ -668,6 +738,25 @@ class ConcurrencyChecker(Linter):
             excl = self._lock_node(func.value, mod, clskey, types)
         facts.blocking.append(
             (f".{func.attr}()", frozenset(held), call, excl))
+
+    def _queue_get(self, func: ast.Attribute, facts: _Facts,
+                   types: dict[str, str]) -> bool:
+        """Is the `.get` receiver a stdlib-queue-typed local?"""
+        parts = _dotted(func.value)
+        return bool(parts) and len(parts) == 1 \
+            and types.get(parts[0]) == _STDLIB_QUEUE
+
+    @staticmethod
+    def _nonblocking_get(call: ast.Call) -> bool:
+        """`q.get(False)` / `q.get(block=False)` returns immediately —
+        only the blocking form parks the thread."""
+        for kw in call.keywords:
+            if kw.arg == "block" \
+                    and isinstance(kw.value, ast.Constant):
+                return not kw.value.value
+        if call.args and isinstance(call.args[0], ast.Constant):
+            return not call.args[0].value
+        return False
 
     # -- entry-held fixpoint ------------------------------------------------
     def _entry_fixpoint(self) -> None:

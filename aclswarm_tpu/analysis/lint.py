@@ -991,15 +991,34 @@ def main(argv: list[str] | None = None) -> int:
         description="jaxcheck: JAX-specific AST lint (JC001-JC005)")
     ap.add_argument("paths", nargs="*", default=[],
                     help="files or directories (default: aclswarm_tpu/; "
-                         "with --concurrency: the host-side dirs)")
+                         "with --concurrency/--protocol: that tier's "
+                         "default dirs)")
     ap.add_argument("--concurrency", action="store_true",
                     help="run the host-side concurrency tier "
                          "(JC101-JC103) instead of the JAX rules")
+    ap.add_argument("--protocol", action="store_true",
+                    help="run the serve-protocol conformance tier "
+                         "(JC201-JC204) instead of the JAX rules")
+    ap.add_argument("--all", action="store_true", dest="all_tiers",
+                    help="run every tier (JC0xx + JC1xx + JC2xx) over "
+                         "its own default paths; exit 1 if ANY tier "
+                         "finds a violation")
     args = ap.parse_args(argv)
+    if args.all_tiers:
+        # merged exit surface: every tier runs (no short-circuit) so
+        # one invocation reports the whole picture, then the codes OR
+        from . import concurrency, protocol
+        rc = main(list(args.paths))
+        rc |= concurrency.main(list(args.paths))
+        rc |= protocol.main([str(p) for p in args.paths])
+        return rc
     if args.concurrency:
         # lazy import: the concurrency module imports from this one
         from . import concurrency
         return concurrency.main(args.paths)
+    if args.protocol:
+        from . import protocol
+        return protocol.main([str(p) for p in args.paths])
     paths = args.paths or [str(Path(__file__).resolve().parents[1])]
     violations = lint_paths(paths)
     for v in violations:

@@ -159,6 +159,11 @@ class Ticket:
         self._events: queue.Queue = queue.Queue()
         self._result: Optional[Result] = None
         self._done = threading.Event()
+        # guards the resolve once-check: test-and-commit must be one
+        # atomic step or two racing resolvers (worker vs recovery vs
+        # wire reader) can both pass the check and the LAST writer's
+        # result overwrites the first after waiters saw it
+        self._resolve_lock = threading.Lock()
         # True once the service has accepted the request. In-process
         # tickets exist only post-acceptance (submit raises otherwise);
         # the wire client flips it False until the accept frame lands,
@@ -172,11 +177,15 @@ class Ticket:
 
     def _resolve(self, result: Result) -> None:
         """Terminal: publish the result and close the event stream.
-        First resolution wins (idempotent — recovery paths may race)."""
-        if self._done.is_set():
-            return
-        self._result = result
-        self._done.set()
+        First resolution wins (idempotent — recovery paths may race):
+        the once-check and the commit share `_resolve_lock`, so the
+        loser of a race observes the winner's publication instead of
+        overwriting it."""
+        with self._resolve_lock:
+            if self._done.is_set():
+                return
+            self._result = result
+            self._done.set()
         self._events.put(_SENTINEL)
 
     # -- client side -------------------------------------------------------

@@ -708,6 +708,8 @@ class SwarmService:
             # racing submits with one request_id cannot both build jobs
             # — the loser attaches to THIS ticket above
             self._jobs[rid] = job
+        journaled = False      # acceptance events on disk -> the error
+        #                        path owes the timeline a terminal record
         try:
             # caps-then-durable-then-prepped-then-runnable: admission
             # HOLDS a caps slot (picker-invisible) before the journal
@@ -738,6 +740,7 @@ class SwarmService:
                 # the acceptance events land BEFORE the job becomes
                 # pickable: a fast worker's `batched` record must never
                 # precede `submitted` in the causal file order
+                journaled = True
                 self._journal_event("submitted", job, kind=kind,
                                     tenant=tenant, deadline_s=deadline_s,
                                     t_submit=req.t_submit)
@@ -764,6 +767,11 @@ class SwarmService:
                 self._jobs.pop(rid, None)
                 if rejected:
                     self.stats["rejected"] += 1
+                # atomic terminal reservation (shared with _finish): if
+                # release() raised after the job turned pickable, a
+                # worker may race this cleanup — first claimant wins
+                already = job.finished
+                job.finished = True
             if rejected:
                 # admission ledger + the backpressure hints handed out
                 self.telemetry.counter("serve_rejected_total").inc()
@@ -771,6 +779,14 @@ class SwarmService:
                     e.retry_after_s)
             self._adm.cancel(job)
             self._sample_queue()
+            if journaled and not already:
+                # the acceptance events are already on disk: without a
+                # terminal record this request reconstructs as a
+                # journaled loss. Close the timeline BEFORE the frame
+                # unlink below retracts the acceptance promise.
+                self._journal_event(
+                    "resolved", job, status=FAILED, chunks=0,
+                    error_code=E_QUEUE_FULL if rejected else E_EXECUTION)
             if self._journal is not None and not self._fence_lost:
                 # fenced submits raised BEFORE writing their frame —
                 # unlinking here would delete a frame the successor
@@ -779,11 +795,12 @@ class SwarmService:
             # a duplicate submit that attached during the reservation
             # window holds this ticket: resolve it so it can never
             # dangle (the primary caller sees the raised error)
-            job.ticket._resolve(Result(
-                request_id=rid, status=FAILED,
-                error=ServeError(
-                    E_QUEUE_FULL if rejected else E_EXECUTION,
-                    f"submit failed before acceptance: {e}")))
+            if not already:
+                job.ticket._resolve(Result(
+                    request_id=rid, status=FAILED,
+                    error=ServeError(
+                        E_QUEUE_FULL if rejected else E_EXECUTION,
+                        f"submit failed before acceptance: {e}")))
             raise
         with self._lock:
             self.stats["accepted"] += 1
@@ -2069,6 +2086,12 @@ class SwarmService:
                     continue
                 job.status = QUEUED
                 job.worker = None
+                # the handback is a real state transition: journal it
+                # in the same lock hold (like _failover_job's
+                # `migrated`) so the postmortem reads an unbroken
+                # ... batched -> queued -> batched ... chain instead
+                # of a gap where the job silently changed hands
+                self._journal_event("queued", job, reason="unowned")
                 self._adm.requeue(job)
 
     def _journal_event_owned(self, event: str, job: _Job, epoch: int,

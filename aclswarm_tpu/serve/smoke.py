@@ -43,6 +43,7 @@ flushed by the supervisor on the worker's behalf.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import signal
@@ -78,6 +79,25 @@ REQUESTS = [
 ]
 
 
+@contextlib.contextmanager
+def _journal_dir(tag: str):
+    """The smoke's journal directory. Ephemeral by default; when
+    ``ACLSWARM_KEEP_JOURNALS`` names a directory, the journal survives
+    the run under ``$ACLSWARM_KEEP_JOURNALS/<tag>/`` — the refinement
+    gate (`analysis.model --refine`, scripts/check.sh) replays exactly
+    the crash-drill journals the smokes already produce, at zero extra
+    smoke runtime."""
+    keep = os.environ.get("ACLSWARM_KEEP_JOURNALS")
+    if keep:
+        d = Path(keep) / tag
+        d.mkdir(parents=True, exist_ok=True)
+        yield str(d)
+        return
+    with tempfile.TemporaryDirectory(
+            prefix=f"aclswarm_{tag}_smoke_") as d:
+        yield d
+
+
 def _service(journal: str) -> SwarmService:
     # max_batch=1 serializes the rounds so the kill boundary is
     # deterministic: round 1 runs the rollout's first chunk, and the
@@ -100,7 +120,7 @@ def child(journal: str) -> int:
 
 
 def run_smoke() -> int:
-    with tempfile.TemporaryDirectory(prefix="aclswarm_serve_smoke_") as d:
+    with _journal_dir("serve") as d:
         env = dict(os.environ, **{ENV_VAR: f"serve:{KILL_ROUND}:kill"})
         t0 = time.time()
         r = subprocess.run(
@@ -181,7 +201,7 @@ def run_multiworker() -> int:
     ref.close()
     assert want.ok
 
-    with tempfile.TemporaryDirectory(prefix="aclswarm_mw_smoke_") as d:
+    with _journal_dir("mw") as d:
         # swarmwatch rides the drill (docs/OBSERVABILITY.md §swarmwatch):
         # the kill below must surface on the LIVE health surface, not
         # just in the postmortem journal. Rejoin backoff > sampler
@@ -280,7 +300,7 @@ def run_postmortem() -> int:
 
     t0 = time.time()
     roll = REQUESTS[0]["params"]
-    with tempfile.TemporaryDirectory(prefix="aclswarm_pm_smoke_") as d:
+    with _journal_dir("pm") as d:
         svc = SwarmService(ServiceConfig(
             workers=2, max_batch=1, quantum_chunks=8, journal_dir=d,
             supervise_poll_s=0.02, rejoin_base_s=0.05))
@@ -363,7 +383,7 @@ def run_procs() -> int:
     ref.close()
     assert want.ok
 
-    with tempfile.TemporaryDirectory(prefix="aclswarm_proc_smoke_") as d:
+    with _journal_dir("proc") as d:
         router = SwarmRouter(RouterConfig(
             journal_root=d, slots=2,
             worker={"service": {"max_batch": 1, "quantum_chunks": 1}}))

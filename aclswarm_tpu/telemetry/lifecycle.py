@@ -76,7 +76,8 @@ EVENTS: dict[str, frozenset] = {
     # admission
     "submitted": frozenset({"kind", "tenant"}),       # + deadline_s, t_submit
     "admitted": frozenset(),                          # + queue_depth
-    "queued": frozenset({"reason"}),    # boundary|preempt|failover|recovery
+    "queued": frozenset({"reason"}),
+    #                     boundary|preempt|failover|recovery|unowned
     # scheduling / execution
     "batched": frozenset({"worker", "round", "batch"}),   # + bucket, chunk
     "chunk": frozenset({"k", "digest", "worker"}),        # + tick_end, round

@@ -47,7 +47,8 @@ from aclswarm_tpu.telemetry.lifecycle import (EVENTS, TERMINAL_EVENTS,
                                               LifecycleLog)
 
 __all__ = ["load_journal", "analyze_request", "reconstruct",
-           "fleet_summary", "fleet_reconstruct", "main"]
+           "fleet_summary", "fleet_merge_summary", "fleet_reconstruct",
+           "main"]
 
 EVENTS_LOG = "events.log"
 
@@ -392,6 +393,29 @@ def fleet_summary(report: dict) -> dict:
     }
 
 
+def fleet_merge_summary(rep: dict) -> dict:
+    """`fleet_summary` over a `fleet_reconstruct` merge: the same
+    rollup table, plus the cross-journal columns the single-journal
+    path cannot have — ``losses`` (journaled, terminal nowhere) and
+    ``duplicate_terminals`` (terminal in MORE than one slot journal:
+    legal at-least-once duplicate compute, but a nonzero count is a
+    budget the `--all` gate makes visible and enforceable)."""
+    base = fleet_summary({
+        "journal": " + ".join(str(j) for j in rep["journals"]),
+        "accepted": rep["accepted"],
+        "reconstructed": rep["resolved"],
+        "complete": rep["resolved"],
+        "gap_free": rep["gap_free"],
+        "events": rep["events"],
+        "torn_tail": rep["torn_tail"],
+        "requests": rep["requests"],
+    })
+    base["migrated"] = rep["migrated"]
+    base["losses"] = rep["losses"]
+    base["duplicate_terminals"] = rep["duplicate_terminals"]
+    return base
+
+
 def _print_fleet(summary: dict) -> None:
     print(f"journal {summary['journal']}: {summary['accepted']} "
           f"accepted, {summary['reconstructed']} reconstructed — "
@@ -404,11 +428,19 @@ def _print_fleet(summary: dict) -> None:
           f"migrations {summary['migrations']}  "
           f"preemptions {summary['preemptions']}  "
           f"resumes {summary['resumes']}  events {summary['events']}")
+    if "duplicate_terminals" in summary:       # fleet-merge columns
+        print(f"  migrated {summary['migrated']}  "
+              f"losses {len(summary['losses'])}  "
+              f"duplicate_terminals "
+              f"{len(summary['duplicate_terminals'])}")
     print(f"  {'stage':<16} {'sum_s':>10} {'mean_s':>10} {'max_s':>10}")
     for k in STAGES:
         st = summary["stages"][k]
         print(f"  {k:<16} {st['sum_s']:>10.3f} {st['mean_s']:>10.3f} "
               f"{st['max_s']:>10.3f}")
+    for rid in summary.get("duplicate_terminals", ()):
+        print(f"  DUPLICATE: {rid} terminal in more than one journal "
+              f"(at-least-once duplicate compute)")
     for rid in summary["incomplete"]:
         print(f"  PROBLEM: {rid} does not reconstruct complete+gap-free")
 
@@ -434,12 +466,29 @@ def main(argv=None) -> int:
     ap.add_argument("--all", action="store_true", dest="fleet",
                     help="one-pass fleet summary over every request "
                          "(verdict counts + aggregate per-stage latency "
-                         "table) instead of per-request timelines")
+                         "table) instead of per-request timelines; with "
+                         "SEVERAL journals the summary adds the merge "
+                         "columns (migrated / losses / duplicate "
+                         "terminals) and a nonzero duplicate-terminal "
+                         "count fails the gate")
     ap.add_argument("--json", action="store_true",
                     help="emit the full machine-readable report")
     args = ap.parse_args(argv)
     if len(args.journal) > 1:
         rep = fleet_reconstruct(args.journal)
+        if args.fleet:
+            # fleet-merge summary table: duplicate terminals are legal
+            # at-least-once behavior on the plain merge path, but the
+            # --all gate treats a nonzero count as a failure — the
+            # duplicate-compute budget is an assertable surface
+            summary = fleet_merge_summary(rep)
+            if args.json:
+                print(json.dumps(summary, indent=1, sort_keys=True,
+                                 default=str))
+            else:
+                _print_fleet(summary)
+            return 0 if not (rep["losses"]
+                             or rep["duplicate_terminals"]) else 1
         if args.json:
             print(json.dumps(rep, indent=1, sort_keys=True,
                              default=str))

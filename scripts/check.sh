@@ -30,9 +30,20 @@
 #                               procworker OS processes mid-flight —
 #                               the router's promise survives, zero
 #                               journaled losses, fenced predecessor
+#   python -m aclswarm_tpu.analysis.lint --protocol
+#                               swarmproto conformance lint (JC201-204)
+#   JAX_PLATFORMS=cpu python -m aclswarm_tpu.analysis.model --self-test
+#                               explicit-state model checker: prove the
+#                               five protocol properties AND that every
+#                               deliberate mutation trips exactly its
+#                               property
+#   JAX_PLATFORMS=cpu python -m aclswarm_tpu.analysis.model --refine DIR
+#                               refinement gate: the crash-drill
+#                               journals the smokes above just produced
+#                               must replay as accepted protocol traces
 #   pytest tests/test_analysis.py tests/test_invariants.py \
 #          tests/test_results_schema.py tests/test_resilience.py \
-#          tests/test_serve.py                      guard self-tests
+#          tests/test_serve.py ...                  guard self-tests
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -41,6 +52,18 @@ scripts/lint.sh
 
 echo "== jaxcheck concurrency tier: lock discipline (JC101-JC103) =="
 python -m aclswarm_tpu.analysis.lint --concurrency
+
+echo "== swarmproto conformance lint: promise/journal/fencing =="
+echo "== protocol (JC201-JC204) over serve/ + resilience/, with =="
+echo "== event-vocabulary coverage (docs/STATIC_ANALYSIS.md) =="
+python -m aclswarm_tpu.analysis.lint --protocol
+
+echo "== swarmproto model checker: BFS the 2-request x 2-worker =="
+echo "== crash/fence state space — prove no-lost-request, at-most- =="
+echo "== once-or-bit-identical, terminal-once, fenced-no-ops, and =="
+echo "== replay idempotence; then verify each deliberate protocol =="
+echo "== mutation trips exactly its property (counterexample drill) =="
+JAX_PLATFORMS=cpu python -m aclswarm_tpu.analysis.model --self-test
 
 echo "== jaxcheck layer 2: trace audit + swarmcheck zero-cost-off proof =="
 JAX_PLATFORMS=cpu python -m aclswarm_tpu.analysis.trace_audit
@@ -91,6 +114,13 @@ echo "== crash-resume smoke: SIGKILL at chunk 1 of an n=5 rollout, =="
 echo "== resume from checkpoint, assert bit-parity (docs/RESILIENCE.md) =="
 JAX_PLATFORMS=cpu python -m aclswarm_tpu.resilience.smoke
 
+# keep the serve smokes' crash-drill journals: the swarmproto
+# refinement gate below replays them through the protocol — real
+# SIGKILL/failover/fence histories, zero extra smoke runtime
+KEEP_JOURNALS=$(mktemp -d /tmp/aclswarm_smoke_journals.XXXXXX)
+trap 'rm -rf "$KEEP_JOURNALS"' EXIT
+export ACLSWARM_KEEP_JOURNALS="$KEEP_JOURNALS"
+
 echo "== serve smoke: start the service, submit 3 mixed requests, =="
 echo "== SIGKILL the worker mid-batch, recover the journal — zero =="
 echo "== losses + bit-identical resume (docs/SERVICE.md) =="
@@ -123,6 +153,19 @@ echo "== ACLSWARM_LOCK_DEBUG=1 inherits into the procworker children, =="
 echo "== so lock-order discipline is enforced across every process =="
 JAX_PLATFORMS=cpu ACLSWARM_LOCK_DEBUG=1 \
     python -m aclswarm_tpu.serve.smoke --procs
+
+echo "== swarmproto refinement gate: every crash-drill journal the =="
+echo "== four serve smokes just produced (SIGKILL, worker failover, =="
+echo "== postmortem kill, process-fleet kill) must replay as an =="
+echo "== accepted trace of the declarative protocol — the spec, the =="
+echo "== model, and the running system agree on the same histories =="
+JAX_PLATFORMS=cpu python -m aclswarm_tpu.analysis.model \
+    --refine "$KEEP_JOURNALS"
+# drop the kept journals now: the final exec replaces this shell, so
+# the EXIT trap (which covers failure paths above) never fires
+rm -rf "$KEEP_JOURNALS"
+trap - EXIT
+unset ACLSWARM_KEEP_JOURNALS
 
 echo "== overload smoke: TCP clients at 10x measured capacity (the =="
 echo "== adversarial open-loop fleet — slow-loris, corrupt frames, =="
@@ -167,7 +210,7 @@ else
     echo "no tier-1 log at $T1_LOG — skipping (run tier-1 first)"
 fi
 
-echo "== guard self-tests (lint fixtures, audit grid, invariant contracts, resilience, serve, wire, router, traffic, telemetry, trace, watch, scenarios) =="
+echo "== guard self-tests (lint fixtures, audit grid, invariant contracts, resilience, serve, wire, router, traffic, telemetry, trace, watch, scenarios, protocol) =="
 exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_analysis.py tests/test_invariants.py \
     tests/test_results_schema.py tests/test_resilience.py \
@@ -177,4 +220,5 @@ exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_telemetry.py tests/test_trace.py \
     tests/test_watch.py \
     tests/test_scenarios.py \
+    tests/test_protocol.py \
     -q -m 'not slow' -p no:cacheprovider

@@ -6,8 +6,16 @@ one unlocked call site, so the report lands on the locked CALL SITE
 (the entry-held intersection is empty); `_fsync_always_locked` is
 entry-held, so the report lands on the primitive itself. ``cv.wait()``
 on the condition you hold is the CV protocol and stays quiet.
+
+The alias cases bind the blocking callable to a local name first
+(``w = evt.wait``; ``f = os.fsync``) — the call site then carries no
+attribute to match, so the binding site supplies the identity. The
+queue case types ``q`` from its stdlib ctor: bare ``.get`` is not in
+the method catalog (every dict read would match), only queue-typed
+receivers report, and only in the blocking form.
 """
 import os
+import queue
 import threading
 import time
 
@@ -65,3 +73,35 @@ class Service:
         # justified: single-writer socket with a bounded frame size
         with self._lock:
             self._sock.sendall(payload)  # jaxcheck: disable=JC103
+
+    def bad_alias_wait(self):
+        w = self._evt.wait
+        with self._lock:
+            w(1.0)                          # JC103 (aliased event wait)
+
+    def bad_alias_fsync(self):
+        f = os.fsync
+        with self._lock:
+            f(self._fd)                     # JC103 (aliased fsync)
+
+    def bad_queue_get(self):
+        q = queue.Queue()
+        with self._lock:
+            return q.get(timeout=1.0)       # JC103 (queue get under lock)
+
+    def queue_get_nonblocking_ok(self):
+        q = queue.Queue()
+        with self._lock:
+            try:
+                return q.get(block=False)   # clean: returns immediately
+            except queue.Empty:
+                return None
+
+    def alias_rebound_ok(self):
+        w = self._evt.wait
+        w = self._make_payload              # rebound: no longer blocking
+        with self._lock:
+            return w()                      # clean
+
+    def _make_payload(self):
+        return b""

@@ -96,7 +96,7 @@ class TestConcurrencyFixtures:
     @pytest.mark.parametrize("fixture,rule,count", [
         ("bad_jc101.py", "JC101", 3),
         ("bad_jc102.py", "JC102", 4),
-        ("bad_jc103.py", "JC103", 5),
+        ("bad_jc103.py", "JC103", 8),
     ])
     def test_rule_fires(self, fired, fixture, rule, count):
         vs = fired.get(fixture, [])
@@ -125,6 +125,13 @@ class TestConcurrencyFixtures:
         src = (FIXTURES / "bad_jc102.py").read_text().splitlines()
         flagged = {src[v.line - 1] for v in fired["bad_jc102.py"]}
         assert not any("partner edge waived" in s for s in flagged)
+
+    def test_alias_and_queue_quiet_cases(self, fired):
+        """The JC103 catalog extension must not overreach: a rebound
+        alias and the non-blocking `q.get(block=False)` stay quiet."""
+        src = (FIXTURES / "bad_jc103.py").read_text().splitlines()
+        flagged = {src[v.line - 1] for v in fired["bad_jc103.py"]}
+        assert not any("clean" in s for s in flagged), flagged
 
     def test_inferred_guard_reports_writes_only(self, fired):
         """The Tally class has no annotations: only the unlocked WRITE

@@ -1,0 +1,298 @@
+"""swarmproto: the protocol spec, the JC2xx conformance lint, the
+explicit-state model checker, and journal trace refinement.
+
+Four layers of the same protocol, tested against each other: the
+declarative transition table accepts exactly the legal request
+histories; the linter fires on the known-bad fixtures and stays at
+zero across serve/ + resilience/; every deliberate protocol mutation
+trips exactly its property with a minimal counterexample naming the
+crashing boundary; and journals — synthetic and real — refine into
+accepted protocol traces.
+"""
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+from aclswarm_tpu.analysis import model as modelmod
+from aclswarm_tpu.analysis import protocol as protomod
+from aclswarm_tpu.telemetry import LifecycleLog, lifecycle, mint_trace_id
+
+FIXTURES = Path(__file__).parent / "fixtures" / "jaxcheck"
+
+pytestmark = pytest.mark.analysis
+
+
+# ------------------------------------------------------ declarative spec
+
+CLEAN = ["submitted", "admitted", "batched", "chunk", "queued",
+         "batched", "chunk", "checkpointed", "resolved"]
+
+
+class TestProtocolSpec:
+    def test_alphabet_is_exactly_the_request_vocabulary(self):
+        alphabet = {ev for edges in protomod.TRANSITIONS.values()
+                    for ev in edges}
+        assert alphabet == set(lifecycle.EVENTS)
+
+    def test_optionals_cover_every_event(self):
+        assert set(protomod.OPTIONAL_FIELDS) == set(protomod.VOCABULARY)
+
+    def test_clean_trace_accepted_and_terminal(self):
+        ok, phase, problem = protomod.accepts(CLEAN)
+        assert ok and problem is None
+        assert phase == protomod.TERMINAL_PHASE
+
+    def test_prefix_closed(self):
+        """Crash-at-any-boundary: every prefix of a legal history is
+        itself a legal (incomplete) history."""
+        for cut in range(len(CLEAN) + 1):
+            ok, phase, problem = protomod.accepts(CLEAN[:cut])
+            assert ok, f"prefix {CLEAN[:cut]} rejected: {problem}"
+
+    def test_terminal_exactly_once(self):
+        ok, _, problem = protomod.accepts(CLEAN + ["resolved"])
+        assert not ok and "'resolved'" in problem
+
+    def test_nothing_before_submitted(self):
+        ok, _, problem = protomod.accepts(["batched"])
+        assert not ok and "phase 'init'" in problem
+
+    def test_cancel_then_resolve_via_finishing(self):
+        ok, phase, _ = protomod.accepts(
+            ["submitted", "admitted", "cancelled", "resolved"])
+        assert ok and phase == protomod.TERMINAL_PHASE
+
+    def test_fragment_accepts_mid_stream_slice(self):
+        """A migrated request's slice in the SURVIVOR's journal starts
+        mid-protocol — legal as a fragment, illegal from init."""
+        slice_ = ["batched", "chunk", "resolved"]
+        ok, _, _ = protomod.accepts(slice_)
+        assert not ok
+        ok, problem = protomod.accepts_fragment(slice_)
+        assert ok, problem
+
+    def test_fragment_still_rejects_impossible_orders(self):
+        ok, problem = protomod.accepts_fragment(
+            ["resolved", "submitted"])
+        assert not ok and "illegal in every reachable phase" in problem
+
+
+# ------------------------------------------------------ conformance lint
+
+def _by_file(violations):
+    out = {}
+    for v in violations:
+        out.setdefault(Path(v.path).name, []).append(v)
+    return out
+
+
+class TestProtocolFixtures:
+    @pytest.fixture(scope="class")
+    def fired(self):
+        return _by_file(protomod.check_paths(
+            [FIXTURES / f for f in ("bad_jc201.py", "bad_jc202.py",
+                                    "bad_jc203.py", "bad_jc204.py")]))
+
+    @pytest.mark.parametrize("fixture,rule,count", [
+        ("bad_jc201.py", "JC201", 1),
+        ("bad_jc202.py", "JC202", 3),
+        ("bad_jc203.py", "JC203", 2),
+        ("bad_jc204.py", "JC204", 3),
+    ])
+    def test_rule_fires(self, fired, fixture, rule, count):
+        vs = fired.get(fixture, [])
+        assert [v.rule for v in vs] == [rule] * count, \
+            f"{fixture}: expected {count}x{rule}, got {vs}"
+
+    def test_fixture_lines_match_annotations(self, fired):
+        for fname, vs in fired.items():
+            src = (FIXTURES / fname).read_text().splitlines()
+            for v in vs:
+                assert v.rule in src[v.line - 1], \
+                    f"{fname}:{v.line} fired {v.rule} on an " \
+                    f"unannotated line: {src[v.line - 1]!r}"
+
+    def test_clean_cases_stay_quiet(self, fired):
+        """Durable-then-reply, ctor writes, emitting helpers, locked
+        once-guards, splat emissions: annotated `clean` lines must not
+        fire."""
+        for fname, vs in fired.items():
+            src = (FIXTURES / fname).read_text().splitlines()
+            for v in vs:
+                assert "clean" not in src[v.line - 1], \
+                    f"{fname}:{v.line} fired on a clean line"
+
+    def test_pragma_suppresses(self, fired):
+        """`# jaxcheck: disable=JC204` waives the reviewed line."""
+        for vs in fired.values():
+            for v in vs:
+                src = Path(v.path).read_text().splitlines()
+                assert "disable=" + v.rule not in src[v.line - 1]
+
+
+class TestProtocolRepo:
+    def test_serve_and_resilience_sweep_clean(self):
+        """The acceptance bar: zero unsuppressed JC201-JC204 across
+        serve/ + resilience/, INCLUDING vocabulary coverage (every
+        event in the schema has a real emission site)."""
+        violations = protomod.check_paths(protomod.default_paths(),
+                                          coverage=True)
+        assert violations == [], "\n".join(str(v) for v in violations)
+
+    def test_cli_exit_codes(self, tmp_path):
+        assert protomod.main(["-q", str(FIXTURES / "bad_jc204.py")]) == 1
+        clean = tmp_path / "clean.py"
+        clean.write_text("def f():\n    return 1\n")
+        assert protomod.main(["-q", str(clean)]) == 0
+
+    def test_lint_all_merges_tiers(self, capsys):
+        """`lint --all` runs JC0xx + JC1xx + JC2xx over their default
+        paths with one merged exit surface."""
+        from aclswarm_tpu.analysis import lint as lintmod
+        assert lintmod.main(["--all"]) == 0
+        out = capsys.readouterr()
+        assert "jaxcheck:" in out.out
+        assert "jaxcheck-concurrency:" in out.out
+        assert "swarmproto:" in out.out + out.err
+
+
+# ----------------------------------------------------- the model checker
+
+class TestModelChecker:
+    def test_all_properties_hold_on_2x2(self):
+        res = modelmod.check(modelmod.ModelConfig())
+        assert res.ok, modelmod.render_trace(res)
+        assert res.states > 100    # the space is genuinely explored
+
+    @pytest.mark.parametrize("mutation,expected",
+                             sorted(modelmod.MUTATIONS.items()))
+    def test_mutation_trips_exactly_its_property(self, mutation,
+                                                 expected):
+        """Each deliberate protocol mutation — drop the done-frame
+        append, skip the fence check, remove a once-guard — must trip
+        precisely the property built to catch it, with a non-empty
+        minimal trace."""
+        res = modelmod.check(modelmod.ModelConfig(mutation=mutation))
+        assert not res.ok, f"{mutation} tripped nothing"
+        assert res.property == expected, \
+            f"{mutation} tripped {res.property}, expected {expected}"
+        assert res.trace, "counterexample trace is empty"
+
+    def test_counterexample_names_property_and_steps(self):
+        res = modelmod.check(
+            modelmod.ModelConfig(mutation="double_resolve"))
+        text = modelmod.render_trace(res)
+        assert "PROPERTY VIOLATED: P3" in text
+        assert "terminal-once" in text
+        assert f"trace ({len(res.trace)} steps)" in text
+        # every step is numbered in order
+        for i in range(1, len(res.trace) + 1):
+            assert f"{i:2d}. " in text
+
+    def test_skip_fence_counterexample_names_the_boundary(self):
+        """The P4 counterexample's crash step must say WHICH boundary
+        the SIGKILL interrupted — that is the line a human replays."""
+        res = modelmod.check(
+            modelmod.ModelConfig(mutation="skip_fence"))
+        assert res.property == "P4"
+        text = modelmod.render_trace(res)
+        assert "<- boundary: after" in text
+        assert any("zombie_write" in label
+                   for label, *_ in res.trace)
+
+    def test_drop_done_frame_is_a_lost_request(self):
+        res = modelmod.check(
+            modelmod.ModelConfig(mutation="drop_done_frame"))
+        assert res.property == "P1"
+        assert "[dropped]" in " ".join(l for l, *_ in res.trace)
+
+    def test_mutated_transitions_need_their_schedule(self):
+        """With no crash budget the fence mutation has no zombie to
+        land — the checker must prove the MUTATED system correct under
+        schedules that never reach the hole (no false alarms)."""
+        res = modelmod.check(modelmod.ModelConfig(
+            mutation="skip_fence", crashes=0, failovers=0,
+            zombie=False))
+        assert res.ok
+
+
+# ----------------------------------------------------- trace refinement
+
+def _emit_history(log: LifecycleLog, rid: str, events) -> None:
+    tid = mint_trace_id()
+    t = [1000.0]
+    defaults = {
+        "submitted": {"kind": "rollout", "tenant": "a"},
+        "admitted": {},
+        "queued": {"reason": "boundary"},
+        "batched": {"worker": 0, "round": 1, "batch": 1},
+        "chunk": {"k": 0, "digest": 7, "worker": 0},
+        "checkpointed": {"chunk": 0, "durable": True},
+        "migrated": {"dead_worker": 0, "chunk": 0},
+        "resumed": {"from_chunk": 0},
+        "preempted": {"chunk": 0},
+        "deadline": {"chunk": 0},
+        "cancelled": {"reason": "client"},
+        "poisoned": {},
+        "resolved": {"status": "completed", "chunks": 1},
+    }
+    for ev in events:
+        t[0] += 0.1
+        log.emit(ev, request_id=rid, trace_id=tid, t_wall=t[0],
+                 **defaults[ev])
+
+
+class TestRefinement:
+    def test_synthetic_clean_journal_refines(self, tmp_path):
+        log = LifecycleLog(tmp_path / "events.log")
+        _emit_history(log, "r1", CLEAN)
+        assert modelmod.refine_dir(tmp_path) == []
+
+    def test_protocol_violating_journal_is_caught(self, tmp_path):
+        log = LifecycleLog(tmp_path / "events.log")
+        _emit_history(log, "r1", CLEAN + ["resolved"])   # terminal twice
+        problems = modelmod.refine_dir(tmp_path)
+        assert len(problems) == 1 and "illegal" in problems[0]
+
+    def test_fleet_slices_refine_as_fragments(self, tmp_path):
+        """A migrated request: acceptance + first chunk in slot0's
+        journal, resumption + terminal in slot1's. Each slice refines
+        as a fragment; slot1's would be ILLEGAL from init."""
+        a, b = tmp_path / "slot0", tmp_path / "slot1"
+        _emit_history(LifecycleLog(a / "events.log"), "r1",
+                      ["submitted", "admitted", "batched", "chunk"])
+        _emit_history(LifecycleLog(b / "events.log"), "r1",
+                      ["batched", "resumed", "chunk", "resolved"])
+        assert modelmod.refine_dir(b) != []       # not valid from init
+        rep = modelmod.refine_tree(tmp_path)      # siblings = one fleet
+        assert rep["journals"] == 2 and rep["problems"] == []
+
+    def test_refine_cli_exit_codes(self, tmp_path, capsys):
+        good = tmp_path / "good"
+        _emit_history(LifecycleLog(good / "events.log"), "r1", CLEAN)
+        assert modelmod.main(["--refine", str(good), "-q"]) == 0
+        bad = tmp_path / "bad"
+        _emit_history(LifecycleLog(bad / "events.log"), "r1",
+                      ["submitted", "submitted"])
+        assert modelmod.main(["--refine", str(bad), "-q"]) == 1
+        out = capsys.readouterr().out
+        assert "REFINEMENT FAIL" in out
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert modelmod.main(["--refine", str(empty), "-q"]) == 1
+
+    def test_real_service_journal_refines(self, tmp_path):
+        """End to end: a live SwarmService journal — acceptance frames,
+        lifecycle events, terminal — replays as an accepted, complete
+        protocol trace."""
+        from aclswarm_tpu.serve import ServiceConfig, SwarmService
+        svc = SwarmService(ServiceConfig(max_batch=2,
+                                         journal_dir=str(tmp_path)))
+        try:
+            t = svc.submit("assign", {"n": 8, "seed": 3}, tenant="a")
+            assert t.result(timeout=120).ok
+        finally:
+            svc.close()
+        assert modelmod.refine_dir(tmp_path) == []

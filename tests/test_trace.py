@@ -209,6 +209,43 @@ class TestPostmortem:
                  kind="rollout", tenant="a")     # never resolves
         assert postmortem.main([str(tmp_path)]) == 1
 
+    def test_fleet_all_gates_on_duplicate_terminals(self, tmp_path,
+                                                    capsys):
+        """Two slot journals, one request terminal in BOTH (the
+        router re-placed a dead slot's work while the slot's successor
+        independently recovered and honored the same promise). The
+        plain merge stays exit 0 — bounded at-least-once duplicate
+        compute is legal — but `--all` surfaces duplicate_terminals in
+        the summary table and a nonzero count fails the gate."""
+        a, b = tmp_path / "slot0", tmp_path / "slot1"
+        la = LifecycleLog(a / "events.log")
+        lb = LifecycleLog(b / "events.log")
+        _emit_clean_timeline(la, "r1", mint_trace_id())
+        _emit_clean_timeline(la, "dup", "feed", t0=2000.0)
+        _emit_clean_timeline(lb, "dup", "feed", t0=2000.0)
+        rep = postmortem.fleet_reconstruct([a, b])
+        assert rep["losses"] == [] and \
+            rep["duplicate_terminals"] == ["dup"]
+        argv = [str(a), str(b)]
+        assert postmortem.main(argv) == 0          # merge: legal
+        capsys.readouterr()
+        assert postmortem.main(argv + ["--all"]) == 1
+        out = capsys.readouterr().out
+        assert "duplicate_terminals 1" in out
+        assert "DUPLICATE: dup" in out
+
+    def test_fleet_all_clean_merge_passes(self, tmp_path, capsys):
+        """The duplicate gate must not fail a clean migration-free
+        two-journal merge."""
+        a, b = tmp_path / "slot0", tmp_path / "slot1"
+        _emit_clean_timeline(LifecycleLog(a / "events.log"), "r1",
+                             mint_trace_id())
+        _emit_clean_timeline(LifecycleLog(b / "events.log"), "r2",
+                             mint_trace_id())
+        assert postmortem.main([str(a), str(b), "--all"]) == 0
+        out = capsys.readouterr().out
+        assert "duplicate_terminals 0" in out
+
 
 # ------------------------------------------------------- span crash dump
 
